@@ -1,0 +1,25 @@
+"""predictionio_tpu — a TPU-native machine-learning server framework.
+
+A ground-up rebuild of the *capabilities* of Apache PredictionIO
+(incubating) — event collection, DASE engines (DataSource / Preparator /
+Algorithm / Serving / Evaluation), train/eval/deploy workflows, pluggable
+storage, and REST serving — with the Spark/MLlib compute substrate replaced
+by JAX/XLA: training data staged into device arrays sharded over a
+``jax.sharding.Mesh``, algorithms compiled with ``jax.jit`` under explicit
+sharding, and a predict server dispatching onto pre-compiled TPU executables.
+
+Layer map (mirrors reference SURVEY.md §1, reimagined TPU-first):
+
+* ``predictionio_tpu.data``     — event model + pluggable storage (L2)
+* ``predictionio_tpu.core``     — DASE controller API + workflow runtime (L4/L5)
+* ``predictionio_tpu.parallel`` — mesh / sharding / collectives (replaces Spark, L3)
+* ``predictionio_tpu.ops``      — JAX/Pallas numeric kernels (replaces MLlib)
+* ``predictionio_tpu.models``   — engine templates (ALS recommendation,
+  Naive Bayes classification, similar-product, e-commerce) (L7)
+* ``predictionio_tpu.serving``  — event server + engine server (L1)
+* ``predictionio_tpu.cli``      — ``pio``-style console (L6)
+"""
+
+from predictionio_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,21 @@
+"""Data layer: event model, property aggregation, pluggable storage.
+
+TPU-native counterpart of the reference ``data`` module
+(``data/src/main/scala/org/apache/predictionio/data`` in the reference
+tree): the Event/DataMap model, the ``$set/$unset/$delete`` property
+aggregation algebra, the env-var-driven storage registry, and the
+engine-facing event stores. Unlike the reference there is no RDD type:
+bulk reads surface as columnar :class:`~predictionio_tpu.data.eventframe.EventFrame`
+batches ready to be staged onto device meshes.
+"""
+
+from predictionio_tpu.data.datamap import DataMap, PropertyMap
+from predictionio_tpu.data.event import Event, EventValidationError, validate_event
+
+__all__ = [
+    "DataMap",
+    "PropertyMap",
+    "Event",
+    "EventValidationError",
+    "validate_event",
+]

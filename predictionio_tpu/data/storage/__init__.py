@@ -1,0 +1,308 @@
+"""Env-var-driven storage registry.
+
+Capability parity with the reference's ``Storage`` object
+(``data/.../storage/Storage.scala:114-403``): storage *sources* are
+declared with ``PIO_STORAGE_SOURCES_<NAME>_TYPE`` (+ per-source config
+keys), and the three *repositories* — METADATA, EVENTDATA, MODELDATA —
+are bound to sources with
+``PIO_STORAGE_REPOSITORIES_<REPO>_{NAME,SOURCE}``.
+
+Where the reference discovers backend classes reflectively by naming
+convention (``jdbc.JDBCApps`` etc., Storage.scala:124-193), we use an
+explicit registry (:func:`register_backend`) — the idiomatic Python
+extension point (SURVEY.md §7 hard-part (e)). Built-ins: ``memory``,
+``sqlite``, ``localfs`` (models only).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Callable, Mapping
+
+from predictionio_tpu.data.storage.base import (
+    AccessKey,
+    AccessKeysBackend,
+    App,
+    AppsBackend,
+    Channel,
+    ChannelsBackend,
+    EngineInstance,
+    EngineInstancesBackend,
+    EvaluationInstance,
+    EvaluationInstancesBackend,
+    EventsBackend,
+    Model,
+    ModelsBackend,
+)
+
+__all__ = [
+    "App", "AccessKey", "Channel", "EngineInstance", "EvaluationInstance",
+    "Model",
+    "AppsBackend", "AccessKeysBackend", "ChannelsBackend",
+    "EngineInstancesBackend", "EvaluationInstancesBackend", "EventsBackend",
+    "ModelsBackend",
+    "Storage", "StorageError", "register_backend", "get_storage",
+    "set_storage",
+]
+
+
+class StorageError(RuntimeError):
+    """Reference ``StorageClientException``."""
+
+
+@dataclass
+class BackendSpec:
+    """Factories for one backend type; any entry may be None if the
+    backend does not support that repository (reference: hbase = events
+    only, elasticsearch = metadata only, localfs = models only)."""
+
+    client: Callable[[dict], object]
+    apps: Callable[[object], AppsBackend] | None = None
+    access_keys: Callable[[object], AccessKeysBackend] | None = None
+    channels: Callable[[object], ChannelsBackend] | None = None
+    engine_instances: Callable[[object], EngineInstancesBackend] | None = None
+    evaluation_instances: (
+        Callable[[object], EvaluationInstancesBackend] | None
+    ) = None
+    models: Callable[[object], ModelsBackend] | None = None
+    events: Callable[[object], EventsBackend] | None = None
+
+
+_BACKENDS: dict[str, BackendSpec] = {}
+
+
+def register_backend(type_name: str, spec: BackendSpec) -> None:
+    _BACKENDS[type_name] = spec
+
+
+def _register_builtins() -> None:
+    from predictionio_tpu.data.storage import localfs, memory, sqlite
+
+    class _MemoryClient:
+        def __init__(self, config: dict):
+            self.apps = memory.MemoryApps()
+            self.access_keys = memory.MemoryAccessKeys()
+            self.channels = memory.MemoryChannels()
+            self.engine_instances = memory.MemoryEngineInstances()
+            self.evaluation_instances = memory.MemoryEvaluationInstances()
+            self.models = memory.MemoryModels()
+            self.events = memory.MemoryEvents()
+
+    register_backend(
+        "memory",
+        BackendSpec(
+            client=_MemoryClient,
+            apps=lambda c: c.apps,
+            access_keys=lambda c: c.access_keys,
+            channels=lambda c: c.channels,
+            engine_instances=lambda c: c.engine_instances,
+            evaluation_instances=lambda c: c.evaluation_instances,
+            models=lambda c: c.models,
+            events=lambda c: c.events,
+        ),
+    )
+    register_backend(
+        "sqlite",
+        BackendSpec(
+            client=sqlite.SQLiteClient,
+            apps=sqlite.SQLiteApps,
+            access_keys=sqlite.SQLiteAccessKeys,
+            channels=sqlite.SQLiteChannels,
+            engine_instances=sqlite.SQLiteEngineInstances,
+            evaluation_instances=sqlite.SQLiteEvaluationInstances,
+            models=sqlite.SQLiteModels,
+            events=sqlite.SQLiteEvents,
+        ),
+    )
+    register_backend(
+        "localfs",
+        BackendSpec(
+            client=lambda config: config,
+            models=lambda config: localfs.LocalFSModels(config),
+        ),
+    )
+
+
+_register_builtins()
+
+_REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")
+
+
+class Storage:
+    """One configured storage environment.
+
+    Accessors mirror the reference's
+    ``Storage.getMetaData*/getLEvents/getModelDataModels``
+    (Storage.scala:360-392).
+    """
+
+    def __init__(self, env: Mapping[str, str] | None = None):
+        self._env = dict(env if env is not None else os.environ)
+        self._clients: dict[str, object] = {}
+        self._specs: dict[str, tuple[BackendSpec, dict]] = {}
+        self._repo_source: dict[str, str] = {}
+        self._lock = threading.Lock()
+        self._parse()
+
+    # -- env parsing (reference Storage.scala:124-193) --------------------
+    def _parse(self) -> None:
+        prefix = "PIO_STORAGE_SOURCES_"
+        sources: dict[str, dict] = {}
+        for k, v in self._env.items():
+            if not k.startswith(prefix):
+                continue
+            rest = k[len(prefix):]
+            name, _, key = rest.partition("_")
+            sources.setdefault(name, {})[key] = v
+        for name, conf in sources.items():
+            type_name = conf.get("TYPE")
+            if type_name is None:
+                continue
+            spec = _BACKENDS.get(type_name)
+            if spec is None:
+                raise StorageError(
+                    f"storage source {name}: unknown backend type "
+                    f"{type_name!r} (registered: {sorted(_BACKENDS)})"
+                )
+            self._specs[name] = (spec, conf)
+
+        for repo in _REPOSITORIES:
+            src = self._env.get(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE")
+            if src is not None:
+                if src not in self._specs:
+                    raise StorageError(
+                        f"repository {repo} bound to undeclared source {src}"
+                    )
+                self._repo_source[repo] = src
+
+        if not self._specs:
+            self._default_wiring()
+
+    def _default_wiring(self) -> None:
+        """Zero-config default: sqlite for metadata+events, localfs models
+        under ``PIO_FS_BASEDIR`` (default ``~/.piotpu``)."""
+        base = self._env.get(
+            "PIO_FS_BASEDIR",
+            os.path.join(os.path.expanduser("~"), ".piotpu"),
+        )
+        self._specs = {
+            "SQLITE": (
+                _BACKENDS["sqlite"],
+                {"TYPE": "sqlite", "PATH": os.path.join(base, "pio.sqlite")},
+            ),
+            "LOCALFS": (
+                _BACKENDS["localfs"],
+                {"TYPE": "localfs", "PATH": os.path.join(base, "models")},
+            ),
+        }
+        self._repo_source = {
+            "METADATA": "SQLITE",
+            "EVENTDATA": "SQLITE",
+            "MODELDATA": "LOCALFS",
+        }
+
+    def _client(self, source: str):
+        with self._lock:
+            if source not in self._clients:
+                spec, conf = self._specs[source]
+                self._clients[source] = spec.client(conf)
+            return self._clients[source]
+
+    def _dao(self, repo: str, attr: str):
+        source = self._repo_source.get(repo)
+        if source is None:
+            if len(self._specs) == 1:
+                # exactly one declared source: binding is unambiguous
+                source = next(iter(self._specs))
+            else:
+                raise StorageError(
+                    f"repository {repo} is not bound to a source; set "
+                    f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE to one of "
+                    f"{sorted(self._specs)}"
+                )
+        spec, _conf = self._specs[source]
+        factory = getattr(spec, attr)
+        if factory is None:
+            raise StorageError(
+                f"storage source {source} does not support {attr} "
+                f"(repository {repo})"
+            )
+        return factory(self._client(source))
+
+    # -- accessors --------------------------------------------------------
+    def get_meta_data_apps(self) -> AppsBackend:
+        return self._dao("METADATA", "apps")
+
+    def get_meta_data_access_keys(self) -> AccessKeysBackend:
+        return self._dao("METADATA", "access_keys")
+
+    def get_meta_data_channels(self) -> ChannelsBackend:
+        return self._dao("METADATA", "channels")
+
+    def get_meta_data_engine_instances(self) -> EngineInstancesBackend:
+        return self._dao("METADATA", "engine_instances")
+
+    def get_meta_data_evaluation_instances(
+        self,
+    ) -> EvaluationInstancesBackend:
+        return self._dao("METADATA", "evaluation_instances")
+
+    def get_model_data_models(self) -> ModelsBackend:
+        return self._dao("MODELDATA", "models")
+
+    def get_events(self) -> EventsBackend:
+        return self._dao("EVENTDATA", "events")
+
+    # -- health (reference Storage.verifyAllDataObjects:335-358) ----------
+    def verify_all_data_objects(self) -> list[str]:
+        """Instantiate every DAO + event-store write/remove roundtrip on
+        app id 0; returns a list of problems (empty = healthy)."""
+        problems: list[str] = []
+        for name in (
+            "get_meta_data_apps",
+            "get_meta_data_access_keys",
+            "get_meta_data_channels",
+            "get_meta_data_engine_instances",
+            "get_meta_data_evaluation_instances",
+            "get_model_data_models",
+        ):
+            try:
+                getattr(self, name)()
+            except Exception as e:  # noqa: BLE001 - health check surface
+                problems.append(f"{name}: {e}")
+        try:
+            events = self.get_events()
+            events.init(0)
+            from predictionio_tpu.data.event import Event
+
+            eid = events.insert(
+                Event(event="$set", entity_type="health", entity_id="0"),
+                0,
+            )
+            events.delete(eid, 0)
+            events.remove(0)
+        except Exception as e:  # noqa: BLE001
+            problems.append(f"events: {e}")
+        return problems
+
+
+_default_storage: Storage | None = None
+_default_lock = threading.Lock()
+
+
+def get_storage() -> Storage:
+    """Process-default storage parsed from ``os.environ``."""
+    global _default_storage
+    with _default_lock:
+        if _default_storage is None:
+            _default_storage = Storage()
+        return _default_storage
+
+
+def set_storage(storage: Storage | None) -> None:
+    """Override the process default (tests, embedded use)."""
+    global _default_storage
+    with _default_lock:
+        _default_storage = storage

@@ -1,0 +1,52 @@
+"""Local-filesystem model blob store.
+
+Counterpart of the reference's ``localfs`` backend
+(``data/.../storage/localfs/LocalFSModels.scala``, model blobs as files
+under ``PIO_FS_BASEDIR``). Model checkpoints written by orbax (sharded
+array checkpoints) also live under this root — see
+:mod:`predictionio_tpu.core.persistence`.
+"""
+
+from __future__ import annotations
+
+import os
+
+from predictionio_tpu.data.storage.base import Model, ModelsBackend
+
+
+class LocalFSModels(ModelsBackend):
+    def __init__(self, config: dict | None = None):
+        config = config or {}
+        base = config.get("PATH") or os.path.join(
+            os.environ.get(
+                "PIO_FS_BASEDIR",
+                os.path.join(os.path.expanduser("~"), ".piotpu"),
+            ),
+            "models",
+        )
+        os.makedirs(base, exist_ok=True)
+        self._base = base
+
+    def _path(self, model_id: str) -> str:
+        safe = model_id.replace("/", "_")
+        return os.path.join(self._base, f"pio_model_{safe}.bin")
+
+    def insert(self, model: Model) -> None:
+        tmp = self._path(model.id) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(model.models)
+        os.replace(tmp, self._path(model.id))
+
+    def get(self, model_id: str) -> Model | None:
+        try:
+            with open(self._path(model_id), "rb") as f:
+                return Model(id=model_id, models=f.read())
+        except FileNotFoundError:
+            return None
+
+    def delete(self, model_id: str) -> bool:
+        try:
+            os.remove(self._path(model_id))
+            return True
+        except FileNotFoundError:
+            return False

@@ -1,0 +1,140 @@
+"""Engine-facing event stores (developer API).
+
+Counterpart of the reference's ``data/.../store`` package:
+
+* :class:`EventStore` ≈ ``PEventStore`` (store/PEventStore.scala:30-116) —
+  bulk, training-time reads addressed by **app name** (+ optional channel
+  name), resolved to ids through the metadata store
+  (store/Common.appNameToId:28-49). Bulk results surface as
+  :class:`~predictionio_tpu.data.eventframe.EventFrame` columnar batches
+  instead of ``RDD[Event]``.
+* The same class exposes ``find_by_entity`` ≈ ``LEventStore``
+  (store/LEventStore.scala:30-142) — low-latency serve-time reads
+  (latest-first), used by the e-commerce template's predict path.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Iterator, Sequence
+
+from predictionio_tpu.data.datamap import PropertyMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.eventframe import EventFrame
+from predictionio_tpu.data.storage import Storage, get_storage
+
+
+class EventStoreError(RuntimeError):
+    pass
+
+
+class EventStore:
+    """App-name-addressed event reads over the configured storage."""
+
+    def __init__(self, storage: Storage | None = None):
+        self._storage = storage or get_storage()
+
+    # -- name→id resolution (reference store/Common.scala:28-49) ----------
+    def _resolve(
+        self, app_name: str, channel_name: str | None
+    ) -> tuple[int, int | None]:
+        app = self._storage.get_meta_data_apps().get_by_name(app_name)
+        if app is None:
+            raise EventStoreError(
+                f"Invalid app name {app_name!r}: app does not exist."
+            )
+        if channel_name is None:
+            return app.id, None
+        channels = self._storage.get_meta_data_channels().get_by_app_id(
+            app.id
+        )
+        for ch in channels:
+            if ch.name == channel_name:
+                return app.id, ch.id
+        raise EventStoreError(
+            f"Invalid channel name {channel_name!r} for app {app_name!r}."
+        )
+
+    # -- bulk (training-time) ---------------------------------------------
+    def find(
+        self,
+        app_name: str,
+        channel_name: str | None = None,
+        start_time: _dt.datetime | None = None,
+        until_time: _dt.datetime | None = None,
+        entity_type: str | None = None,
+        entity_id: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type=...,
+        target_entity_id=...,
+    ) -> Iterator[Event]:
+        app_id, channel_id = self._resolve(app_name, channel_name)
+        return self._storage.get_events().find(
+            app_id,
+            channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
+        )
+
+    def frame(self, app_name: str, **kwargs) -> EventFrame:
+        """Bulk columnar read — the device-staging path."""
+        return EventFrame.from_events(self.find(app_name, **kwargs))
+
+    def aggregate_properties(
+        self,
+        app_name: str,
+        entity_type: str,
+        channel_name: str | None = None,
+        start_time: _dt.datetime | None = None,
+        until_time: _dt.datetime | None = None,
+        required: Sequence[str] | None = None,
+    ) -> dict[str, PropertyMap]:
+        """Reference PEventStore.aggregateProperties:70-116."""
+        app_id, channel_id = self._resolve(app_name, channel_name)
+        return self._storage.get_events().aggregate_properties(
+            app_id,
+            channel_id,
+            entity_type=entity_type,
+            start_time=start_time,
+            until_time=until_time,
+            required=required,
+        )
+
+    # -- serve-time (reference LEventStore) -------------------------------
+    def find_by_entity(
+        self,
+        app_name: str,
+        entity_type: str,
+        entity_id: str,
+        channel_name: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type=...,
+        target_entity_id=...,
+        start_time: _dt.datetime | None = None,
+        until_time: _dt.datetime | None = None,
+        limit: int | None = None,
+        latest: bool = True,
+    ) -> list[Event]:
+        """Latest-first entity scan for predict-time business rules
+        (reference LEventStore.findByEntity:36-85)."""
+        app_id, channel_id = self._resolve(app_name, channel_name)
+        return list(
+            self._storage.get_events().find(
+                app_id,
+                channel_id,
+                start_time=start_time,
+                until_time=until_time,
+                entity_type=entity_type,
+                entity_id=entity_id,
+                event_names=event_names,
+                target_entity_type=target_entity_type,
+                target_entity_id=target_entity_id,
+                limit=limit,
+                reversed=latest,
+            )
+        )

@@ -1,0 +1,1 @@
+"""Shared utilities: BiMap id-interning, logging, config helpers."""

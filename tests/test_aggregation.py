@@ -1,0 +1,164 @@
+"""EventOp monoid tests (reference LEventAggregatorSpec / PEventAggregatorSpec).
+
+Key property: the fold is order- and grouping-independent, so the
+aggregation can be sharded arbitrarily.
+"""
+
+import datetime as dt
+import itertools
+import random
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.aggregation import EventOp, aggregate_properties
+
+
+def _t(seconds: int) -> dt.datetime:
+    return dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc) + dt.timedelta(
+        seconds=seconds
+    )
+
+
+def _set(eid, props, t):
+    return Event(
+        event="$set",
+        entity_type="user",
+        entity_id=eid,
+        properties=DataMap(props),
+        event_time=_t(t),
+    )
+
+
+def _unset(eid, keys, t):
+    return Event(
+        event="$unset",
+        entity_type="user",
+        entity_id=eid,
+        properties=DataMap({k: None for k in keys}),
+        event_time=_t(t),
+    )
+
+
+def _delete(eid, t):
+    return Event(
+        event="$delete", entity_type="user", entity_id=eid, event_time=_t(t)
+    )
+
+
+def test_set_last_write_wins():
+    out = aggregate_properties(
+        [
+            _set("u1", {"a": 1, "b": 1}, 0),
+            _set("u1", {"a": 2}, 10),
+            _set("u1", {"b": 0}, 5),
+        ]
+    )
+    pm = out["u1"]
+    assert pm["a"] == 2
+    assert pm["b"] == 0
+    assert pm.first_updated == _t(0)
+    assert pm.last_updated == _t(10)
+
+
+def test_unset_only_removes_older_sets():
+    out = aggregate_properties(
+        [
+            _set("u1", {"a": 1, "b": 1}, 0),
+            _unset("u1", ["a"], 5),
+            _set("u1", {"a": 3}, 10),  # re-set after unset → survives
+            _unset("u1", ["b"], 1),
+        ]
+    )
+    pm = out["u1"]
+    assert pm["a"] == 3
+    assert "b" not in pm
+
+
+def test_delete_covering_latest_set_removes_entity():
+    out = aggregate_properties(
+        [_set("u1", {"a": 1}, 0), _delete("u1", 5)]
+    )
+    assert "u1" not in out
+
+
+def test_delete_then_set_survives():
+    out = aggregate_properties(
+        [
+            _set("u1", {"a": 1, "b": 2}, 0),
+            _delete("u1", 5),
+            _set("u1", {"a": 9}, 10),
+        ]
+    )
+    pm = out["u1"]
+    assert pm["a"] == 9
+    assert "b" not in pm  # set before the delete
+
+
+def test_entity_without_set_is_absent():
+    out = aggregate_properties([_unset("u1", ["a"], 0), _delete("u2", 0)])
+    assert out == {}
+
+
+def test_non_special_events_ignored():
+    e = Event(
+        event="rate",
+        entity_type="user",
+        entity_id="u1",
+        target_entity_type="item",
+        target_entity_id="i1",
+        event_time=_t(0),
+    )
+    assert aggregate_properties([e]) == {}
+
+
+def test_monoid_order_independence():
+    events = [
+        _set("u1", {"a": 1, "b": 1, "c": 1}, 0),
+        _unset("u1", ["b"], 3),
+        _set("u1", {"a": 2}, 6),
+        _delete("u1", 4),
+        _set("u1", {"d": 4}, 8),
+        _unset("u1", ["d"], 7),  # older than the set at t=8 → no-op
+    ]
+    expected = aggregate_properties(events)
+    rng = random.Random(0)
+    for _ in range(20):
+        shuffled = events[:]
+        rng.shuffle(shuffled)
+        assert aggregate_properties(shuffled) == expected
+
+
+def test_monoid_grouping_independence():
+    events = [
+        _set("u1", {"a": 1}, 0),
+        _unset("u1", ["a"], 2),
+        _set("u1", {"a": 5, "b": 6}, 4),
+        _delete("u1", 1),
+    ]
+    ops = [EventOp.from_event(e) for e in events]
+    # fold left-to-right
+    seq = ops[0]
+    for op in ops[1:]:
+        seq = seq.combine(op)
+    # fold as balanced tree with identity padding
+    tree = (
+        ops[0].combine(ops[1]) .combine(ops[2].combine(ops[3]))
+    ).combine(EventOp.identity())
+    assert seq.to_property_map() == tree.to_property_map()
+    assert seq.to_property_map()["a"] == 5
+
+
+def test_associativity_exhaustive_small():
+    events = [
+        _set("u1", {"a": 1}, 0),
+        _unset("u1", ["a"], 1),
+        _set("u1", {"a": 2}, 2),
+        _delete("u1", 3),
+    ]
+    ops = [EventOp.from_event(e) for e in events]
+    results = set()
+    for perm in itertools.permutations(range(4)):
+        acc = EventOp.identity()
+        for i in perm:
+            acc = acc.combine(ops[i])
+        results.add(repr(acc.to_property_map()))
+    assert len(results) == 1  # None for every ordering (delete at t=3 wins)

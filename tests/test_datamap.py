@@ -1,0 +1,50 @@
+"""DataMap typed-access tests (reference DataMapSpec)."""
+
+import pytest
+
+from predictionio_tpu.data import DataMap
+from predictionio_tpu.data.datamap import DataMapError
+
+
+def test_typed_access():
+    d = DataMap(
+        {
+            "s": "hello",
+            "f": 1.5,
+            "i": 3,
+            "ls": ["a", "b"],
+            "lf": [1, 2.5],
+            "n": None,
+        }
+    )
+    assert d.get_str("s") == "hello"
+    assert d.get_float("f") == 1.5
+    assert d.get_int("i") == 3
+    assert d.get_str_list("ls") == ["a", "b"]
+    assert d.get_float_list("lf") == [1.0, 2.5]
+    assert d.get_opt("missing") is None
+    assert d.get("missing", 7) == 7
+    with pytest.raises(DataMapError):
+        d.get_required("n")  # null required field
+    with pytest.raises(DataMapError):
+        d.get_required("missing")
+    with pytest.raises(DataMapError):
+        d.get_list("s")
+
+
+def test_merge_and_remove():
+    a = DataMap({"x": 1, "y": 2})
+    b = a.merged_with({"y": 3, "z": 4})
+    assert b.to_dict() == {"x": 1, "y": 3, "z": 4}
+    c = b.without(["x", "z"])
+    assert c.to_dict() == {"y": 3}
+    # original untouched (immutability)
+    assert a.to_dict() == {"x": 1, "y": 2}
+
+
+def test_mapping_protocol():
+    d = DataMap({"x": 1})
+    assert "x" in d
+    assert len(d) == 1
+    assert dict(d) == {"x": 1}
+    assert d == DataMap({"x": 1})

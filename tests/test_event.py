@@ -1,0 +1,100 @@
+"""Event model + validation tests (reference Event.scala rules)."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data import DataMap, Event, EventValidationError
+
+
+def test_basic_event_roundtrip_json():
+    e = Event(
+        event="rate",
+        entity_type="user",
+        entity_id="u1",
+        target_entity_type="item",
+        target_entity_id="i1",
+        properties=DataMap({"rating": 4.5}),
+        tags=("a", "b"),
+        pr_id="pr-1",
+    )
+    d = e.to_json_dict()
+    e2 = Event.from_json_dict(d)
+    assert e2.event == "rate"
+    assert e2.target_entity_id == "i1"
+    assert e2.properties.get_float("rating") == 4.5
+    assert e2.tags == ("a", "b")
+    assert e2.pr_id == "pr-1"
+    assert e2.event_time == e.event_time
+
+
+def test_naive_event_time_becomes_utc():
+    e = Event(
+        event="view",
+        entity_type="user",
+        entity_id="u1",
+        event_time=dt.datetime(2020, 1, 1, 12, 0, 0),
+    )
+    assert e.event_time.tzinfo is not None
+
+
+@pytest.mark.parametrize(
+    "kwargs",
+    [
+        dict(event="", entity_type="user", entity_id="u1"),
+        dict(event="view", entity_type="", entity_id="u1"),
+        dict(event="view", entity_type="user", entity_id=""),
+        # unsupported reserved names
+        dict(event="$foo", entity_type="user", entity_id="u1"),
+        dict(event="pio_x", entity_type="user", entity_id="u1"),
+        dict(event="view", entity_type="pio_custom", entity_id="u1"),
+        # special events must not carry target entity
+        dict(
+            event="$set",
+            entity_type="user",
+            entity_id="u1",
+            target_entity_type="item",
+            target_entity_id="i1",
+        ),
+        # $unset requires non-empty properties
+        dict(event="$unset", entity_type="user", entity_id="u1"),
+        # target type/id must come together
+        dict(
+            event="view",
+            entity_type="user",
+            entity_id="u1",
+            target_entity_type="item",
+        ),
+        # reserved property key
+        dict(
+            event="view",
+            entity_type="user",
+            entity_id="u1",
+            properties=DataMap({"pio_x": 1}),
+        ),
+    ],
+)
+def test_invalid_events_rejected(kwargs):
+    with pytest.raises(EventValidationError):
+        Event(**kwargs)
+
+
+def test_builtin_entity_type_allowed():
+    e = Event(event="predict", entity_type="pio_pr", entity_id="p1")
+    assert e.entity_type == "pio_pr"
+
+
+def test_special_events_allowed():
+    for name in ("$set", "$delete"):
+        Event(event=name, entity_type="user", entity_id="u1")
+    Event(
+        event="$unset",
+        entity_type="user",
+        entity_id="u1",
+        properties=DataMap({"a": None}),
+    )
+
+
+def test_from_json_requires_fields():
+    with pytest.raises(EventValidationError):
+        Event.from_json_dict({"event": "view", "entityType": "user"})

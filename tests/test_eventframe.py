@@ -1,0 +1,159 @@
+"""EventFrame / BiMap / Interactions tests (reference BiMapSpec + the
+DataSource→dense-id staging path every template exercises)."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.eventframe import EventFrame
+from predictionio_tpu.utils.bimap import BiMap
+
+
+def _t(s):
+    return dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc) + dt.timedelta(
+        seconds=s
+    )
+
+
+def _rate(u, i, r, t):
+    return Event(
+        event="rate",
+        entity_type="user",
+        entity_id=u,
+        target_entity_type="item",
+        target_entity_id=i,
+        properties=DataMap({"rating": r}),
+        event_time=_t(t),
+    )
+
+
+class TestBiMap:
+    def test_string_int(self):
+        m = BiMap.string_int(["b", "a", "c", "a"])
+        assert len(m) == 3
+        assert sorted(m(k) for k in ("a", "b", "c")) == [0, 1, 2]
+        assert m.inverse(m("b")) == "b"
+
+    def test_encode_decode_vectorized(self):
+        arr = np.asarray(["u3", "u1", "u2", "u1", "zz"])
+        m, codes = BiMap.string_int_with_codes(arr[:4])
+        assert list(m.decode(codes)) == ["u3", "u1", "u2", "u1"]
+        enc = m.encode(arr)
+        assert enc[4] == -1  # unknown
+        assert list(m.decode(enc[:4])) == ["u3", "u1", "u2", "u1"]
+
+    def test_encode_unsorted_keys(self):
+        m = BiMap(["z", "a", "m"])
+        enc = m.encode(np.asarray(["a", "z", "m", "q"]))
+        assert list(enc) == [1, 0, 2, -1]
+
+    def test_unique_required(self):
+        with pytest.raises(ValueError):
+            BiMap(["a", "a"])
+
+
+class TestEventFrame:
+    def test_from_events_columns(self):
+        fr = EventFrame.from_events(
+            [_rate("u1", "i1", 4.0, 0), _rate("u2", "i2", 2.0, 5)]
+        )
+        assert len(fr) == 2
+        assert list(fr.entity_id) == ["u1", "u2"]
+        assert list(fr.target_entity_id) == ["i1", "i2"]
+        assert fr.event_time[1] - fr.event_time[0] == 5.0
+        assert list(fr.property_column("rating")) == [4.0, 2.0]
+
+    def test_to_interactions(self):
+        fr = EventFrame.from_events(
+            [
+                _rate("u1", "i1", 4.0, 0),
+                _rate("u1", "i2", 3.0, 1),
+                _rate("u2", "i1", 5.0, 2),
+            ]
+        )
+        inter = fr.to_interactions(value_key="rating")
+        assert inter.n_rows == 2 and inter.n_cols == 2
+        assert inter.nnz == 3
+        dense = np.zeros((2, 2), dtype=np.float32)
+        dense[inter.rows, inter.cols] = inter.values
+        u1, u2 = inter.entity_map("u1"), inter.entity_map("u2")
+        i1, i2 = inter.target_map("i1"), inter.target_map("i2")
+        assert dense[u1, i1] == 4.0
+        assert dense[u1, i2] == 3.0
+        assert dense[u2, i1] == 5.0
+
+    def test_to_interactions_with_existing_maps_drops_unknown(self):
+        fr = EventFrame.from_events(
+            [_rate("u1", "i1", 4.0, 0), _rate("uX", "i1", 1.0, 1)]
+        )
+        emap = BiMap(["u1"])
+        inter = fr.to_interactions(value_key="rating", entity_map=emap)
+        assert inter.nnz == 1
+        assert inter.values[0] == 4.0
+
+    def test_dedupe_sum_and_latest(self):
+        fr = EventFrame.from_events(
+            [
+                _rate("u1", "i1", 1.0, 0),
+                _rate("u1", "i1", 2.0, 5),
+                _rate("u1", "i2", 3.0, 1),
+            ]
+        )
+        inter = fr.to_interactions(value_key="rating")
+        summed = inter.dedupe_sum()
+        assert summed.nnz == 2
+        i1 = inter.target_map("i1")
+        v = {
+            (r, c): val
+            for r, c, val in zip(summed.rows, summed.cols, summed.values)
+        }
+        assert v[(0, i1)] == 3.0  # 1 + 2
+        latest = inter.dedupe_latest()
+        v = {
+            (r, c): val
+            for r, c, val in zip(latest.rows, latest.cols, latest.values)
+        }
+        assert v[(0, i1)] == 2.0  # the t=5 event wins
+
+    def test_filter_events(self):
+        fr = EventFrame.from_events(
+            [
+                _rate("u1", "i1", 1.0, 0),
+                Event(
+                    event="view",
+                    entity_type="user",
+                    entity_id="u1",
+                    target_entity_type="item",
+                    target_entity_id="i2",
+                    event_time=_t(1),
+                ),
+            ]
+        )
+        assert len(fr.filter_events(["view"])) == 1
+
+
+class TestReviewRegressions:
+    def test_empty_target_rows_dropped_from_interactions(self):
+        from predictionio_tpu.data import DataMap
+        events = [
+            _rate("u1", "i1", 4.0, 0),
+            Event(
+                event="$set",
+                entity_type="user",
+                entity_id="u1",
+                properties=DataMap({"a": 1}),
+                event_time=_t(1),
+            ),
+        ]
+        inter = EventFrame.from_events(events).to_interactions(
+            value_key="rating"
+        )
+        assert inter.nnz == 1
+        assert "" not in inter.target_map
+
+    def test_empty_bimap_encode(self):
+        m = BiMap(np.asarray([], dtype=np.str_))
+        enc = m.encode(np.asarray(["a", "b"]))
+        assert list(enc) == [-1, -1]

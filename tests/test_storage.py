@@ -1,0 +1,345 @@
+"""Storage contract suite — run against every backend
+(reference LEventsSpec/PEventsSpec pattern: one contract, N backends,
+data/src/test/.../LEventsSpec.scala:22-49)."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EvaluationInstance,
+    Model,
+    Storage,
+    StorageError,
+)
+
+
+def _t(seconds: int) -> dt.datetime:
+    return dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc) + dt.timedelta(
+        seconds=seconds
+    )
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def storage(request, memory_storage, sqlite_storage):
+    return {"memory": memory_storage, "sqlite": sqlite_storage}[
+        request.param
+    ]
+
+
+class TestApps:
+    def test_crud(self, storage):
+        apps = storage.get_meta_data_apps()
+        app_id = apps.insert(App(id=0, name="myapp", description="d"))
+        assert app_id is not None and app_id > 0
+        assert apps.get(app_id).name == "myapp"
+        assert apps.get_by_name("myapp").id == app_id
+        # duplicate name rejected
+        assert apps.insert(App(id=0, name="myapp")) is None
+        assert apps.update(App(id=app_id, name="myapp2")) is True
+        assert apps.get_by_name("myapp2") is not None
+        assert [a.id for a in apps.get_all()] == [app_id]
+        assert apps.delete(app_id) is True
+        assert apps.get(app_id) is None
+
+
+class TestAccessKeys:
+    def test_crud(self, storage):
+        keys = storage.get_meta_data_access_keys()
+        k = keys.insert(AccessKey(key="", appid=1, events=("view",)))
+        assert k and len(k) > 20
+        got = keys.get(k)
+        assert got.appid == 1 and got.events == ("view",)
+        assert keys.get_by_app_id(1) == [got]
+        assert keys.get_by_app_id(2) == []
+        assert keys.delete(k) is True
+        assert keys.get(k) is None
+
+
+class TestChannels:
+    def test_crud_and_name_validation(self, storage):
+        channels = storage.get_meta_data_channels()
+        cid = channels.insert(Channel(id=0, name="ch-1", appid=1))
+        assert cid is not None
+        assert channels.get(cid).name == "ch-1"
+        assert channels.insert(Channel(id=0, name="bad name!", appid=1)) is None
+        assert (
+            channels.insert(Channel(id=0, name="x" * 17, appid=1)) is None
+        )
+        assert [c.id for c in channels.get_by_app_id(1)] == [cid]
+        assert channels.delete(cid) is True
+
+
+class TestEngineInstances:
+    def test_lifecycle(self, storage):
+        eis = storage.get_meta_data_engine_instances()
+        base = dict(
+            engine_id="e",
+            engine_version="1",
+            engine_variant="v",
+            engine_factory="f",
+        )
+        a = eis.insert(
+            EngineInstance(
+                id="", status="INIT", start_time=_t(0), end_time=_t(0), **base
+            )
+        )
+        b = eis.insert(
+            EngineInstance(
+                id="",
+                status="COMPLETED",
+                start_time=_t(10),
+                end_time=_t(20),
+                **base,
+            )
+        )
+        c = eis.insert(
+            EngineInstance(
+                id="",
+                status="COMPLETED",
+                start_time=_t(30),
+                end_time=_t(40),
+                **base,
+            )
+        )
+        assert len({a, b, c}) == 3
+        latest = eis.get_latest_completed("e", "1", "v")
+        assert latest.id == c
+        inst = eis.get(a)
+        assert eis.update(
+            EngineInstance(**{**inst.__dict__, "status": "FAILED"})
+        )
+        assert eis.get(a).status == "FAILED"
+        assert eis.get_latest_completed("e", "1", "other") is None
+        assert eis.delete(a)
+
+
+class TestEvaluationInstances:
+    def test_lifecycle(self, storage):
+        evis = storage.get_meta_data_evaluation_instances()
+        i = evis.insert(
+            EvaluationInstance(
+                id="", status="INIT", start_time=_t(0), end_time=_t(0)
+            )
+        )
+        inst = evis.get(i)
+        assert inst.status == "INIT"
+        assert evis.update(
+            EvaluationInstance(
+                **{
+                    **inst.__dict__,
+                    "status": "EVALCOMPLETED",
+                    "evaluator_results": "best!",
+                }
+            )
+        )
+        assert evis.get_completed()[0].evaluator_results == "best!"
+
+
+class TestModels:
+    def test_blob_roundtrip(self, storage):
+        models = storage.get_model_data_models()
+        models.insert(Model(id="m1", models=b"\x00\x01\x02"))
+        assert models.get("m1").models == b"\x00\x01\x02"
+        # overwrite
+        models.insert(Model(id="m1", models=b"\x03"))
+        assert models.get("m1").models == b"\x03"
+        assert models.delete("m1") is True
+        assert models.get("m1") is None
+
+
+class TestEvents:
+    def _seed(self, events, app_id, channel_id=None):
+        events.init(app_id, channel_id)
+        out = []
+        for i in range(10):
+            out.append(
+                events.insert(
+                    Event(
+                        event="view" if i % 2 == 0 else "buy",
+                        entity_type="user",
+                        entity_id=f"u{i % 3}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{i}",
+                        properties=DataMap({"n": i}),
+                        event_time=_t(i),
+                    ),
+                    app_id,
+                    channel_id,
+                )
+            )
+        return out
+
+    def test_insert_get_delete(self, storage):
+        events = storage.get_events()
+        ids = self._seed(events, 1)
+        e = events.get(ids[0], 1)
+        assert e.event == "view" and e.properties.get_int("n") == 0
+        assert events.delete(ids[0], 1) is True
+        assert events.get(ids[0], 1) is None
+        assert events.delete(ids[0], 1) is False
+
+    def test_find_filters(self, storage):
+        events = storage.get_events()
+        self._seed(events, 1)
+        assert len(list(events.find(1))) == 10
+        assert len(list(events.find(1, event_names=["view"]))) == 5
+        assert len(list(events.find(1, entity_id="u0"))) == 4
+        assert (
+            len(list(events.find(1, start_time=_t(3), until_time=_t(7))))
+            == 4
+        )
+        got = list(events.find(1, limit=3))
+        assert [e.event_time for e in got] == [_t(0), _t(1), _t(2)]
+        got = list(events.find(1, limit=3, reversed=True))
+        assert got[0].event_time == _t(9)
+        # tri-state target filter
+        assert len(list(events.find(1, target_entity_id="i4"))) == 1
+        events.insert(
+            Event(
+                event="$set",
+                entity_type="user",
+                entity_id="u9",
+                event_time=_t(100),
+            ),
+            1,
+        )
+        assert len(list(events.find(1, target_entity_id=None))) == 1
+
+    def test_channels_isolated(self, storage):
+        events = storage.get_events()
+        self._seed(events, 1)
+        self._seed(events, 1, channel_id=7)
+        events.insert(
+            Event(event="extra", entity_type="u", entity_id="x"),
+            1,
+            7,
+        )
+        assert len(list(events.find(1))) == 10
+        assert len(list(events.find(1, 7))) == 11
+
+    def test_remove(self, storage):
+        events = storage.get_events()
+        self._seed(events, 2)
+        assert events.remove(2) is True
+        assert list(events.find(2)) == []
+
+    def test_aggregate_via_backend(self, storage):
+        events = storage.get_events()
+        events.init(3)
+        events.insert(
+            Event(
+                event="$set",
+                entity_type="item",
+                entity_id="i1",
+                properties=DataMap({"color": "red"}),
+                event_time=_t(0),
+            ),
+            3,
+        )
+        events.insert(
+            Event(
+                event="$set",
+                entity_type="item",
+                entity_id="i1",
+                properties=DataMap({"color": "blue"}),
+                event_time=_t(5),
+            ),
+            3,
+        )
+        events.insert(
+            Event(
+                event="$set",
+                entity_type="user",
+                entity_id="u1",
+                properties=DataMap({"x": 1}),
+                event_time=_t(0),
+            ),
+            3,
+        )
+        props = events.aggregate_properties(3, entity_type="item")
+        assert set(props) == {"i1"}
+        assert props["i1"]["color"] == "blue"
+
+
+class TestRegistry:
+    def test_unknown_backend_type_raises(self):
+        with pytest.raises(StorageError):
+            Storage(env={"PIO_STORAGE_SOURCES_X_TYPE": "nope"})
+
+    def test_unbound_repo_binding_raises(self):
+        with pytest.raises(StorageError):
+            Storage(
+                env={
+                    "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+                    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "OTHER",
+                }
+            )
+
+    def test_verify_all_data_objects(self, storage):
+        assert storage.verify_all_data_objects() == []
+
+    def test_models_only_source_rejects_events(self, tmp_path):
+        storage = Storage(
+            env={
+                "PIO_STORAGE_SOURCES_FS_TYPE": "localfs",
+                "PIO_STORAGE_SOURCES_FS_PATH": str(tmp_path),
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "FS",
+            }
+        )
+        with pytest.raises(StorageError):
+            storage.get_events()
+
+
+class TestReviewRegressions:
+    """Regression tests for the round-1 code-review findings."""
+
+    def test_naive_datetime_bounds_are_utc(self, storage):
+        events = storage.get_events()
+        events.init(9)
+        events.insert(
+            Event(
+                event="view",
+                entity_type="user",
+                entity_id="u1",
+                event_time=_t(100),
+            ),
+            9,
+        )
+        naive = dt.datetime(2020, 1, 1)  # == _t(0) under naive-is-UTC
+        got = list(events.find(9, start_time=naive))
+        assert len(got) == 1
+
+    def test_sqlite_insert_auto_inits_table(self, sqlite_storage):
+        events = sqlite_storage.get_events()
+        # no init() call — must auto-create like the memory backend
+        eid = events.insert(
+            Event(event="view", entity_type="user", entity_id="u1"), 77
+        )
+        assert events.get(eid, 77) is not None
+
+    def test_aggregate_requires_entity_type(self, storage):
+        events = storage.get_events()
+        events.init(8)
+        with pytest.raises(TypeError):
+            events.aggregate_properties(8)  # positional-only misuse
+        with pytest.raises(ValueError):
+            events.aggregate_properties(8, entity_type="")
+
+    def test_unbound_repo_with_multiple_sources_raises(self):
+        storage = Storage(
+            env={
+                "PIO_STORAGE_SOURCES_A_TYPE": "memory",
+                "PIO_STORAGE_SOURCES_B_TYPE": "memory",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "A",
+            }
+        )
+        with pytest.raises(StorageError):
+            storage.get_meta_data_apps()
+        # bound repo still works
+        storage.get_events()
