@@ -1,0 +1,170 @@
+"""Benchmark — prints ONE JSON line for the driver.
+
+Headline metric (BASELINE.md north star): implicit-ALS epoch time on a
+synthetic MovieLens-class workload. ``vs_baseline`` is the speedup of
+the TPU epoch over the same jitted program on this host's CPU backend
+(measured in a subprocess, cached in .bench_cpu_baseline.json) — the
+stand-in for the reference's Spark-local-CPU training until a Spark rig
+exists. >1.0 means the TPU wins.
+
+Workload: 49,152 users × 8,192 items, ~2M implicit interactions,
+rank 32 — ml-1m/ml-10m territory, sized to keep the whole bench under a
+couple of minutes including compiles.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+N_USERS = 49_152
+N_ITEMS = 8_192
+NNZ = 2_000_000
+RANK = 32
+BLOCK_LEN = 64
+ROW_CHUNK = 256
+TIMED_ITERS = 3
+
+_CACHE = os.path.join(os.path.dirname(__file__), ".bench_cpu_baseline.json")
+
+
+def make_data():
+    rng = np.random.default_rng(42)
+    # power-law item popularity, uniform users
+    pop = rng.zipf(1.3, NNZ) % N_ITEMS
+    rows = rng.integers(0, N_USERS, NNZ).astype(np.int32)
+    cols = pop.astype(np.int32)
+    vals = rng.integers(1, 6, NNZ).astype(np.float32)
+    return rows, cols, vals
+
+
+def run_epoch_bench() -> float:
+    """Median per-iteration wall-clock of the alternating solve."""
+    import jax
+
+    from predictionio_tpu.ops.als import (
+        build_padded_csr,
+        make_solve_side,
+    )
+    from predictionio_tpu.parallel.mesh import ComputeContext
+
+    ctx = ComputeContext.create(batch="bench")
+    n_data = ctx.data_parallelism
+    rows, cols, vals = make_data()
+
+    def pack(r, c, n):
+        return build_padded_csr(
+            r, c, vals, n,
+            block_len=BLOCK_LEN,
+            row_multiple=n_data,
+            block_multiple=n_data * ROW_CHUNK,
+        )
+
+    user_csr = pack(rows, cols, N_USERS)
+    item_csr = pack(cols, rows, N_ITEMS)
+    solve_u = make_solve_side(
+        ctx, user_csr.n_rows_padded, ROW_CHUNK, True, 1.0
+    )
+    solve_i = make_solve_side(
+        ctx, item_csr.n_rows_padded, ROW_CHUNK, True, 1.0
+    )
+    put = lambda a: jax.device_put(a, ctx.data_sharded)  # noqa: E731
+    u_dev = (put(user_csr.idx), put(user_csr.weights), put(user_csr.owner))
+    i_dev = (put(item_csr.idx), put(item_csr.weights), put(item_csr.owner))
+
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    y = jax.device_put(
+        (rng.normal(size=(item_csr.n_rows_padded, RANK)) / np.sqrt(RANK))
+        .astype(np.float32),
+        ctx.replicated,
+    )
+    lam = jnp.float32(0.01)
+
+    def sync(arr) -> float:
+        # host fetch of a scalar reduction: block_until_ready() returns
+        # early on the axon tunnel platform, so a device→host transfer is
+        # the only reliable sync barrier
+        return float(jax.device_get(arr.sum()))
+
+    # warmup (compile both directions)
+    x = solve_u(y, *u_dev, lam)
+    y = solve_i(x, *i_dev, lam)
+    sync(y)
+
+    times = []
+    for _ in range(TIMED_ITERS):
+        t0 = time.perf_counter()
+        x = solve_u(y, *u_dev, lam)
+        y = solve_i(x, *i_dev, lam)
+        sync(y)
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def cpu_baseline_seconds() -> float | None:
+    """Same program on the host CPU backend, cached across runs."""
+    key = f"{N_USERS}x{N_ITEMS}x{NNZ}x{RANK}"
+    try:
+        with open(_CACHE) as f:
+            cache = json.load(f)
+        if cache.get("key") == key:
+            return float(cache["seconds"])
+    except (OSError, ValueError):
+        pass
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PIO_BENCH_SIDE"] = "cpu"
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=3600,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        line = out.stdout.strip().splitlines()[-1]
+        seconds = float(json.loads(line)["value"])
+    except Exception:
+        return None
+    try:
+        with open(_CACHE, "w") as f:
+            json.dump({"key": key, "seconds": seconds}, f)
+    except OSError:
+        pass
+    return seconds
+
+
+def main() -> None:
+    if os.environ.get("PIO_BENCH_SIDE") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        secs = run_epoch_bench()
+        print(json.dumps({"metric": "als_epoch_time_cpu", "value": secs}))
+        return
+
+    secs = run_epoch_bench()
+    baseline = cpu_baseline_seconds()
+    vs = (baseline / secs) if baseline else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": "als_epoch_time",
+                "value": round(secs, 4),
+                "unit": "s",
+                "vs_baseline": round(vs, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

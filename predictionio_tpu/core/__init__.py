@@ -1,0 +1,44 @@
+"""Core: DASE controller API + engine assembly + workflow runtime.
+
+TPU-native counterpart of the reference ``core`` module: the controller
+SPI (``core/src/main/scala/.../core/Base*.scala``), the developer-facing
+controller API (``.../controller``), and the workflow runtime
+(``.../workflow``). One deliberate collapse: the reference's P/P2L/L
+algorithm trichotomy exists because RDD-backed vs local models behave
+differently on Spark (SURVEY.md §2.2); with JAX every model is a pytree
+that is either host-resident or mesh-sharded, so there is a single
+:class:`~predictionio_tpu.core.controller.Algorithm` base whose
+persistence mode covers the distinction.
+"""
+
+from predictionio_tpu.core.controller import (
+    Algorithm,
+    AverageServing,
+    DataSource,
+    EmptyParams,
+    FirstServing,
+    IdentityPreparator,
+    Params,
+    PersistenceMode,
+    Preparator,
+    Serving,
+)
+from predictionio_tpu.core.engine import Engine, EngineParams
+from predictionio_tpu.core.registry import engine_registry, register_engine
+
+__all__ = [
+    "Algorithm",
+    "AverageServing",
+    "DataSource",
+    "EmptyParams",
+    "Engine",
+    "EngineParams",
+    "FirstServing",
+    "IdentityPreparator",
+    "Params",
+    "PersistenceMode",
+    "Preparator",
+    "Serving",
+    "engine_registry",
+    "register_engine",
+]

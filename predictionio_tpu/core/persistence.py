@@ -1,0 +1,83 @@
+"""Model persistence — serialize trained models into the model store.
+
+Capability parity with the reference's three-mode persistence
+(SURVEY.md §5 "Checkpoint / resume"):
+
+* AUTO — the reference Kryo-serializes models into the Models store
+  (workflow/CoreWorkflow.scala:73-78). Here the model pytree is staged to
+  host (``jax.device_get`` — works for mesh-sharded arrays too) and
+  pickled.
+* MANUAL — the reference stores a ``PersistentModelManifest`` and calls
+  ``PersistentModel.save`` (controller/PersistentModel.scala:64-112).
+  Here the algorithm's ``save_model``/``load_model`` hooks run (orbax
+  sharded checkpoints are the intended implementation) and the store
+  keeps a manifest marker.
+* RETRAIN — a marker only; deploy re-trains (Engine.scala:208-230).
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import pickle
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+
+from predictionio_tpu.core.controller import Algorithm, PersistenceMode
+
+logger = logging.getLogger(__name__)
+
+_FORMAT_VERSION = 1
+
+
+def to_host(pytree: Any) -> Any:
+    """Stage every jax array in a pytree to host numpy (device_get
+    gathers sharded arrays; non-array leaves pass through)."""
+    return jax.tree.map(
+        lambda leaf: np.asarray(jax.device_get(leaf))
+        if isinstance(leaf, jax.Array)
+        else leaf,
+        jax.device_get(pytree),
+    )
+
+
+def serialize_models(
+    instance_id: str,
+    algorithms: Sequence[Algorithm],
+    models: Sequence[Any],
+) -> bytes:
+    """One blob for the whole engine instance (all algorithms)."""
+    entries: list[tuple[str, Any]] = []
+    for i, (algo, model) in enumerate(zip(algorithms, models)):
+        mode = algo.persistence_mode
+        if mode == PersistenceMode.AUTO:
+            entries.append(
+                ("auto", to_host(algo.prepare_model_for_host(model)))
+            )
+        elif mode == PersistenceMode.MANUAL:
+            algo.save_model(instance_id, model)
+            entries.append(("manifest", type(algo).__qualname__))
+        else:
+            entries.append(("retrain", None))
+        logger.debug(
+            "model[%d] (%s): persistence=%s", i, type(algo).__name__, mode
+        )
+    buf = io.BytesIO()
+    pickle.dump(
+        {"version": _FORMAT_VERSION, "entries": entries},
+        buf,
+        protocol=pickle.HIGHEST_PROTOCOL,
+    )
+    return buf.getvalue()
+
+
+def deserialize_models(blob: bytes) -> list[tuple[str, Any]]:
+    """→ [(mode_tag, payload)] in algorithm order."""
+    payload = pickle.loads(blob)
+    if payload.get("version") != _FORMAT_VERSION:
+        raise ValueError(
+            f"unsupported model blob version {payload.get('version')}"
+        )
+    return payload["entries"]

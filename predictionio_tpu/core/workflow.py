@@ -1,0 +1,177 @@
+"""Workflow runtime — train/deploy orchestration + instance bookkeeping.
+
+Capability parity with the reference's ``workflow`` package:
+``CoreWorkflow.runTrain`` (workflow/CoreWorkflow.scala:42-98) and the
+deploy-side model recovery in ``CreateServer.createServerActorWithEngine``
+(workflow/CreateServer.scala:204-263). The spark-submit process boundary
+disappears: the CLI calls these functions in-process (multi-host runs
+start one such process per TPU host via
+:mod:`predictionio_tpu.parallel.distributed`).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import logging
+from typing import Any, Sequence
+
+from predictionio_tpu.core.controller import PersistenceMode
+from predictionio_tpu.core.engine import (
+    Engine,
+    EngineParams,
+    StopAfterPrepareInterruption,
+    StopAfterReadInterruption,
+    WorkflowParams,
+)
+from predictionio_tpu.core.persistence import (
+    deserialize_models,
+    serialize_models,
+)
+from predictionio_tpu.data.storage import (
+    EngineInstance,
+    Model,
+    Storage,
+    get_storage,
+)
+from predictionio_tpu.parallel.mesh import ComputeContext
+
+logger = logging.getLogger(__name__)
+
+
+def _now() -> _dt.datetime:
+    return _dt.datetime.now(_dt.timezone.utc)
+
+
+def run_train(
+    engine: Engine,
+    params: EngineParams,
+    engine_id: str,
+    engine_version: str = "1",
+    engine_variant: str = "default",
+    engine_factory: str = "",
+    workflow: WorkflowParams | None = None,
+    ctx: ComputeContext | None = None,
+    storage: Storage | None = None,
+) -> str:
+    """Train + persist; returns the EngineInstance id.
+
+    Lifecycle mirrors the reference (INIT on entry; COMPLETED only after
+    models are persisted, so deploy's ``getLatestCompleted`` never picks
+    a half-written run; FAILED on error)."""
+    workflow = workflow or WorkflowParams()
+    storage = storage or get_storage()
+    instances = storage.get_meta_data_engine_instances()
+    instance = EngineInstance(
+        id="",
+        status="INIT",
+        start_time=_now(),
+        end_time=_now(),
+        engine_id=engine_id,
+        engine_version=engine_version,
+        engine_variant=engine_variant,
+        engine_factory=engine_factory,
+        batch=workflow.batch,
+    )
+    instance_id = instances.insert(instance)
+    instance = instances.get(instance_id)
+    ctx = ctx or ComputeContext.create(batch=workflow.batch or engine_id)
+    try:
+        # build algorithm instances once: the SAME objects train and (for
+        # MANUAL persistence) save, so trained state is what gets saved
+        algorithms = engine.make_algorithms(params)
+        models = engine.train(ctx, params, workflow, algorithms=algorithms)
+        if workflow.save_model:
+            blob = serialize_models(instance_id, algorithms, models)
+            storage.get_model_data_models().insert(
+                Model(id=instance_id, models=blob)
+            )
+            logger.info(
+                "persisted %d model(s) for instance %s (%d bytes)",
+                len(models),
+                instance_id,
+                len(blob),
+            )
+        instances.update(
+            EngineInstance(
+                **{
+                    **instance.__dict__,
+                    "status": "COMPLETED",
+                    "end_time": _now(),
+                }
+            )
+        )
+        return instance_id
+    except (StopAfterReadInterruption, StopAfterPrepareInterruption):
+        instances.update(
+            EngineInstance(
+                **{
+                    **instance.__dict__,
+                    "status": "INTERRUPTED",
+                    "end_time": _now(),
+                }
+            )
+        )
+        raise
+    except Exception:
+        instances.update(
+            EngineInstance(
+                **{
+                    **instance.__dict__,
+                    "status": "FAILED",
+                    "end_time": _now(),
+                }
+            )
+        )
+        raise
+
+
+def load_deployment(
+    engine: Engine,
+    params: EngineParams,
+    engine_id: str,
+    engine_version: str = "1",
+    engine_variant: str = "default",
+    instance_id: str | None = None,
+    ctx: ComputeContext | None = None,
+    storage: Storage | None = None,
+):
+    """Recover (algorithms, models, serving) for serving.
+
+    ``instance_id=None`` picks the latest COMPLETED instance (the
+    reference deploy path, Console.scala:844-879 →
+    CreateServer.scala:204-263)."""
+    storage = storage or get_storage()
+    instances = storage.get_meta_data_engine_instances()
+    if instance_id is None:
+        instance = instances.get_latest_completed(
+            engine_id, engine_version, engine_variant
+        )
+        if instance is None:
+            raise RuntimeError(
+                f"No COMPLETED engine instance for {engine_id} "
+                f"{engine_version} {engine_variant}; run train first."
+            )
+    else:
+        instance = instances.get(instance_id)
+        if instance is None:
+            raise RuntimeError(f"engine instance {instance_id} not found")
+    ctx = ctx or ComputeContext.create(batch=f"serving:{engine_id}")
+
+    algorithms = engine.make_algorithms(params)
+    needs_blob = any(
+        a.persistence_mode == PersistenceMode.AUTO for a in algorithms
+    )
+    stored: Sequence[Any]
+    if needs_blob:
+        record = storage.get_model_data_models().get(instance.id)
+        if record is None:
+            raise RuntimeError(
+                f"model blob for instance {instance.id} missing"
+            )
+        stored = [payload for _tag, payload in deserialize_models(record.models)]
+    else:
+        stored = [None] * len(algorithms)
+    algorithms, models, serving = engine.prepare_deploy(
+        ctx, params, instance.id, stored
+    )
+    return instance, algorithms, models, serving

@@ -1,0 +1,32 @@
+"""Engine templates — the workloads (reference ``examples/`` §2.8).
+
+Importing this package registers every built-in template in the engine
+registry (the discovery hook used by the CLI and servers).
+"""
+
+_TEMPLATES = []
+
+try:  # populated as templates land
+    from predictionio_tpu.models import classification  # noqa: F401
+
+    _TEMPLATES.append("classification")
+except ImportError:  # pragma: no cover
+    pass
+try:
+    from predictionio_tpu.models import recommendation  # noqa: F401
+
+    _TEMPLATES.append("recommendation")
+except ImportError:  # pragma: no cover
+    pass
+try:
+    from predictionio_tpu.models import similarproduct  # noqa: F401
+
+    _TEMPLATES.append("similarproduct")
+except ImportError:  # pragma: no cover
+    pass
+try:
+    from predictionio_tpu.models import ecommerce  # noqa: F401
+
+    _TEMPLATES.append("ecommerce")
+except ImportError:  # pragma: no cover
+    pass

@@ -1,0 +1,219 @@
+"""Classification template — Naive Bayes over entity properties.
+
+Capability parity with the reference
+``examples/scala-parallel-classification`` (MLlib ``NaiveBayes.train``,
+add-algorithm/src/main/scala/NaiveBayesAlgorithm.scala:15-28;
+DataSource.scala reads ``$set`` entity properties): entities carry
+numeric attribute properties plus a label property; train fits
+multinomial NB; queries ``{"features": [...]}`` answer
+``{"label": ..., "scores": {...}}``.
+
+TPU path: the Preparator stages feature/label arrays padded + sharded
+over the mesh data axis; training is a single jitted matmul-shaped fit
+(:func:`predictionio_tpu.ops.naive_bayes.fit_multinomial`); serving
+dispatches one pre-compiled fixed-shape scoring program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.core import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    Params,
+    Preparator,
+    register_engine,
+)
+from predictionio_tpu.core.controller import SanityCheck
+from predictionio_tpu.data.store import EventStore
+from predictionio_tpu.ops import naive_bayes as nb
+from predictionio_tpu.parallel.mesh import ComputeContext, pad_to_multiple
+from predictionio_tpu.utils.bimap import BiMap
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class ClassificationDataSourceParams(Params):
+    app_name: str = "MyApp"
+    entity_type: str = "user"
+    attributes: tuple[str, ...] = ("attr0", "attr1", "attr2")
+    label: str = "plan"
+    eval_k: int = 0  # >0 enables k-fold read_eval
+
+
+@dataclasses.dataclass
+class ClassificationTrainingData(SanityCheck):
+    x: np.ndarray            # [n, d] float32
+    y: np.ndarray            # [n] int32 label codes
+    label_map: BiMap
+
+    def sanity_check(self) -> None:
+        if len(self.x) == 0:
+            raise ValueError("training data is empty")
+        if not np.isfinite(self.x).all():
+            raise ValueError("training features contain NaN/inf")
+        if (self.x < 0).any():
+            raise ValueError(
+                "multinomial NB requires non-negative features"
+            )
+
+
+class ClassificationDataSource(
+    DataSource[ClassificationTrainingData, dict, dict, str]
+):
+    params_class = ClassificationDataSourceParams
+
+    def _read(self) -> ClassificationTrainingData:
+        p = self.params
+        props = EventStore().aggregate_properties(
+            p.app_name,
+            entity_type=p.entity_type,
+            required=list(p.attributes) + [p.label],
+        )
+        rows, labels = [], []
+        for _eid, pm in props.items():
+            rows.append([pm.get_float(a) for a in p.attributes])
+            labels.append(str(pm.get_required(p.label)))
+        label_map, y = BiMap.string_int_with_codes(
+            np.asarray(labels, dtype=np.str_)
+        ) if labels else (BiMap(np.asarray([], dtype=np.str_)),
+                          np.zeros(0, np.int32))
+        return ClassificationTrainingData(
+            x=np.asarray(rows, dtype=np.float32).reshape(
+                len(rows), len(p.attributes)
+            ),
+            y=y,
+            label_map=label_map,
+        )
+
+    def read_training(self, ctx: ComputeContext) -> ClassificationTrainingData:
+        return self._read()
+
+    def read_eval(self, ctx: ComputeContext):
+        """k-fold split by index (reference e2 CrossValidation.splitData,
+        e2/.../evaluation/CrossValidation.scala:33-63)."""
+        k = self.params.eval_k
+        if k <= 1:
+            raise ValueError("eval_k must be >= 2 for evaluation")
+        full = self._read()
+        folds = []
+        idx = np.arange(len(full.x))
+        for fold in range(k):
+            test = idx % k == fold
+            td = ClassificationTrainingData(
+                x=full.x[~test], y=full.y[~test], label_map=full.label_map
+            )
+            qa = [
+                (
+                    {"features": full.x[i].tolist()},
+                    full.label_map.inverse(int(full.y[i])),
+                )
+                for i in idx[test]
+            ]
+            folds.append((td, {"fold": fold}, qa))
+        return folds
+
+
+@dataclasses.dataclass
+class PreparedClassificationData:
+    x: jax.Array      # [n_pad, d] data-sharded
+    y: jax.Array      # [n_pad]
+    mask: jax.Array   # [n_pad] 1.0 real / 0.0 padding
+    label_map: BiMap
+    n_classes: int
+
+
+class ClassificationPreparator(
+    Preparator[ClassificationTrainingData, PreparedClassificationData]
+):
+    """Fixed-shape boundary: pad rows to the data-axis multiple and place
+    on the mesh (SURVEY.md §7 hard-part (a))."""
+
+    def prepare(
+        self, ctx: ComputeContext, td: ClassificationTrainingData
+    ) -> PreparedClassificationData:
+        n = len(td.x)
+        mult = ctx.data_parallelism
+        mask = pad_to_multiple(np.ones(n, np.float32), mult)
+        return PreparedClassificationData(
+            x=ctx.shard_rows(td.x),
+            y=ctx.shard_rows(td.y),
+            mask=jax.device_put(mask, ctx.data_sharded),
+            label_map=td.label_map,
+            n_classes=max(len(td.label_map), 1),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class NaiveBayesParams(Params):
+    lambda_: float = 1.0
+
+
+@dataclasses.dataclass
+class NaiveBayesModel:
+    nb: nb.MultinomialNBModel
+    label_map: BiMap
+
+
+class NaiveBayesAlgorithm(
+    Algorithm[PreparedClassificationData, NaiveBayesModel, dict, dict]
+):
+    """Reference NaiveBayesAlgorithm.scala:15-28 (MLlib NB, lambda)."""
+
+    params_class = NaiveBayesParams
+
+    def train(
+        self, ctx: ComputeContext, pd: PreparedClassificationData
+    ) -> NaiveBayesModel:
+        model = nb.fit_multinomial(
+            pd.x,
+            pd.y,
+            n_classes=pd.n_classes,
+            alpha=self.params.lambda_,
+            mask=pd.mask,
+        )
+        return NaiveBayesModel(nb=model, label_map=pd.label_map)
+
+    def predict(self, model: NaiveBayesModel, query: dict) -> dict:
+        x = jnp.asarray(
+            [query["features"]], dtype=model.nb.theta.dtype
+        )
+        scores = nb.log_scores(model.nb, x)[0]
+        best = int(jnp.argmax(scores))
+        return {
+            "label": model.label_map.inverse(best),
+            "scores": {
+                model.label_map.inverse(c): float(scores[c])
+                for c in range(model.nb.n_classes)
+            },
+        }
+
+    def batch_predict(self, model: NaiveBayesModel, queries) -> list[dict]:
+        x = jnp.asarray(
+            [q["features"] for q in queries], dtype=model.nb.theta.dtype
+        )
+        best = np.asarray(nb.predict_classes(model.nb, x))
+        return [
+            {"label": model.label_map.inverse(int(b))} for b in best
+        ]
+
+
+def classification_engine() -> Engine:
+    return Engine(
+        ClassificationDataSource,
+        ClassificationPreparator,
+        {"naive": NaiveBayesAlgorithm},
+        FirstServing,
+    )
+
+
+register_engine("classification", classification_engine)

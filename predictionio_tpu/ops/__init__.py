@@ -1,0 +1,8 @@
+"""Numeric kernels — the MLlib replacement.
+
+Every algorithm the reference delegates to Spark MLlib (SURVEY.md §2.9:
+``ALS.trainImplicit``, ``NaiveBayes.train``, ``CoordinateMatrix`` cosine)
+is re-implemented here as JAX programs designed for the MXU: dense
+batched linear algebra under ``jax.jit`` with explicit shardings, no
+data-dependent Python control flow, fixed shapes at every jit boundary.
+"""

@@ -1,0 +1,49 @@
+"""Scoring / similarity kernels for serving.
+
+Replaces the reference's per-query RDD predict (ALSAlgorithm.predict:
+``productFeatures.lookup`` + cosine ``collect`` — a Spark job per query,
+the serving anti-pattern SURVEY.md §3.2 flags) with pre-compiled dense
+scoring: one [B, k] × [k, I] matmul + ``lax.top_k``. The same kernels
+serve the recommendation template (dot-product scores) and the
+similar-product template (cosine over item factors,
+examples/scala-parallel-similarproduct/multi/.../ALSAlgorithm.scala).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def l2_normalize(x: jax.Array, eps: float = 1e-9) -> jax.Array:
+    return x / (jnp.linalg.norm(x, axis=-1, keepdims=True) + eps)
+
+
+@partial(jax.jit, static_argnames=("num",))
+def top_k_dot(
+    queries: jax.Array,      # [B, k]
+    items: jax.Array,        # [I, k]
+    num: int,
+    mask: jax.Array | None = None,  # [B, I] True = exclude
+) -> tuple[jax.Array, jax.Array]:
+    """Top-``num`` items by dot product. Returns (scores, indices) [B, num]."""
+    scores = queries @ items.T  # [B, I] — MXU
+    if mask is not None:
+        scores = jnp.where(mask, -jnp.inf, scores)
+    return jax.lax.top_k(scores, num)
+
+
+@partial(jax.jit, static_argnames=("num",))
+def top_k_cosine(
+    queries: jax.Array,
+    items: jax.Array,
+    num: int,
+    mask: jax.Array | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Top-``num`` by cosine similarity (similar-product scoring)."""
+    return top_k_dot(
+        l2_normalize(queries), l2_normalize(items), num, mask
+    )

@@ -1,0 +1,23 @@
+"""Parallel substrate: device mesh, sharding helpers, collectives, multi-host.
+
+This package replaces the reference's L3 compute backend (Apache Spark:
+SparkContext + RDD + shuffle/broadcast, SURVEY.md §1 L3, §2.9) with the
+JAX equivalents: an explicit :class:`ComputeContext` wrapping a
+``jax.sharding.Mesh``, NamedSharding annotations instead of RDD
+partitioning, and XLA collectives (psum / all_gather / reduce_scatter over
+ICI) instead of Netty shuffles.
+"""
+
+from predictionio_tpu.parallel.mesh import (
+    ComputeContext,
+    DATA_AXIS,
+    MODEL_AXIS,
+    pad_to_multiple,
+)
+
+__all__ = [
+    "ComputeContext",
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "pad_to_multiple",
+]

@@ -1,0 +1,66 @@
+"""Multi-host initialization — the spark-submit boundary, TPU-style.
+
+The reference reaches a cluster by shelling out to ``spark-submit``
+(tools/Runner.scala:92-210) with ``PIO_*`` env forwarded. The TPU-native
+equivalent (SURVEY.md §2.9, §5) is one Python process per TPU host, all
+calling :func:`initialize` so XLA collectives span ICI within a slice and
+DCN across slices. The CLI launcher invokes this before building a
+:class:`~predictionio_tpu.parallel.mesh.ComputeContext`, which then sees
+the global device set.
+
+Env contract (mirrors the reference's env-var process boundary):
+
+* ``PIO_COORDINATOR_ADDRESS`` — host:port of process 0
+* ``PIO_NUM_PROCESSES`` / ``PIO_PROCESS_ID`` — world size / rank
+
+On single-host runs (or TPU pods, where jax can infer everything from the
+metadata server) all are optional.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Join the multi-host job. No-op when single-process."""
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get(
+        "PIO_COORDINATOR_ADDRESS"
+    )
+    if num_processes is None and "PIO_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["PIO_NUM_PROCESSES"])
+    if process_id is None and "PIO_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["PIO_PROCESS_ID"])
+    if coordinator_address is None and num_processes is None:
+        # single process — nothing to coordinate
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    logger.info(
+        "jax.distributed initialized: process %d/%d, %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        len(jax.devices()),
+    )
+
+
+def is_coordinator() -> bool:
+    return jax.process_index() == 0

@@ -1,0 +1,136 @@
+"""ComputeContext — the SparkContext replacement.
+
+The reference threads a ``SparkContext`` through every controller
+signature and creates it per workflow run (``WorkflowContext.scala:25-44``,
+app name "PredictionIO <Mode>: <batch>"). Here the equivalent carrier is a
+:class:`ComputeContext`: a ``jax.sharding.Mesh`` over the available
+devices plus sharding helpers and host-staging utilities. Controllers
+receive it as their first argument exactly where the reference passes
+``sc``.
+
+Mesh convention (scaling-book style):
+
+* axis ``"data"`` — batch / example / entity-row parallelism (the RDD
+  partition analogue; SURVEY.md §2.9 strategy 1);
+* axis ``"model"`` — feature / factor / vocabulary sharding (the
+  embedding-table tensor-parallel analogue; SURVEY.md §2.9 strategy 2).
+
+Single-chip runs get a 1×1 mesh and every sharding degenerates to
+replicated — the same jitted programs run unchanged from 1 chip to a
+multi-host slice, which is the whole point of GSPMD.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def pad_to_multiple(
+    arr: np.ndarray, multiple: int, axis: int = 0, fill: Any = 0
+) -> np.ndarray:
+    """Pad ``axis`` up to the next multiple — the fixed-shape boundary
+    (SURVEY.md §7 hard-part (a): bucketing/padding at the Preparator)."""
+    size = arr.shape[axis]
+    target = -(-size // multiple) * multiple
+    if target == size:
+        return arr
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, target - size)
+    return np.pad(arr, widths, constant_values=fill)
+
+
+@dataclasses.dataclass
+class ComputeContext:
+    """Mesh + sharding helpers threaded through DASE controllers."""
+
+    mesh: Mesh
+    batch: str = ""  # run label (reference WorkflowContext app name)
+
+    # -- construction -----------------------------------------------------
+    @staticmethod
+    def create(
+        batch: str = "",
+        mesh_shape: Sequence[int] | None = None,
+        axis_names: Sequence[str] = (DATA_AXIS, MODEL_AXIS),
+        devices: Sequence[jax.Device] | None = None,
+    ) -> "ComputeContext":
+        """Build a context over the available devices.
+
+        Default mesh: all devices on the ``data`` axis, ``model`` axis of
+        size 1 — the right default for the framework's workloads, whose
+        first scaling dimension is #entities (SURVEY.md §5). Callers
+        (engine variants) may request e.g. ``mesh_shape=(4, 2)`` for
+        factor-sharded ALS.
+        """
+        devs = list(devices if devices is not None else jax.devices())
+        if mesh_shape is None:
+            mesh_shape = (len(devs),) + (1,) * (len(axis_names) - 1)
+        if int(np.prod(mesh_shape)) != len(devs):
+            raise ValueError(
+                f"mesh_shape {tuple(mesh_shape)} does not cover "
+                f"{len(devs)} devices"
+            )
+        device_grid = np.asarray(devs).reshape(tuple(mesh_shape))
+        mesh = Mesh(device_grid, tuple(axis_names))
+        logger.info(
+            "ComputeContext %r: mesh %s over %d %s device(s)",
+            batch,
+            dict(zip(axis_names, mesh_shape)),
+            len(devs),
+            devs[0].platform,
+        )
+        return ComputeContext(mesh=mesh, batch=batch)
+
+    # -- mesh facts -------------------------------------------------------
+    @property
+    def n_devices(self) -> int:
+        return self.mesh.size
+
+    @property
+    def data_parallelism(self) -> int:
+        return self.mesh.shape.get(DATA_AXIS, 1)
+
+    @property
+    def model_parallelism(self) -> int:
+        return self.mesh.shape.get(MODEL_AXIS, 1)
+
+    # -- sharding helpers -------------------------------------------------
+    def sharding(self, *spec: Any) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    @property
+    def data_sharded(self) -> NamedSharding:
+        """Rows split over the data axis (the RDD-partition analogue)."""
+        return NamedSharding(self.mesh, P(DATA_AXIS))
+
+    @property
+    def model_sharded(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(MODEL_AXIS))
+
+    def shard_rows(self, arr: np.ndarray, fill: Any = 0) -> jax.Array:
+        """Pad rows to the data-axis multiple and place data-sharded."""
+        padded = pad_to_multiple(arr, self.data_parallelism, axis=0, fill=fill)
+        return jax.device_put(padded, self.data_sharded)
+
+    def replicate(self, arr: Any) -> jax.Array:
+        return jax.device_put(arr, self.replicated)
+
+    def stop(self) -> None:
+        """Release compiled-program/array references (reference
+        ``sc.stop()``; jax owns the runtime so this is advisory)."""
+        jax.clear_caches()

@@ -8,12 +8,23 @@ semantics without real hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Override unconditionally: the machine env points JAX_PLATFORMS at the
+# real TPU; tests always run on the virtual 8-device CPU mesh. The env
+# var alone is not enough (the TPU-tunnel plugin stomps it), so also
+# force the platform via jax.config after import.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.device_count() == 8, (
+    f"expected 8 virtual CPU devices, got {jax.devices()}"
+)
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,100 @@
+"""Deterministic fake DASE components — the SampleEngine fixture pattern
+(reference core/src/test/scala/.../controller/SampleEngine.scala:12-472):
+every component's output encodes its inputs and params so pipeline wiring
+is assertable end-to-end, with error-injection flags."""
+
+from __future__ import annotations
+
+import dataclasses
+
+from predictionio_tpu.core import (
+    Algorithm,
+    DataSource,
+    Params,
+    Preparator,
+    Serving,
+)
+from predictionio_tpu.core.controller import SanityCheck
+
+
+@dataclasses.dataclass(frozen=True)
+class FakeParams(Params):
+    id: int = 0
+    error: bool = False
+
+
+@dataclasses.dataclass
+class FakeTD(SanityCheck):
+    id: int
+    error: bool = False
+
+    def sanity_check(self) -> None:
+        if self.error:
+            raise ValueError(f"TD{self.id} sanity check failed")
+
+
+@dataclasses.dataclass
+class FakePD:
+    source_id: int
+    prep_id: int
+
+
+class FakeDataSource(DataSource):
+    params_class = FakeParams
+
+    def read_training(self, ctx):
+        return FakeTD(id=self.params.id, error=self.params.error)
+
+    def read_eval(self, ctx):
+        # two folds; queries are ints, actual = query * 10
+        return [
+            (
+                FakeTD(id=self.params.id),
+                {"fold": k},
+                [(q, q * 10) for q in range(3)],
+            )
+            for k in range(2)
+        ]
+
+
+class FakePreparator(Preparator):
+    params_class = FakeParams
+
+    def prepare(self, ctx, td: FakeTD) -> FakePD:
+        if self.params.error:
+            raise ValueError("preparator error")
+        return FakePD(source_id=td.id, prep_id=self.params.id)
+
+
+@dataclasses.dataclass
+class FakeModel:
+    source_id: int
+    prep_id: int
+    algo_id: int
+
+
+class FakeAlgorithm(Algorithm):
+    params_class = FakeParams
+
+    def train(self, ctx, pd: FakePD) -> FakeModel:
+        if self.params.error:
+            raise ValueError("algo error")
+        return FakeModel(
+            source_id=pd.source_id, prep_id=pd.prep_id, algo_id=self.params.id
+        )
+
+    def predict(self, model: FakeModel, query: int) -> int:
+        # prediction encodes the whole pipeline + the query
+        return (
+            model.source_id * 1000
+            + model.prep_id * 100
+            + model.algo_id * 10
+            + query
+        )
+
+
+class FakeServing(Serving):
+    params_class = FakeParams
+
+    def serve(self, query, predictions):
+        return sum(predictions)

@@ -1,0 +1,218 @@
+"""ALS kernel tests: packing correctness, normal-equation agreement with a
+dense numpy reference, reconstruction quality, multi-device equivalence."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops.als import (
+    ALSFactors,
+    build_padded_csr,
+    train_als,
+)
+from predictionio_tpu.parallel.mesh import ComputeContext
+
+
+@pytest.fixture(scope="module")
+def ctx8():
+    return ComputeContext.create(batch="als-test")
+
+
+@pytest.fixture(scope="module")
+def ctx1():
+    import jax
+
+    return ComputeContext.create(
+        batch="als-1dev", devices=jax.devices()[:1]
+    )
+
+
+class TestPacking:
+    def test_blocks_cover_all_nnz(self):
+        rng = np.random.default_rng(0)
+        n_rows, nnz = 17, 300
+        rows = rng.integers(0, n_rows, nnz).astype(np.int32)
+        cols = rng.integers(0, 50, nnz).astype(np.int32)
+        vals = rng.uniform(0.5, 2.0, nnz).astype(np.float32)
+        csr = build_padded_csr(rows, cols, vals, n_rows, block_len=8)
+        # every nnz appears exactly once with its weight
+        total = csr.weights.sum()
+        np.testing.assert_allclose(total, vals.sum(), rtol=1e-5)
+        # per-row weight sums match
+        for u in range(n_rows):
+            expected = vals[rows == u].sum()
+            got = csr.weights[csr.owner == u].sum()
+            # owner 0 also holds padding blocks with zero weight
+            np.testing.assert_allclose(got, expected, rtol=1e-5)
+
+    def test_heavy_row_spans_blocks(self):
+        rows = np.zeros(100, np.int32)
+        cols = np.arange(100).astype(np.int32)
+        vals = np.ones(100, np.float32)
+        csr = build_padded_csr(rows, cols, vals, 1, block_len=16)
+        assert (csr.owner == 0).all()
+        assert csr.n_blocks == 7  # ceil(100/16)
+        assert csr.weights.sum() == 100
+
+    def test_padding_multiples(self):
+        rows = np.asarray([0, 1, 2], np.int32)
+        cols = np.asarray([0, 1, 2], np.int32)
+        vals = np.ones(3, np.float32)
+        csr = build_padded_csr(
+            rows, cols, vals, 3, block_len=4, row_multiple=8,
+            block_multiple=16,
+        )
+        assert csr.n_rows_padded == 8
+        assert csr.idx.shape[0] == 16
+
+
+def _dense_implicit_reference(r, x_init, n_iters, rank, lam, alpha):
+    """Textbook dense implicit ALS for cross-checking."""
+    n_u, n_i = r.shape
+    rng = np.random.default_rng(13)
+    y = x_init.copy()
+    x = np.zeros((n_u, rank), np.float64)
+
+    def solve_side(r_mat, y_):
+        yty = y_.T @ y_
+        out = np.zeros((r_mat.shape[0], rank))
+        for u in range(r_mat.shape[0]):
+            cu = alpha * r_mat[u]
+            a = yty + (y_.T * cu) @ y_ + lam * np.eye(rank)
+            b = y_.T @ ((1 + cu) * (r_mat[u] > 0))
+            out[u] = np.linalg.solve(a, b)
+        return out
+
+    for _ in range(n_iters):
+        x = solve_side(r, y)
+        y = solve_side(r.T, x)
+    return x, y
+
+
+class TestSolveCorrectness:
+    def test_matches_dense_reference(self, ctx8):
+        """One deterministic seed: our mesh solve must match the dense
+        numpy implicit-ALS reference iteration-for-iteration."""
+        rng = np.random.default_rng(7)
+        n_u, n_i, rank = 12, 9, 4
+        r = np.zeros((n_u, n_i), np.float32)
+        nnz_mask = rng.uniform(size=(n_u, n_i)) < 0.4
+        r[nnz_mask] = rng.integers(1, 5, nnz_mask.sum())
+        rows, cols = np.nonzero(r)
+        vals = r[rows, cols]
+
+        factors = train_als(
+            ctx8,
+            rows.astype(np.int32),
+            cols.astype(np.int32),
+            vals.astype(np.float32),
+            n_users=n_u,
+            n_items=n_i,
+            rank=rank,
+            iterations=3,
+            reg=0.1,
+            alpha=2.0,
+            implicit=True,
+            block_len=4,
+            row_chunk=2,
+        )
+        # replicate the same init the device code uses (logical size)
+        import jax
+
+        key = jax.random.PRNGKey(13)
+        y0 = np.asarray(
+            jax.random.normal(key, (n_i, rank), np.float32)
+            / np.sqrt(rank)
+        ).astype(np.float64)
+        x_ref, y_ref = _dense_implicit_reference(r, y0, 3, rank, 0.1, 2.0)
+        np.testing.assert_allclose(
+            factors.user_factors, x_ref, rtol=2e-3, atol=2e-4
+        )
+        np.testing.assert_allclose(
+            factors.item_factors, y_ref, rtol=2e-3, atol=2e-4
+        )
+
+    def test_reconstruction_quality_implicit(self, ctx8):
+        """Low-rank planted structure: observed entries should score far
+        above unobserved ones."""
+        rng = np.random.default_rng(3)
+        n_u, n_i, rank = 40, 30, 8
+        # two user groups × two item groups
+        r = np.zeros((n_u, n_i), np.float32)
+        r[:20, :15] = rng.integers(1, 4, (20, 15))
+        r[20:, 15:] = rng.integers(1, 4, (20, 15))
+        rows, cols = np.nonzero(r)
+        factors = train_als(
+            ctx8,
+            rows.astype(np.int32),
+            cols.astype(np.int32),
+            r[rows, cols],
+            n_users=n_u,
+            n_items=n_i,
+            rank=rank,
+            iterations=8,
+            reg=0.05,
+            alpha=4.0,
+            block_len=8,
+            row_chunk=4,
+        )
+        scores = factors.user_factors @ factors.item_factors.T
+        in_block = scores[:20, :15].mean()
+        out_block = scores[:20, 15:].mean()
+        assert in_block > 0.7
+        assert in_block > out_block + 0.5
+
+    def test_explicit_mode_fits_ratings(self, ctx8):
+        rng = np.random.default_rng(5)
+        n_u, n_i, rank = 30, 20, 6
+        true_u = rng.normal(size=(n_u, rank))
+        true_i = rng.normal(size=(n_i, rank))
+        full = true_u @ true_i.T
+        mask = rng.uniform(size=full.shape) < 0.6
+        rows, cols = np.nonzero(mask)
+        vals = full[rows, cols].astype(np.float32)
+        factors = train_als(
+            ctx8,
+            rows.astype(np.int32),
+            cols.astype(np.int32),
+            vals,
+            n_users=n_u,
+            n_items=n_i,
+            rank=rank,
+            iterations=12,
+            reg=0.05,
+            implicit=False,
+            block_len=8,
+            row_chunk=4,
+        )
+        pred = factors.user_factors @ factors.item_factors.T
+        rmse = np.sqrt(((pred[mask] - full[mask]) ** 2).mean())
+        assert rmse < 0.15 * np.abs(full[mask]).std() + 0.1
+
+    def test_single_vs_multi_device_identical(self, ctx8, ctx1):
+        rng = np.random.default_rng(11)
+        nnz = 200
+        rows = rng.integers(0, 16, nnz).astype(np.int32)
+        cols = rng.integers(0, 12, nnz).astype(np.int32)
+        vals = rng.integers(1, 5, nnz).astype(np.float32)
+        kwargs = dict(
+            n_users=16, n_items=12, rank=4, iterations=2, reg=0.1,
+            alpha=1.0, block_len=4, row_chunk=2,
+        )
+        f8 = train_als(ctx8, rows, cols, vals, **kwargs)
+        f1 = train_als(ctx1, rows, cols, vals, **kwargs)
+        np.testing.assert_allclose(
+            f8.user_factors, f1.user_factors, rtol=1e-4, atol=1e-5
+        )
+
+    def test_cold_entities_get_zero_factors(self, ctx8):
+        # user 3 and item 4 never interact
+        rows = np.asarray([0, 1, 2], np.int32)
+        cols = np.asarray([0, 1, 2], np.int32)
+        vals = np.ones(3, np.float32)
+        factors = train_als(
+            ctx8, rows, cols, vals, n_users=4, n_items=5, rank=4,
+            iterations=2, block_len=4, row_chunk=1,
+        )
+        assert isinstance(factors, ALSFactors)
+        np.testing.assert_allclose(factors.user_factors[3], 0.0, atol=1e-6)
+        np.testing.assert_allclose(factors.item_factors[4], 0.0, atol=1e-6)

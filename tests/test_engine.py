@@ -1,0 +1,159 @@
+"""Engine pipeline tests with fake DASE components
+(reference EngineTest / EngineWorkflowTest pattern)."""
+
+import pytest
+
+from fake_engine import (
+    FakeAlgorithm,
+    FakeDataSource,
+    FakeParams,
+    FakePreparator,
+    FakeServing,
+)
+from predictionio_tpu.core import Engine, EngineParams, FirstServing
+from predictionio_tpu.core.controller import ParamsError, params_from_json
+from predictionio_tpu.core.engine import (
+    StopAfterPrepareInterruption,
+    StopAfterReadInterruption,
+    WorkflowParams,
+)
+from predictionio_tpu.parallel.mesh import ComputeContext
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return ComputeContext.create(batch="test")
+
+
+def _engine():
+    return Engine(
+        FakeDataSource, FakePreparator, FakeAlgorithm, FakeServing
+    )
+
+
+def _params(ds=1, prep=2, algos=((3,), (4,)), error_td=False):
+    return EngineParams(
+        data_source=("", FakeParams(id=ds, error=error_td)),
+        preparator=("", FakeParams(id=prep)),
+        algorithms=[("", FakeParams(id=a)) for (a,) in algos],
+        serving=("", FakeParams()),
+    )
+
+
+class TestTrain:
+    def test_pipeline_wiring(self, ctx):
+        models = _engine().train(ctx, _params())
+        assert [(m.source_id, m.prep_id, m.algo_id) for m in models] == [
+            (1, 2, 3),
+            (1, 2, 4),
+        ]
+
+    def test_sanity_check_enforced_and_skippable(self, ctx):
+        engine = _engine()
+        with pytest.raises(ValueError, match="sanity check failed"):
+            engine.train(ctx, _params(error_td=True))
+        models = engine.train(
+            ctx,
+            _params(error_td=True),
+            WorkflowParams(skip_sanity_check=True),
+        )
+        assert len(models) == 2
+
+    def test_stop_after_read_and_prepare(self, ctx):
+        engine = _engine()
+        with pytest.raises(StopAfterReadInterruption):
+            engine.train(ctx, _params(), WorkflowParams(stop_after_read=True))
+        with pytest.raises(StopAfterPrepareInterruption):
+            engine.train(
+                ctx, _params(), WorkflowParams(stop_after_prepare=True)
+            )
+
+    def test_unknown_component_name(self, ctx):
+        with pytest.raises(ParamsError, match="unknown algorithm"):
+            _engine().train(
+                ctx,
+                EngineParams(algorithms=[("nope", FakeParams())]),
+            )
+
+
+class TestEval:
+    def test_multi_algo_serving_join(self, ctx):
+        results = _engine().eval(ctx, _params())
+        assert len(results) == 2  # two folds
+        eval_info, qpa = results[0]
+        assert eval_info == {"fold": 0}
+        # serving sums the two algo predictions:
+        # algo3: 1000+200+30+q ; algo4: 1000+200+40+q  → sum = 2470+2q
+        for q, p, a in qpa:
+            assert p == 2470 + 2 * q
+            assert a == q * 10
+
+    def test_first_serving(self, ctx):
+        engine = Engine(
+            FakeDataSource, FakePreparator, FakeAlgorithm, FirstServing
+        )
+        params = EngineParams(
+            data_source=("", FakeParams(id=1)),
+            preparator=("", FakeParams(id=2)),
+            algorithms=[("", FakeParams(id=3)), ("", FakeParams(id=4))],
+        )
+        _, qpa = engine.eval(ctx, params)[0]
+        q, p, a = qpa[1]
+        assert p == 1000 + 200 + 30 + 1  # first algorithm wins
+
+
+class TestVariantJson:
+    def test_params_from_variant(self):
+        engine = Engine(
+            {"ds": FakeDataSource},
+            {"prep": FakePreparator},
+            {"a": FakeAlgorithm, "b": FakeAlgorithm},
+            {"s": FakeServing},
+        )
+        variant = {
+            "datasource": {"name": "ds", "params": {"id": 7}},
+            "preparator": {"name": "prep", "params": {"id": 8}},
+            "algorithms": [
+                {"name": "a", "params": {"id": 9}},
+                {"name": "b", "params": {"id": 10, "error": True}},
+            ],
+            "serving": {"name": "s"},
+        }
+        ep = engine.params_from_variant(variant)
+        assert ep.data_source[1].id == 7
+        assert ep.preparator[1].id == 8
+        assert [p.id for _, p in ep.algorithms] == [9, 10]
+        assert ep.algorithms[1][1].error is True
+
+    def test_unknown_param_key_rejected(self):
+        with pytest.raises(ParamsError, match="unknown params"):
+            params_from_json(FakeParams, {"id": 1, "typo": 2})
+
+    def test_single_class_empty_name_sugar(self):
+        engine = _engine()
+        ep = engine.params_from_variant({})
+        assert engine.make_data_source(ep) is not None
+
+
+class TestComputeContext:
+    def test_mesh_covers_virtual_devices(self, ctx):
+        assert ctx.n_devices == 8
+        assert ctx.data_parallelism == 8
+        assert ctx.model_parallelism == 1
+
+    def test_custom_mesh_shape(self):
+        c = ComputeContext.create(mesh_shape=(4, 2))
+        assert c.data_parallelism == 4
+        assert c.model_parallelism == 2
+
+    def test_bad_mesh_shape(self):
+        with pytest.raises(ValueError):
+            ComputeContext.create(mesh_shape=(3, 2))
+
+    def test_shard_rows_pads(self, ctx):
+        import numpy as np
+
+        arr = np.arange(10, dtype=np.float32).reshape(10, 1)
+        sharded = ctx.shard_rows(arr)
+        assert sharded.shape == (16, 1)  # padded to multiple of 8
+        assert sharded.sharding.spec[0] == "data"

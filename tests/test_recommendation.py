@@ -1,0 +1,148 @@
+"""Recommendation template end-to-end: events → ALS train → deploy →
+top-k predictions (reference scala-parallel-recommendation quickstart)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core.engine import EngineParams
+from predictionio_tpu.core.workflow import load_deployment, run_train
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import App
+from predictionio_tpu.models.recommendation import (
+    ALSParams,
+    RecDataSourceParams,
+    RecPreparatorParams,
+    recommendation_engine,
+)
+from predictionio_tpu.parallel.mesh import ComputeContext
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return ComputeContext.create(batch="rec-test")
+
+
+def _seed(storage, n_users=24, n_items=16):
+    """Two taste clusters: even users like even items, odd like odd."""
+    apps = storage.get_meta_data_apps()
+    app_id = apps.insert(App(id=0, name="recapp"))
+    events = storage.get_events()
+    events.init(app_id)
+    rng = np.random.default_rng(0)
+    for u in range(n_users):
+        liked = [i for i in range(n_items) if i % 2 == u % 2]
+        for i in rng.choice(liked, size=6, replace=False):
+            events.insert(
+                Event(
+                    event="rate",
+                    entity_type="user",
+                    entity_id=f"u{u}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{i}",
+                    properties=DataMap({"rating": float(rng.integers(3, 6))}),
+                ),
+                app_id,
+            )
+    return app_id
+
+
+def _params(num_iterations=6, eval_k=0):
+    return EngineParams(
+        data_source=(
+            "",
+            RecDataSourceParams(app_name="recapp", eval_k=eval_k),
+        ),
+        preparator=("", RecPreparatorParams(dedupe="sum")),
+        algorithms=[
+            (
+                "als",
+                ALSParams(
+                    rank=8,
+                    num_iterations=num_iterations,
+                    lambda_=0.05,
+                    alpha=4.0,
+                    block_len=8,
+                    row_chunk=8,
+                ),
+            )
+        ],
+    )
+
+
+class TestEndToEnd:
+    def test_train_deploy_recommend(self, ctx, memory_storage):
+        _seed(memory_storage)
+        engine = recommendation_engine()
+        run_train(
+            engine, _params(), engine_id="rec", ctx=ctx,
+            storage=memory_storage,
+        )
+        _, algorithms, models, serving = load_deployment(
+            engine, _params(), engine_id="rec", ctx=ctx,
+            storage=memory_storage,
+        )
+        algo, model = algorithms[0], models[0]
+        result = serving.serve(
+            {"user": "u0", "num": 5},
+            [algo.predict(model, {"user": "u0", "num": 5})],
+        )
+        assert len(result["itemScores"]) == 5
+        # u0 (even cluster) should be recommended mostly even items
+        even = sum(
+            1
+            for s in result["itemScores"]
+            if int(s["item"][1:]) % 2 == 0
+        )
+        assert even >= 4
+        scores = [s["score"] for s in result["itemScores"]]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_unknown_user_empty(self, ctx, memory_storage):
+        _seed(memory_storage)
+        engine = recommendation_engine()
+        run_train(
+            engine, _params(), engine_id="rec", ctx=ctx,
+            storage=memory_storage,
+        )
+        _, algorithms, models, _ = load_deployment(
+            engine, _params(), engine_id="rec", ctx=ctx,
+            storage=memory_storage,
+        )
+        assert algorithms[0].predict(
+            models[0], {"user": "nobody", "num": 3}
+        ) == {"itemScores": []}
+
+    def test_batch_predict_mixed_nums(self, ctx, memory_storage):
+        _seed(memory_storage)
+        engine = recommendation_engine()
+        run_train(
+            engine, _params(), engine_id="rec", ctx=ctx,
+            storage=memory_storage,
+        )
+        _, algorithms, models, _ = load_deployment(
+            engine, _params(), engine_id="rec", ctx=ctx,
+            storage=memory_storage,
+        )
+        out = algorithms[0].batch_predict(
+            models[0],
+            [
+                {"user": "u1", "num": 2},
+                {"user": "u2", "num": 7},
+            ],
+        )
+        assert len(out[0]["itemScores"]) == 2
+        assert len(out[1]["itemScores"]) == 7
+
+    def test_eval_ranking(self, ctx, memory_storage):
+        """Held-out items should rank well (precision proxy)."""
+        _seed(memory_storage)
+        engine = recommendation_engine()
+        results = engine.eval(ctx, _params(eval_k=3))
+        hits = total = 0
+        for _info, qpa in results:
+            for _q, p, actual in qpa:
+                recommended = {s["item"] for s in p["itemScores"]}
+                hits += len(recommended & set(actual))
+                total += len(actual)
+        assert total > 0
+        assert hits / total > 0.5

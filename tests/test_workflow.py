@@ -1,0 +1,224 @@
+"""Workflow runtime tests: instance lifecycle, persistence round-trip,
+deploy recovery (reference CoreWorkflow + prepareDeploy behavior)."""
+
+import dataclasses
+
+import pytest
+
+from fake_engine import (
+    FakeAlgorithm,
+    FakeDataSource,
+    FakeModel,
+    FakeParams,
+    FakePreparator,
+    FakeServing,
+)
+from predictionio_tpu.core import Engine, EngineParams, PersistenceMode
+from predictionio_tpu.core.engine import WorkflowParams
+from predictionio_tpu.core.workflow import load_deployment, run_train
+from predictionio_tpu.parallel.mesh import ComputeContext
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return ComputeContext.create(batch="wf-test")
+
+
+def _engine(algo_cls=FakeAlgorithm):
+    return Engine(FakeDataSource, FakePreparator, algo_cls, FakeServing)
+
+
+def _params(error=False):
+    return EngineParams(
+        data_source=("", FakeParams(id=1, error=error)),
+        preparator=("", FakeParams(id=2)),
+        algorithms=[("", FakeParams(id=3))],
+        serving=("", FakeParams()),
+    )
+
+
+class TestRunTrain:
+    def test_completed_lifecycle_and_model_blob(self, ctx, memory_storage):
+        iid = run_train(
+            _engine(),
+            _params(),
+            engine_id="fake",
+            ctx=ctx,
+            storage=memory_storage,
+        )
+        inst = memory_storage.get_meta_data_engine_instances().get(iid)
+        assert inst.status == "COMPLETED"
+        assert memory_storage.get_model_data_models().get(iid) is not None
+
+    def test_failed_lifecycle(self, ctx, memory_storage):
+        with pytest.raises(ValueError):
+            run_train(
+                _engine(),
+                _params(error=True),
+                engine_id="fake",
+                ctx=ctx,
+                storage=memory_storage,
+            )
+        insts = memory_storage.get_meta_data_engine_instances().get_all()
+        assert [i.status for i in insts] == ["FAILED"]
+
+    def test_save_model_false_skips_blob(self, ctx, memory_storage):
+        iid = run_train(
+            _engine(),
+            _params(),
+            engine_id="fake",
+            workflow=WorkflowParams(save_model=False),
+            ctx=ctx,
+            storage=memory_storage,
+        )
+        assert memory_storage.get_model_data_models().get(iid) is None
+
+
+class TestDeploy:
+    def test_auto_persistence_roundtrip(self, ctx, memory_storage):
+        run_train(
+            _engine(),
+            _params(),
+            engine_id="fake",
+            ctx=ctx,
+            storage=memory_storage,
+        )
+        instance, algorithms, models, serving = load_deployment(
+            _engine(),
+            _params(),
+            engine_id="fake",
+            ctx=ctx,
+            storage=memory_storage,
+        )
+        assert instance.status == "COMPLETED"
+        assert models[0] == FakeModel(source_id=1, prep_id=2, algo_id=3)
+        # end-to-end predict through recovered model
+        p = algorithms[0].predict(models[0], 5)
+        assert p == 1000 + 200 + 30 + 5
+
+    def test_latest_completed_picked(self, ctx, memory_storage):
+        run_train(
+            _engine(), _params(), engine_id="fake", ctx=ctx,
+            storage=memory_storage,
+        )
+        second = run_train(
+            _engine(), _params(), engine_id="fake", ctx=ctx,
+            storage=memory_storage,
+        )
+        instance, *_ = load_deployment(
+            _engine(), _params(), engine_id="fake", ctx=ctx,
+            storage=memory_storage,
+        )
+        assert instance.id == second
+
+    def test_no_completed_instance_raises(self, ctx, memory_storage):
+        with pytest.raises(RuntimeError, match="No COMPLETED"):
+            load_deployment(
+                _engine(), _params(), engine_id="fake", ctx=ctx,
+                storage=memory_storage,
+            )
+
+    def test_retrain_persistence(self, ctx, memory_storage):
+        class RetrainAlgo(FakeAlgorithm):
+            persistence_mode = PersistenceMode.RETRAIN
+
+        engine = _engine(RetrainAlgo)
+        iid = run_train(
+            engine, _params(), engine_id="fake", ctx=ctx,
+            storage=memory_storage,
+        )
+        blob = memory_storage.get_model_data_models().get(iid)
+        assert blob is not None  # blob exists but holds a retrain marker
+        _, algorithms, models, _ = load_deployment(
+            engine, _params(), engine_id="fake", ctx=ctx,
+            storage=memory_storage,
+        )
+        assert models[0].algo_id == 3  # re-trained at deploy time
+
+    def test_manual_persistence(self, ctx, memory_storage, tmp_path):
+        saved = {}
+
+        class ManualAlgo(FakeAlgorithm):
+            persistence_mode = PersistenceMode.MANUAL
+
+            def save_model(self, instance_id, model):
+                saved[instance_id] = dataclasses.asdict(model)
+
+            def load_model(self, instance_id, ctx):
+                return FakeModel(**saved[instance_id])
+
+        engine = _engine(ManualAlgo)
+        iid = run_train(
+            engine, _params(), engine_id="fake", ctx=ctx,
+            storage=memory_storage,
+        )
+        assert iid in saved
+        _, _, models, _ = load_deployment(
+            engine, _params(), engine_id="fake", ctx=ctx,
+            storage=memory_storage,
+        )
+        assert models[0] == FakeModel(source_id=1, prep_id=2, algo_id=3)
+
+
+class TestPersistenceHelpers:
+    def test_jax_arrays_staged_to_host(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from predictionio_tpu.core.persistence import (
+            deserialize_models,
+            serialize_models,
+            to_host,
+        )
+
+        host = to_host({"w": jnp.ones((4, 4)), "meta": "x"})
+        assert isinstance(host["w"], np.ndarray)
+        assert host["meta"] == "x"
+
+        algo = FakeAlgorithm(FakeParams(id=1))
+        blob = serialize_models("i1", [algo], [{"w": jnp.ones(3)}])
+        entries = deserialize_models(blob)
+        assert entries[0][0] == "auto"
+        assert isinstance(entries[0][1]["w"], np.ndarray)
+
+
+class TestReviewRegressions:
+    def test_manual_save_sees_trained_instance(self, ctx, memory_storage):
+        """MANUAL save_model must run on the same instance that trained."""
+        observed = {}
+
+        class StatefulManualAlgo(FakeAlgorithm):
+            persistence_mode = PersistenceMode.MANUAL
+
+            def train(self, ctx, pd):
+                self.trained_state = "ready"
+                return super().train(ctx, pd)
+
+            def save_model(self, instance_id, model):
+                observed["state"] = getattr(self, "trained_state", None)
+
+            def load_model(self, instance_id, ctx):
+                return FakeModel(1, 2, 3)
+
+        run_train(
+            _engine(StatefulManualAlgo), _params(), engine_id="fake",
+            ctx=ctx, storage=memory_storage,
+        )
+        assert observed["state"] == "ready"
+
+    def test_algorithm_count_mismatch_rejected(self, ctx, memory_storage):
+        run_train(
+            _engine(), _params(), engine_id="fake", ctx=ctx,
+            storage=memory_storage,
+        )
+        two_algo_params = EngineParams(
+            data_source=("", FakeParams(id=1)),
+            preparator=("", FakeParams(id=2)),
+            algorithms=[("", FakeParams(id=3)), ("", FakeParams(id=4))],
+            serving=("", FakeParams()),
+        )
+        with pytest.raises(RuntimeError, match="persisted 1 model"):
+            load_deployment(
+                _engine(), two_algo_params, engine_id="fake", ctx=ctx,
+                storage=memory_storage,
+            )
