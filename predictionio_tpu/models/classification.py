@@ -198,6 +198,8 @@ class NaiveBayesAlgorithm(
         }
 
     def batch_predict(self, model: NaiveBayesModel, queries) -> list[dict]:
+        if not queries:
+            return []
         x = jnp.asarray(
             [q["features"] for q in queries], dtype=model.nb.theta.dtype
         )

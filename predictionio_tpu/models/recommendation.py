@@ -194,15 +194,26 @@ class ALSAlgorithm(Algorithm[RecTrainingData, ALSRecModel, dict, dict]):
         return self.batch_predict(model, [query])[0]
 
     def batch_predict(self, model: ALSRecModel, queries) -> list[dict]:
+        if not queries:
+            return []
         num = max(int(q.get("num", 10)) for q in queries)
         num = min(num, len(model.item_factors))
+        # bucket the jit-static shapes (top-k size and batch rows) to
+        # powers of two so arbitrary client input cannot force unbounded
+        # recompiles at serving time
+        num_bucket = min(
+            1 << max(0, (num - 1)).bit_length(), len(model.item_factors)
+        )
         user_idx = np.asarray(
             [model.user_map.get(q.get("user", ""), -1) for q in queries],
             np.int32,
         )
         vecs = model.user_factors[np.clip(user_idx, 0, None)]
+        batch_bucket = 1 << max(0, (len(vecs) - 1)).bit_length()
+        if batch_bucket > len(vecs):
+            vecs = np.pad(vecs, ((0, batch_bucket - len(vecs)), (0, 0)))
         scores, items = similarity.top_k_dot(
-            jnp.asarray(vecs), jnp.asarray(model.item_factors), num
+            jnp.asarray(vecs), jnp.asarray(model.item_factors), num_bucket
         )
         scores = np.asarray(scores)
         items = np.asarray(items)

@@ -46,7 +46,8 @@ class PaddedCSR:
     """Fixed-shape blocked interaction lists for one solve direction."""
 
     idx: np.ndarray      # [R, L] int32 — column ids (0 where padded)
-    weights: np.ndarray  # [R, L] float32 — interaction value (0 = padding)
+    weights: np.ndarray  # [R, L] float32 — interaction value
+    valid: np.ndarray    # [R, L] float32 — 1.0 real nnz / 0.0 padding
     owner: np.ndarray    # [R] int32 — row entity of each block
     n_rows: int          # entity count (unpadded)
     n_rows_padded: int   # entity count padded for the mesh
@@ -88,9 +89,11 @@ def build_padded_csr(
     )
     idx = np.zeros((blocks_padded, block_len), np.int32)
     weights = np.zeros((blocks_padded, block_len), np.float32)
+    valid = np.zeros((blocks_padded, block_len), np.float32)
     owner = np.zeros(blocks_padded, np.int32)
     idx[seg_of_nnz, pos_in_seg] = c
     weights[seg_of_nnz, pos_in_seg] = v
+    valid[seg_of_nnz, pos_in_seg] = 1.0
     owner[:n_blocks] = np.repeat(np.arange(n_rows), nseg)
     # padding blocks carry zero weights → zero contribution; owner 0 is safe
     n_rows_padded = max(
@@ -99,6 +102,7 @@ def build_padded_csr(
     return PaddedCSR(
         idx=idx,
         weights=weights,
+        valid=valid,
         owner=owner,
         n_rows=n_rows,
         n_rows_padded=n_rows_padded,
@@ -111,7 +115,7 @@ def build_padded_csr(
 
 
 def _local_stats(
-    y, idx, weights, owner, n_rows, row_chunk, implicit, alpha,
+    y, idx, weights, valid, owner, n_rows, row_chunk, implicit, alpha,
     axis_name=None,
 ):
     """Scan this shard's blocks, accumulating normal-equation stats."""
@@ -121,15 +125,15 @@ def _local_stats(
 
     def body(carry, chunk):
         a_acc, b_acc, cnt_acc = carry
-        ii, ww, oo = chunk
+        ii, ww, vv, oo = chunk
         yg = y[ii]  # [B, L, k] gather
-        mask = (ww != 0).astype(dtype)
+        mask = vv  # explicit validity: a real 0-valued rating still counts
         if implicit:
-            aw = alpha * ww             # C - I  (zero on padding)
-            bw = mask + alpha * ww      # c * p on observed
+            aw = alpha * ww * mask      # C - I  (zero on padding)
+            bw = mask + alpha * ww * mask  # c * p on observed
         else:
             aw = mask
-            bw = ww
+            bw = ww * mask
         a_part = jnp.einsum(
             "blk,bl,blm->bkm", yg, aw, yg, preferred_element_type=dtype
         )
@@ -151,6 +155,7 @@ def _local_stats(
     chunks = (
         idx.reshape(n_chunks, row_chunk, -1),
         weights.reshape(n_chunks, row_chunk, -1),
+        valid.reshape(n_chunks, row_chunk, -1),
         owner.reshape(n_chunks, row_chunk),
     )
     (a, b, cnt), _ = jax.lax.scan(body, init, chunks)
@@ -178,25 +183,26 @@ def make_solve_side(
 ):
     """Build the jitted one-direction solver for a fixed geometry.
 
-    Returned fn: (y [I,k] replicated, idx [R,L], weights [R,L], owner [R],
-    lam) → x [n_rows_padded, k] replicated. Blocks are sharded over the
-    data axis; each device reduces its partial normal equations, a
-    reduce-scatter splits them by entity, every device Cholesky-solves
-    its slice, and an all-gather rebuilds the factor matrix.
+    Returned fn: (y [I,k] replicated, idx [R,L], weights [R,L],
+    valid [R,L], owner [R], lam) → x [n_rows_padded, k] replicated.
+    Blocks are sharded over the data axis; each device reduces its
+    partial normal equations, a reduce-scatter splits them by entity,
+    every device Cholesky-solves its slice, and an all-gather rebuilds
+    the factor matrix.
     """
     mesh = ctx.mesh
     n_data = ctx.data_parallelism
     if n_rows_padded % n_data:
         raise ValueError("n_rows_padded must divide over the data axis")
 
-    def solve(y, idx, weights, owner, lam):
+    def solve(y, idx, weights, valid, owner, lam):
         k = y.shape[1]
         dtype = y.dtype
 
-        def shard_fn(y_, idx_, weights_, owner_, lam_):
+        def shard_fn(y_, idx_, weights_, valid_, owner_, lam_):
             a, b, cnt = _local_stats(
-                y_, idx_, weights_, owner_, n_rows_padded, row_chunk,
-                implicit, alpha, axis_name=DATA_AXIS,
+                y_, idx_, weights_, valid_, owner_, n_rows_padded,
+                row_chunk, implicit, alpha, axis_name=DATA_AXIS,
             )
             # one reduce-scatter: each device keeps its slice of rows
             a = jax.lax.psum_scatter(a, DATA_AXIS, scatter_dimension=0, tiled=True)
@@ -213,9 +219,12 @@ def make_solve_side(
         x = jax.shard_map(
             shard_fn,
             mesh=mesh,
-            in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
+            in_specs=(
+                P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                P(DATA_AXIS), P(),
+            ),
             out_specs=P(DATA_AXIS),
-        )(y, idx, weights, owner, lam)
+        )(y, idx, weights, valid, owner, lam)
         # replicate for the next gather pass
         return jax.lax.with_sharding_constraint(
             x, jax.NamedSharding(mesh, P())
@@ -291,8 +300,14 @@ def train_als(
     user_factors = None
 
     put = lambda arr: jax.device_put(arr, ctx.data_sharded)  # noqa: E731
-    u_dev = (put(user_csr.idx), put(user_csr.weights), put(user_csr.owner))
-    i_dev = (put(item_csr.idx), put(item_csr.weights), put(item_csr.owner))
+    u_dev = (
+        put(user_csr.idx), put(user_csr.weights), put(user_csr.valid),
+        put(user_csr.owner),
+    )
+    i_dev = (
+        put(item_csr.idx), put(item_csr.weights), put(item_csr.valid),
+        put(item_csr.owner),
+    )
 
     lam = jnp.asarray(reg, dtype)
     for _ in range(iterations):

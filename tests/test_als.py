@@ -216,3 +216,37 @@ class TestSolveCorrectness:
         assert isinstance(factors, ALSFactors)
         np.testing.assert_allclose(factors.user_factors[3], 0.0, atol=1e-6)
         np.testing.assert_allclose(factors.item_factors[4], 0.0, atol=1e-6)
+
+
+class TestReviewRegressions:
+    def test_explicit_zero_rating_counts(self, ctx8):
+        """A real 0-valued rating must contribute to the normal equations
+        (validity mask, not weight!=0)."""
+        # user 0 rates item 0 as 0.0 and item 1 as 4.0
+        rows = np.asarray([0, 0], np.int32)
+        cols = np.asarray([0, 1], np.int32)
+        vals = np.asarray([0.0, 4.0], np.float32)
+        f = train_als(
+            ctx8, rows, cols, vals, n_users=1, n_items=2, rank=2,
+            iterations=4, reg=0.1, implicit=False, block_len=2, row_chunk=1,
+        )
+        pred = f.user_factors @ f.item_factors.T
+        # the observed 0 should be fit near 0, not treated as unobserved
+        assert abs(pred[0, 0]) < 1.0
+        assert pred[0, 1] > 2.0
+
+    def test_empty_batch_predict(self, ctx8, memory_storage):
+        from predictionio_tpu.models.recommendation import (
+            ALSAlgorithm,
+            ALSParams,
+            ALSRecModel,
+        )
+        from predictionio_tpu.utils.bimap import BiMap
+
+        model = ALSRecModel(
+            user_factors=np.ones((2, 4), np.float32),
+            item_factors=np.ones((3, 4), np.float32),
+            user_map=BiMap(["u0", "u1"]),
+            item_map=BiMap(["i0", "i1", "i2"]),
+        )
+        assert ALSAlgorithm(ALSParams()).batch_predict(model, []) == []
