@@ -74,8 +74,14 @@ def run_epoch_bench() -> float:
         ctx, item_csr.n_rows_padded, ROW_CHUNK, True, 1.0
     )
     put = lambda a: jax.device_put(a, ctx.data_sharded)  # noqa: E731
-    u_dev = (put(user_csr.idx), put(user_csr.weights), put(user_csr.owner))
-    i_dev = (put(item_csr.idx), put(item_csr.weights), put(item_csr.owner))
+    u_dev = (
+        put(user_csr.idx), put(user_csr.weights), put(user_csr.valid),
+        put(user_csr.owner),
+    )
+    i_dev = (
+        put(item_csr.idx), put(item_csr.weights), put(item_csr.valid),
+        put(item_csr.owner),
+    )
 
     import jax.numpy as jnp
 
