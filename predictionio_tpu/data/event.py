@@ -107,9 +107,16 @@ class Event:
 
         def _time(key: str) -> _dt.datetime:
             raw = d.get(key)
-            if raw is None:
+            if raw is None or raw == "":
                 return _utcnow()
-            t = _dt.datetime.fromisoformat(str(raw).replace("Z", "+00:00"))
+            try:
+                t = _dt.datetime.fromisoformat(
+                    str(raw).replace("Z", "+00:00")
+                )
+            except ValueError as e:
+                raise EventValidationError(
+                    f"{key} {raw!r} is not an ISO-8601 time: {e}"
+                ) from e
             return t if t.tzinfo else t.replace(tzinfo=_dt.timezone.utc)
 
         return Event(

@@ -1,0 +1,9 @@
+"""HTTP servers (L1): Event Server, Engine Server, dashboard.
+
+Replaces the reference's spray/akka services (``data/.../api/EventServer.scala``,
+``core/.../workflow/CreateServer.scala``) with stdlib threaded HTTP
+servers. The predict hot path dispatches onto pre-compiled jitted
+programs through a micro-batching queue — the design answer to the
+reference's per-query Spark job and its sequential multi-algorithm
+serve loop ("TODO: Parallelize", CreateServer.scala:519).
+"""

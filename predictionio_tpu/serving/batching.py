@@ -1,0 +1,118 @@
+"""Micro-batching queue for serving.
+
+The reference serves one query at a time per request thread and, for
+RDD-backed models, pays a Spark job per query (CreateServer.scala:520,
+SURVEY.md §3.2). The TPU answer is the opposite shape: concurrent
+requests are coalesced into one fixed-shape batch dispatched to a
+pre-compiled jitted program — XLA dispatch overhead amortizes across
+the batch, which is what makes the ≥1k QPS target reachable.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Any, Callable, Sequence
+
+logger = logging.getLogger(__name__)
+
+
+class MicroBatcher:
+    """Coalesce submit()-ed items into batches for ``batch_fn``.
+
+    A batch is dispatched when ``max_batch`` items are waiting or
+    ``max_wait_ms`` elapsed since the first queued item — the classic
+    latency/throughput knob.
+    """
+
+    def __init__(
+        self,
+        batch_fn: Callable[[Sequence[Any]], Sequence[Any]],
+        max_batch: int = 64,
+        max_wait_ms: float = 2.0,
+    ):
+        self._batch_fn = batch_fn
+        self._max_batch = max_batch
+        self._max_wait = max_wait_ms / 1000.0
+        self._queue: queue.Queue = queue.Queue()
+        self._closed = threading.Event()
+        self._submit_lock = threading.Lock()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def submit(self, item: Any) -> Future:
+        # lock orders submit against close(): once the sentinel is queued
+        # no new item can slip in behind it (which would hang its Future)
+        with self._submit_lock:
+            if self._closed.is_set():
+                raise RuntimeError("batcher is closed")
+            future: Future = Future()
+            self._queue.put((item, future))
+            return future
+
+    def __call__(self, item: Any, timeout: float | None = 30.0) -> Any:
+        return self.submit(item).result(timeout=timeout)
+
+    def close(self) -> None:
+        """Graceful: already-submitted items are still processed."""
+        with self._submit_lock:
+            if self._closed.is_set():
+                return
+            self._closed.set()
+            self._queue.put(None)  # wake the worker
+        self._thread.join(timeout=30)
+
+    # -- worker -----------------------------------------------------------
+    def _drain_and_exit(self, batch) -> None:
+        """Sentinel seen: serve everything already queued, then stop."""
+        while True:
+            try:
+                nxt = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if nxt is not None:
+                batch.append(nxt)
+        if batch:
+            self._flush(batch)
+
+    def _loop(self) -> None:
+        import time
+
+        while True:
+            first = self._queue.get()
+            if first is None:
+                self._drain_and_exit([])
+                return
+            batch = [first]
+            deadline = time.monotonic() + self._max_wait
+            while len(batch) < self._max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._drain_and_exit(batch)
+                    return
+                batch.append(nxt)
+            self._flush(batch)
+
+    def _flush(self, batch) -> None:
+        items = [item for item, _f in batch]
+        try:
+            results = self._batch_fn(items)
+            if len(results) != len(items):
+                raise RuntimeError(
+                    f"batch_fn returned {len(results)} results for "
+                    f"{len(items)} items"
+                )
+            for (_item, future), result in zip(batch, results):
+                future.set_result(result)
+        except Exception as e:  # noqa: BLE001 - propagate to every waiter
+            for _item, future in batch:
+                if not future.done():
+                    future.set_exception(e)

@@ -1,0 +1,238 @@
+"""Engine Server — the predict REST service.
+
+Capability parity with the reference's ServerActor/MasterActor
+(core/.../workflow/CreateServer.scala:266-718), default port 8000:
+
+* ``GET  /``             → status (engine info, request count, latencies —
+  the twirl status page's data as JSON)
+* ``POST /queries.json`` → the predict hot path (:495-647): parse query →
+  ``serving.supplement`` → per-algorithm predict → ``serving.serve`` →
+  JSON; optional feedback loop storing a ``predict`` event with a
+  ``prId`` (entity type ``pio_pr``, :539-600); latency bookkeeping
+* ``POST /reload``       → hot-swap to the latest COMPLETED instance
+  (MasterActor :337-363)
+* ``POST /stop``         → undeploy (Console.undeploy posts here, :905-932)
+
+TPU-first difference: queries flow through a
+:class:`~predictionio_tpu.serving.batching.MicroBatcher` per algorithm
+onto pre-compiled batch predict programs instead of per-request model
+code.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import logging
+import secrets
+import threading
+import time
+
+from predictionio_tpu.core.engine import Engine, EngineParams
+from predictionio_tpu.core.workflow import load_deployment
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.parallel.mesh import ComputeContext
+from predictionio_tpu.serving.batching import MicroBatcher
+from predictionio_tpu.serving.http import (
+    HTTPError,
+    HTTPServer,
+    Request,
+    Response,
+    Router,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class EngineServer:
+    def __init__(
+        self,
+        engine: Engine,
+        params: EngineParams,
+        engine_id: str,
+        engine_version: str = "1",
+        engine_variant: str = "default",
+        storage: Storage | None = None,
+        ctx: ComputeContext | None = None,
+        feedback: bool = False,
+        feedback_app_id: int | None = None,
+        max_batch: int = 64,
+        max_wait_ms: float = 2.0,
+    ):
+        self._engine = engine
+        self._params = params
+        self._engine_id = engine_id
+        self._engine_version = engine_version
+        self._engine_variant = engine_variant
+        self._storage = storage or get_storage()
+        self._ctx = ctx or ComputeContext.create(
+            batch=f"serving:{engine_id}"
+        )
+        self._feedback = feedback
+        self._feedback_app_id = feedback_app_id
+        self._max_batch = max_batch
+        self._max_wait_ms = max_wait_ms
+
+        self._lock = threading.Lock()
+        self._request_count = 0
+        self._last_serving_sec = 0.0
+        self._avg_serving_sec = 0.0
+        self._start_time = _dt.datetime.now(_dt.timezone.utc)
+        self._batchers: list[MicroBatcher] = []
+        self._load()
+
+        self.router = Router()
+        self.router.route("GET", "/", self._status)
+        self.router.route("POST", "/queries.json", self._queries)
+        self.router.route("POST", "/reload", self._reload)
+        self.router.route("POST", "/stop", self._stop)
+        self._http: HTTPServer | None = None
+
+    # -- model loading / hot swap ----------------------------------------
+    def _load(self) -> None:
+        instance, algorithms, models, serving = load_deployment(
+            self._engine,
+            self._params,
+            engine_id=self._engine_id,
+            engine_version=self._engine_version,
+            engine_variant=self._engine_variant,
+            ctx=self._ctx,
+            storage=self._storage,
+        )
+        old = self._batchers
+        batchers = [
+            MicroBatcher(
+                (lambda a, m: lambda qs: a.batch_predict(m, qs))(
+                    algo, model
+                ),
+                max_batch=self._max_batch,
+                max_wait_ms=self._max_wait_ms,
+            )
+            for algo, model in zip(algorithms, models)
+        ]
+        with self._lock:
+            self._instance = instance
+            self._serving = serving
+            self._batchers = batchers
+        for b in old:
+            b.close()
+        logger.info(
+            "engine server serving instance %s (%d algorithm(s))",
+            instance.id,
+            len(batchers),
+        )
+
+    # -- routes -----------------------------------------------------------
+    def _status(self, request: Request) -> Response:
+        with self._lock:
+            return Response(
+                200,
+                {
+                    "status": "alive",
+                    "engineId": self._engine_id,
+                    "engineVersion": self._engine_version,
+                    "engineVariant": self._engine_variant,
+                    "engineInstanceId": self._instance.id,
+                    "startTime": self._start_time.isoformat(),
+                    "requestCount": self._request_count,
+                    "avgServingSec": round(self._avg_serving_sec, 6),
+                    "lastServingSec": round(self._last_serving_sec, 6),
+                },
+            )
+
+    def _queries(self, request: Request) -> Response:
+        t0 = time.perf_counter()
+        query = request.json()
+        if not isinstance(query, dict):
+            raise HTTPError(400, "query must be a JSON object")
+        for _attempt in range(2):
+            with self._lock:
+                serving = self._serving
+                batchers = self._batchers
+            supplemented = serving.supplement(query)
+            try:
+                futures = [b.submit(supplemented) for b in batchers]
+            except RuntimeError:
+                # /reload swapped+closed the batchers between our snapshot
+                # and submit — retry once against the fresh set
+                continue
+            break
+        else:
+            raise HTTPError(503, "server is reloading; retry")
+        predictions = [f.result(timeout=30.0) for f in futures]
+        prediction = serving.serve(supplemented, predictions)
+
+        if self._feedback:
+            prediction = self._record_feedback(query, prediction)
+
+        elapsed = time.perf_counter() - t0
+        with self._lock:
+            self._request_count += 1
+            self._last_serving_sec = elapsed
+            self._avg_serving_sec += (
+                elapsed - self._avg_serving_sec
+            ) / self._request_count
+        return Response(200, prediction)
+
+    def _record_feedback(self, query: dict, prediction):
+        """Store a ``predict`` event (entity ``pio_pr``) carrying query +
+        prediction, and inject the prId into the response
+        (reference CreateServer.scala:539-600)."""
+        pr_id = None
+        if isinstance(prediction, dict):
+            pr_id = prediction.get("prId")
+        pr_id = pr_id or secrets.token_hex(16)
+        try:
+            event = Event(
+                event="predict",
+                entity_type="pio_pr",
+                entity_id=pr_id,
+                properties=DataMap(
+                    {
+                        "engineInstanceId": self._instance.id,
+                        "query": query,
+                        "prediction": prediction,
+                    }
+                ),
+            )
+            app_id = self._feedback_app_id
+            if app_id is not None:
+                self._storage.get_events().insert(event, app_id)
+        except Exception:  # noqa: BLE001 - feedback must not break serving
+            logger.exception("feedback event failed")
+        if isinstance(prediction, dict):
+            prediction = {**prediction, "prId": pr_id}
+        return prediction
+
+    def _reload(self, request: Request) -> Response:
+        self._load()
+        return Response(200, {"message": "reloaded", "engineInstanceId": self._instance.id})
+
+    def _stop(self, request: Request) -> Response:
+        if self._http is not None:
+            threading.Thread(
+                target=self._http.shutdown, daemon=True
+            ).start()
+        return Response(200, {"message": "stopping"})
+
+    # -- lifecycle --------------------------------------------------------
+    def serve(self, host: str = "0.0.0.0", port: int = 8000) -> HTTPServer:
+        self._http = HTTPServer(self.router, host=host, port=port)
+        return self._http
+
+    def close(self) -> None:
+        for b in self._batchers:
+            b.close()
+
+
+def create_engine_server(
+    engine: Engine,
+    params: EngineParams,
+    engine_id: str,
+    host: str = "0.0.0.0",
+    port: int = 8000,
+    **kwargs,
+) -> tuple[EngineServer, HTTPServer]:
+    server = EngineServer(engine, params, engine_id, **kwargs)
+    return server, server.serve(host=host, port=port)

@@ -1,0 +1,265 @@
+"""Event Server — REST event collection.
+
+Capability parity with the reference Event Server
+(data/.../api/EventServer.scala:52-641), default port 7070:
+
+* auth by ``accessKey`` query param or HTTP Basic username
+  (EventServer.scala:90-140), optional ``channel`` query param;
+* ``GET  /``                     → alive status
+* ``POST /events.json``          → 201 {"eventId"} (event-name whitelist
+  from the access key enforced, :259-372)
+* ``GET  /events.json``          → filtered query (full filter set)
+* ``GET/DELETE /events/<id>.json``
+* ``POST /batch/events.json``    → ≤50 events, per-event status (:374-440)
+* ``GET  /stats.json``           → opt-in counters (``--stats``)
+* ``POST /webhooks/<name>.json`` / ``.form`` → connector-mapped events
+
+Differences: thread-per-request stdlib HTTP instead of spray/akka;
+input plugins are a simple callable list instead of ServiceLoader.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import logging
+from typing import Callable
+
+from predictionio_tpu.data.event import Event, EventValidationError
+from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.serving.http import (
+    HTTPError,
+    HTTPServer,
+    Request,
+    Response,
+    Router,
+)
+from predictionio_tpu.serving.stats import Stats
+from predictionio_tpu.serving.webhooks import (
+    FORM_CONNECTORS,
+    JSON_CONNECTORS,
+    ConnectorError,
+)
+
+logger = logging.getLogger(__name__)
+
+MAX_BATCH_SIZE = 50  # reference EventServer.scala:68
+
+#: input blocker: raise to reject an event before storage
+InputBlocker = Callable[[Event, int, int | None], None]
+
+
+class EventServer:
+    def __init__(
+        self,
+        storage: Storage | None = None,
+        stats: bool = False,
+        input_blockers: list[InputBlocker] | None = None,
+    ):
+        self._storage = storage or get_storage()
+        self._stats = Stats() if stats else None
+        self._input_blockers = list(input_blockers or [])
+        self.router = Router()
+        r = self.router
+        r.route("GET", "/", self._status)
+        r.route("POST", "/events.json", self._create_event)
+        r.route("GET", "/events.json", self._find_events)
+        r.route("GET", "/events/<event_id>.json", self._get_event)
+        r.route("DELETE", "/events/<event_id>.json", self._delete_event)
+        r.route("POST", "/batch/events.json", self._batch)
+        r.route("GET", "/stats.json", self._stats_route)
+        r.route("POST", "/webhooks/<name>.json", self._webhook_json)
+        r.route("POST", "/webhooks/<name>.form", self._webhook_form)
+
+    # -- auth (reference EventServer.scala:90-140) ------------------------
+    def _auth(self, request: Request) -> tuple[int, int | None, tuple]:
+        key = request.query.get("accessKey")
+        if key is None:
+            auth = request.headers.get("Authorization", "")
+            if auth.startswith("Basic "):
+                import base64
+
+                try:
+                    decoded = base64.b64decode(auth[6:]).decode()
+                    key = decoded.split(":", 1)[0]
+                except Exception:  # noqa: BLE001
+                    key = None
+        if not key:
+            raise HTTPError(401, "Missing accessKey.")
+        access_key = self._storage.get_meta_data_access_keys().get(key)
+        if access_key is None:
+            raise HTTPError(401, "Invalid accessKey.")
+        channel_id = None
+        channel_name = request.query.get("channel")
+        if channel_name is not None:
+            channels = self._storage.get_meta_data_channels().get_by_app_id(
+                access_key.appid
+            )
+            match = next(
+                (c for c in channels if c.name == channel_name), None
+            )
+            if match is None:
+                raise HTTPError(400, "Invalid channel.")
+            channel_id = match.id
+        return access_key.appid, channel_id, tuple(access_key.events)
+
+    # -- routes -----------------------------------------------------------
+    def _status(self, request: Request) -> Response:
+        return Response(200, {"status": "alive"})
+
+    def _store(self, event: Event, app_id: int, channel_id, whitelist):
+        if whitelist and event.event not in whitelist:
+            raise HTTPError(
+                403, f"{event.event} events are not allowed"
+            )
+        for blocker in self._input_blockers:
+            blocker(event, app_id, channel_id)
+        return self._storage.get_events().insert(event, app_id, channel_id)
+
+    def _create_event(self, request: Request) -> Response:
+        app_id, channel_id, whitelist = self._auth(request)
+        try:
+            event = Event.from_json_dict(request.json() or {})
+            event_id = self._store(event, app_id, channel_id, whitelist)
+        except (EventValidationError, HTTPError) as e:
+            status = e.status if isinstance(e, HTTPError) else 400
+            if self._stats:
+                self._stats.update(app_id, status)
+            if isinstance(e, HTTPError):
+                raise
+            raise HTTPError(400, str(e)) from e
+        if self._stats:
+            self._stats.update(app_id, 201, event)
+        return Response(201, {"eventId": event_id})
+
+    def _parse_time(self, raw: str | None) -> _dt.datetime | None:
+        if raw is None:
+            return None
+        try:
+            t = _dt.datetime.fromisoformat(raw.replace("Z", "+00:00"))
+        except ValueError as e:
+            raise HTTPError(400, f"bad time {raw!r}: {e}") from e
+        return t if t.tzinfo else t.replace(tzinfo=_dt.timezone.utc)
+
+    def _find_events(self, request: Request) -> Response:
+        app_id, channel_id, _ = self._auth(request)
+        q = request.query
+        # Option[Option[...]] tri-state: "none" means must-be-absent
+        # (reference LEvents.scala:338-345 / EventServer query params)
+        tet = q.get("targetEntityType", ...)
+        tei = q.get("targetEntityId", ...)
+        tet = None if tet == "none" else tet
+        tei = None if tei == "none" else tei
+        try:
+            limit = int(q.get("limit", 20))
+        except ValueError as e:
+            raise HTTPError(400, f"bad limit: {e}") from e
+        events = self._storage.get_events().find(
+            app_id,
+            channel_id,
+            start_time=self._parse_time(q.get("startTime")),
+            until_time=self._parse_time(q.get("untilTime")),
+            entity_type=q.get("entityType"),
+            entity_id=q.get("entityId"),
+            event_names=[q["event"]] if "event" in q else None,
+            target_entity_type=tet,
+            target_entity_id=tei,
+            limit=limit,
+            reversed=q.get("reversed", "false").lower() == "true",
+        )
+        return Response(200, [e.to_json_dict() for e in events])
+
+    def _get_event(self, request: Request) -> Response:
+        app_id, channel_id, _ = self._auth(request)
+        event = self._storage.get_events().get(
+            request.path_params["event_id"], app_id, channel_id
+        )
+        if event is None:
+            raise HTTPError(404, "event not found")
+        return Response(200, event.to_json_dict())
+
+    def _delete_event(self, request: Request) -> Response:
+        app_id, channel_id, _ = self._auth(request)
+        found = self._storage.get_events().delete(
+            request.path_params["event_id"], app_id, channel_id
+        )
+        if not found:
+            raise HTTPError(404, "event not found")
+        return Response(200, {"message": "deleted"})
+
+    def _batch(self, request: Request) -> Response:
+        """Per-event status list (reference EventServer.scala:374-440)."""
+        app_id, channel_id, whitelist = self._auth(request)
+        payload = request.json()
+        if not isinstance(payload, list):
+            raise HTTPError(400, "request body must be a JSON array")
+        if len(payload) > MAX_BATCH_SIZE:
+            raise HTTPError(
+                400,
+                f"Batch request must have less than or equal to "
+                f"{MAX_BATCH_SIZE} events",
+            )
+        results = []
+        for item in payload:
+            try:
+                event = Event.from_json_dict(item)
+                event_id = self._store(event, app_id, channel_id, whitelist)
+                results.append({"status": 201, "eventId": event_id})
+                if self._stats:
+                    self._stats.update(app_id, 201, event)
+            except (EventValidationError, HTTPError, TypeError) as e:
+                status = e.status if isinstance(e, HTTPError) else 400
+                results.append({"status": status, "message": str(e)})
+                if self._stats:
+                    self._stats.update(app_id, status)
+        return Response(200, results)
+
+    def _stats_route(self, request: Request) -> Response:
+        app_id, _, _ = self._auth(request)
+        if self._stats is None:
+            raise HTTPError(
+                404, "stats are not enabled (run with stats=True)"
+            )
+        return Response(200, self._stats.snapshot(app_id))
+
+    def _webhook_json(self, request: Request) -> Response:
+        app_id, channel_id, whitelist = self._auth(request)
+        connector = JSON_CONNECTORS.get(request.path_params["name"])
+        if connector is None:
+            raise HTTPError(404, "webhook connector not found")
+        try:
+            event = Event.from_json_dict(
+                connector.to_event_json(request.json() or {})
+            )
+            event_id = self._store(event, app_id, channel_id, whitelist)
+        except (ConnectorError, EventValidationError) as e:
+            raise HTTPError(400, str(e)) from e
+        if self._stats:
+            self._stats.update(app_id, 201, event)
+        return Response(201, {"eventId": event_id})
+
+    def _webhook_form(self, request: Request) -> Response:
+        app_id, channel_id, whitelist = self._auth(request)
+        connector = FORM_CONNECTORS.get(request.path_params["name"])
+        if connector is None:
+            raise HTTPError(404, "webhook connector not found")
+        try:
+            event = Event.from_json_dict(
+                connector.to_event_json(request.form())
+            )
+            event_id = self._store(event, app_id, channel_id, whitelist)
+        except (ConnectorError, EventValidationError) as e:
+            raise HTTPError(400, str(e)) from e
+        if self._stats:
+            self._stats.update(app_id, 201, event)
+        return Response(201, {"eventId": event_id})
+
+
+def create_event_server(
+    host: str = "0.0.0.0",
+    port: int = 7070,
+    storage: Storage | None = None,
+    stats: bool = False,
+) -> HTTPServer:
+    """Reference EventServer.createEventServer (default port 7070)."""
+    server = EventServer(storage=storage, stats=stats)
+    return HTTPServer(server.router, host=host, port=port)

@@ -1,0 +1,194 @@
+"""Minimal threaded HTTP routing layer for the framework's servers.
+
+Plays the role spray-can + spray-routing play in the reference
+(EventServer.scala routes, CreateServer.scala ServerActor routes) on top
+of stdlib ``http.server`` — zero dependencies, thread-per-request, which
+is the right shape here because request handling is either a quick
+storage call (event server) or an enqueue onto the serving batcher
+(engine server).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+from urllib.parse import parse_qs, urlparse
+
+logger = logging.getLogger(__name__)
+
+Handler = Callable[["Request"], "Response"]
+
+
+class Request:
+    def __init__(
+        self,
+        method: str,
+        path: str,
+        query: dict[str, str],
+        headers,
+        body: bytes,
+        path_params: dict[str, str],
+    ):
+        self.method = method
+        self.path = path
+        self.query = query
+        self.headers = headers
+        self.body = body
+        self.path_params = path_params
+
+    def json(self) -> Any:
+        if not self.body:
+            return None
+        return json.loads(self.body.decode("utf-8"))
+
+    def form(self) -> dict[str, str]:
+        data = parse_qs(self.body.decode("utf-8"))
+        return {k: v[0] for k, v in data.items()}
+
+
+class Response:
+    def __init__(
+        self,
+        status: int = 200,
+        body: Any = None,
+        content_type: str = "application/json",
+        headers: dict[str, str] | None = None,
+    ):
+        self.status = status
+        self.body = body
+        self.content_type = content_type
+        self.headers = headers or {}
+
+    def payload(self) -> bytes:
+        if self.body is None:
+            return b""
+        if isinstance(self.body, bytes):
+            return self.body
+        if isinstance(self.body, str):
+            return self.body.encode("utf-8")
+        return json.dumps(self.body).encode("utf-8")
+
+
+class HTTPError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class Router:
+    """Method + regex path routing; ``<name>`` captures a segment."""
+
+    def __init__(self):
+        self._routes: list[tuple[str, re.Pattern, Handler]] = []
+
+    def route(self, method: str, pattern: str, handler: Handler) -> None:
+        # escape literal segments so '.' in '.json' doesn't match anything
+        parts = re.split(r"<([a-zA-Z_]+)>", pattern)
+        built = "".join(
+            f"(?P<{part}>[^/]+)" if i % 2 else re.escape(part)
+            for i, part in enumerate(parts)
+        )
+        self._routes.append(
+            (method.upper(), re.compile(f"^{built}$"), handler)
+        )
+
+    def dispatch(self, request: Request) -> Response:
+        path_matched = False
+        for method, regex, handler in self._routes:
+            m = regex.match(request.path)
+            if not m:
+                continue
+            path_matched = True
+            if method != request.method:
+                continue
+            request.path_params = {
+                k: v for k, v in m.groupdict().items()
+            }
+            return handler(request)
+        if path_matched:
+            raise HTTPError(405, "method not allowed")
+        raise HTTPError(404, "not found")
+
+
+class HTTPServer:
+    """Threaded server wrapping a Router; start()/shutdown() lifecycle
+    (the EventServerActor / MasterActor bind-unbind equivalent)."""
+
+    def __init__(self, router: Router, host: str = "0.0.0.0", port: int = 0):
+        router_ref = router
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route through logging
+                logger.debug("%s %s", self.address_string(), fmt % args)
+
+            def _handle(self):
+                parsed = urlparse(self.path)
+                query = {
+                    k: v[0] for k, v in parse_qs(parsed.query).items()
+                }
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                request = Request(
+                    method=self.command,
+                    path=parsed.path,
+                    query=query,
+                    headers=self.headers,
+                    body=body,
+                    path_params={},
+                )
+                try:
+                    response = router_ref.dispatch(request)
+                except HTTPError as e:
+                    response = Response(
+                        e.status, {"message": e.message}
+                    )
+                except json.JSONDecodeError as e:
+                    response = Response(400, {"message": f"bad JSON: {e}"})
+                except Exception as e:  # noqa: BLE001 - server boundary
+                    logger.exception("handler error")
+                    response = Response(500, {"message": str(e)})
+                payload = response.payload()
+                self.send_response(response.status)
+                self.send_header("Content-Type", response.content_type)
+                self.send_header("Content-Length", str(len(payload)))
+                for k, v in response.headers.items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(payload)
+
+            do_GET = do_POST = do_DELETE = do_PUT = _handle
+
+        class _Server(ThreadingHTTPServer):
+            # socketserver's default backlog of 5 drops connections under
+            # concurrent bursts — the exact load the batcher exists for
+            request_queue_size = 128
+            daemon_threads = True
+
+        self._httpd = _Server((host, port), _Handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def serve_forever(self) -> None:
+        self._httpd.serve_forever()
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -1,0 +1,234 @@
+"""Engine Server tests over a real socket: queries.json hot path,
+micro-batching, reload, feedback loop (reference ServerActor behavior)."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from fake_engine import (
+    FakeAlgorithm,
+    FakeDataSource,
+    FakeParams,
+    FakePreparator,
+    FakeServing,
+)
+from predictionio_tpu.core import Engine, EngineParams
+from predictionio_tpu.core.workflow import run_train
+from predictionio_tpu.parallel.mesh import ComputeContext
+from predictionio_tpu.serving.batching import MicroBatcher
+from predictionio_tpu.serving.engine_server import EngineServer
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return ComputeContext.create(batch="srv-test")
+
+
+def _call(url, method="GET", body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+class DictQueryAlgorithm(FakeAlgorithm):
+    """Fake algorithm answering dict queries (the server speaks JSON)."""
+
+    def predict(self, model, query):
+        return {"result": model.algo_id * 10 + int(query.get("x", 0))}
+
+    def batch_predict(self, model, queries):
+        return [self.predict(model, q) for q in queries]
+
+
+class DictServing(FakeServing):
+    def serve(self, query, predictions):
+        return predictions[0]
+
+
+def _engine():
+    return Engine(
+        FakeDataSource, FakePreparator, DictQueryAlgorithm, DictServing
+    )
+
+
+def _params():
+    return EngineParams(
+        data_source=("", FakeParams(id=1)),
+        preparator=("", FakeParams(id=2)),
+        algorithms=[("", FakeParams(id=3))],
+        serving=("", FakeParams()),
+    )
+
+
+@pytest.fixture()
+def server(ctx, memory_storage):
+    run_train(
+        _engine(), _params(), engine_id="srv", ctx=ctx,
+        storage=memory_storage,
+    )
+    es = EngineServer(
+        _engine(),
+        _params(),
+        engine_id="srv",
+        storage=memory_storage,
+        ctx=ctx,
+        feedback=True,
+        feedback_app_id=1,
+    )
+    memory_storage.get_events().init(1)
+    http = es.serve(host="127.0.0.1", port=0)
+    http.start()
+    yield f"http://127.0.0.1:{http.port}", es, memory_storage
+    http.shutdown()
+    es.close()
+
+
+class TestEngineServer:
+    def test_status_page(self, server):
+        base, _, _ = server
+        status, body = _call(f"{base}/")
+        assert status == 200
+        assert body["engineId"] == "srv"
+        assert body["requestCount"] == 0
+
+    def test_query_hot_path(self, server):
+        base, _, _ = server
+        status, body = _call(
+            f"{base}/queries.json", "POST", {"x": 7}
+        )
+        assert status == 200
+        assert body["result"] == 37  # algo_id 3 → 30 + x
+        _, info = _call(f"{base}/")
+        assert info["requestCount"] == 1
+        assert info["lastServingSec"] > 0
+
+    def test_feedback_event_recorded_and_prid_injected(self, server):
+        base, _, storage = server
+        _, body = _call(f"{base}/queries.json", "POST", {"x": 1})
+        assert "prId" in body
+        events = list(
+            storage.get_events().find(1, entity_type="pio_pr")
+        )
+        assert len(events) == 1
+        assert events[0].event == "predict"
+        assert events[0].properties["query"] == {"x": 1}
+
+    def test_concurrent_queries_batched(self, server):
+        base, es, _ = server
+        results = [None] * 32
+
+        def call(i):
+            _, body = _call(f"{base}/queries.json", "POST", {"x": i})
+            results[i] = body["result"]
+
+        threads = [
+            threading.Thread(target=call, args=(i,)) for i in range(32)
+        ]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert results == [30 + i for i in range(32)]
+
+    def test_reload_picks_latest(self, server, ctx, memory_storage):
+        base, es, _ = server
+        old_instance = es._instance.id
+        run_train(
+            _engine(), _params(), engine_id="srv", ctx=ctx,
+            storage=memory_storage,
+        )
+        status, body = _call(f"{base}/reload", "POST")
+        assert status == 200
+        assert body["engineInstanceId"] != old_instance
+        status, body = _call(f"{base}/queries.json", "POST", {"x": 2})
+        assert body["result"] == 32
+
+    def test_malformed_query(self, server):
+        base, _, _ = server
+        status, _ = _call(f"{base}/queries.json", "POST", [1, 2, 3])
+        assert status == 400
+
+
+class TestMicroBatcher:
+    def test_batches_and_results_in_order(self):
+        seen_batches = []
+
+        def batch_fn(items):
+            seen_batches.append(len(items))
+            return [i * 2 for i in items]
+
+        b = MicroBatcher(batch_fn, max_batch=16, max_wait_ms=20)
+        futures = [b.submit(i) for i in range(40)]
+        assert [f.result(5) for f in futures] == [i * 2 for i in range(40)]
+        assert sum(seen_batches) == 40
+        assert max(seen_batches) > 1  # some coalescing happened
+        b.close()
+
+    def test_error_propagates_to_all(self):
+        def bad(items):
+            raise RuntimeError("boom")
+
+        b = MicroBatcher(bad, max_batch=4, max_wait_ms=1)
+        futures = [b.submit(i) for i in range(3)]
+        for f in futures:
+            with pytest.raises(RuntimeError, match="boom"):
+                f.result(5)
+        b.close()
+
+    def test_wrong_result_count(self):
+        b = MicroBatcher(lambda items: [1], max_batch=4, max_wait_ms=1)
+        f1, f2 = b.submit("a"), b.submit("b")
+        with pytest.raises(RuntimeError, match="results"):
+            f1.result(5)
+        b.close()
+
+    def test_submit_after_close(self):
+        b = MicroBatcher(lambda items: items)
+        b.close()
+        with pytest.raises(RuntimeError):
+            b.submit(1)
+
+
+class TestReviewRegressions:
+    def test_graceful_close_serves_queued_items(self):
+        import time
+
+        def slow(items):
+            time.sleep(0.05)
+            return [i * 2 for i in items]
+
+        b = MicroBatcher(slow, max_batch=2, max_wait_ms=1)
+        futures = [b.submit(i) for i in range(10)]
+        b.close()  # must drain, not abandon
+        assert [f.result(5) for f in futures] == [i * 2 for i in range(10)]
+
+    def test_query_during_reload_survives(self, server, ctx, memory_storage):
+        base, es, _ = server
+        run_train(
+            _engine(), _params(), engine_id="srv", ctx=ctx,
+            storage=memory_storage,
+        )
+        errors = []
+        done = threading.Event()
+
+        def hammer():
+            while not done.is_set():
+                status, body = _call(
+                    f"{base}/queries.json", "POST", {"x": 1}
+                )
+                if status != 200:
+                    errors.append((status, body))
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        [t.start() for t in threads]
+        for _ in range(3):
+            _call(f"{base}/reload", "POST")
+        done.set()
+        [t.join() for t in threads]
+        assert errors == []

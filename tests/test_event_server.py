@@ -1,0 +1,259 @@
+"""Event Server REST tests over a real socket
+(reference EventServiceSpec / SegmentIOAuthSpec patterns)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.data.storage import AccessKey, App, Channel
+from predictionio_tpu.serving.event_server import create_event_server
+
+
+@pytest.fixture()
+def server(memory_storage):
+    apps = memory_storage.get_meta_data_apps()
+    app_id = apps.insert(App(id=0, name="srvapp"))
+    memory_storage.get_events().init(app_id)
+    key = memory_storage.get_meta_data_access_keys().insert(
+        AccessKey(key="testkey", appid=app_id)
+    )
+    cid = memory_storage.get_meta_data_channels().insert(
+        Channel(id=0, name="ch1", appid=app_id)
+    )
+    memory_storage.get_events().init(app_id, cid)
+    limited = memory_storage.get_meta_data_access_keys().insert(
+        AccessKey(key="limitedkey", appid=app_id, events=("view",))
+    )
+    http = create_event_server(
+        host="127.0.0.1", port=0, storage=memory_storage, stats=True
+    )
+    http.start()
+    yield f"http://127.0.0.1:{http.port}", key, limited
+    http.shutdown()
+
+
+def _call(url, method="GET", body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if data:
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+def _event(name="view", entity="u1", **extra):
+    return {
+        "event": name,
+        "entityType": "user",
+        "entityId": entity,
+        **extra,
+    }
+
+
+class TestEventAPI:
+    def test_alive(self, server):
+        base, _, _ = server
+        assert _call(f"{base}/")[1] == {"status": "alive"}
+
+    def test_create_get_delete(self, server):
+        base, key, _ = server
+        status, body = _call(
+            f"{base}/events.json?accessKey={key}", "POST", _event()
+        )
+        assert status == 201
+        eid = body["eventId"]
+        status, body = _call(f"{base}/events/{eid}.json?accessKey={key}")
+        assert status == 200 and body["event"] == "view"
+        status, _ = _call(
+            f"{base}/events/{eid}.json?accessKey={key}", "DELETE"
+        )
+        assert status == 200
+        status, _ = _call(f"{base}/events/{eid}.json?accessKey={key}")
+        assert status == 404
+
+    def test_auth_required_and_invalid(self, server):
+        base, _, _ = server
+        assert _call(f"{base}/events.json", "POST", _event())[0] == 401
+        assert (
+            _call(f"{base}/events.json?accessKey=wrong", "POST", _event())[0]
+            == 401
+        )
+
+    def test_event_whitelist(self, server):
+        base, _, limited = server
+        ok = _call(
+            f"{base}/events.json?accessKey={limited}", "POST", _event("view")
+        )
+        assert ok[0] == 201
+        denied = _call(
+            f"{base}/events.json?accessKey={limited}", "POST", _event("buy")
+        )
+        assert denied[0] == 403
+
+    def test_invalid_event_rejected(self, server):
+        base, key, _ = server
+        status, body = _call(
+            f"{base}/events.json?accessKey={key}", "POST", _event("$bogus")
+        )
+        assert status == 400
+        assert "reserved" in body["message"]
+
+    def test_find_with_filters(self, server):
+        base, key, _ = server
+        for i in range(5):
+            _call(
+                f"{base}/events.json?accessKey={key}",
+                "POST",
+                _event("view" if i % 2 == 0 else "buy", f"u{i}"),
+            )
+        status, body = _call(f"{base}/events.json?accessKey={key}&event=buy")
+        assert status == 200 and len(body) == 2
+        status, body = _call(
+            f"{base}/events.json?accessKey={key}&limit=3"
+        )
+        assert len(body) == 3
+
+    def test_channel_isolation(self, server):
+        base, key, _ = server
+        _call(
+            f"{base}/events.json?accessKey={key}&channel=ch1",
+            "POST",
+            _event("view", "chan-user"),
+        )
+        status, body = _call(
+            f"{base}/events.json?accessKey={key}&channel=ch1"
+        )
+        assert [e["entityId"] for e in body] == ["chan-user"]
+        status, body = _call(
+            f"{base}/events.json?accessKey={key}&channel=nope"
+        )
+        assert status == 400
+
+    def test_batch(self, server):
+        base, key, _ = server
+        events = [_event("view", f"b{i}") for i in range(3)]
+        events.insert(1, {"event": "$bad", "entityType": "u", "entityId": "x"})
+        status, body = _call(
+            f"{base}/batch/events.json?accessKey={key}", "POST", events
+        )
+        assert status == 200
+        assert [r["status"] for r in body] == [201, 400, 201, 201]
+
+    def test_batch_limit_50(self, server):
+        base, key, _ = server
+        status, body = _call(
+            f"{base}/batch/events.json?accessKey={key}",
+            "POST",
+            [_event("view", f"b{i}") for i in range(51)],
+        )
+        assert status == 400
+        assert "50" in body["message"]
+
+    def test_stats(self, server):
+        base, key, _ = server
+        _call(f"{base}/events.json?accessKey={key}", "POST", _event())
+        status, body = _call(f"{base}/stats.json?accessKey={key}")
+        assert status == 200
+        assert body["statusCount"].get("201", 0) >= 1
+        assert body["eventCount"].get("view", 0) >= 1
+
+    def test_webhook_segmentio(self, server):
+        base, key, _ = server
+        payload = {
+            "type": "track",
+            "userId": "seg-user",
+            "event": "Signed Up",
+            "properties": {"plan": "pro"},
+            "timestamp": "2026-01-01T00:00:00Z",
+        }
+        status, body = _call(
+            f"{base}/webhooks/segmentio.json?accessKey={key}",
+            "POST",
+            payload,
+        )
+        assert status == 201
+        status, events = _call(
+            f"{base}/events.json?accessKey={key}&event=track"
+        )
+        assert events[0]["entityId"] == "seg-user"
+        assert events[0]["properties"]["event"] == "Signed Up"
+
+    def test_webhook_unknown_connector(self, server):
+        base, key, _ = server
+        status, _ = _call(
+            f"{base}/webhooks/nope.json?accessKey={key}", "POST", {}
+        )
+        assert status == 404
+
+    def test_method_not_allowed(self, server):
+        base, key, _ = server
+        status, _ = _call(f"{base}/batch/events.json?accessKey={key}")
+        assert status == 405
+
+    def test_bad_json(self, server):
+        base, key, _ = server
+        req = urllib.request.Request(
+            f"{base}/events.json?accessKey={key}",
+            data=b"{not json",
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                status = resp.status
+        except urllib.error.HTTPError as e:
+            status = e.code
+        assert status == 400
+
+
+class TestReviewRegressions:
+    def test_bad_event_time_single_is_400(self, server):
+        base, key, _ = server
+        status, body = _call(
+            f"{base}/events.json?accessKey={key}",
+            "POST",
+            _event(eventTime="garbage"),
+        )
+        assert status == 400
+        assert "ISO-8601" in body["message"]
+
+    def test_bad_event_time_in_batch_keeps_contract(self, server):
+        base, key, _ = server
+        events = [
+            _event("view", "ok1"),
+            _event("view", "bad", eventTime="bad"),
+            _event("view", "ok2"),
+        ]
+        status, body = _call(
+            f"{base}/batch/events.json?accessKey={key}", "POST", events
+        )
+        assert status == 200
+        assert [r["status"] for r in body] == [201, 400, 201]
+
+    def test_mailchimp_without_fired_at_defaults_now(self, server):
+        import urllib.parse
+
+        base, key, _ = server
+        form = urllib.parse.urlencode(
+            {
+                "type": "cleaned",
+                "data[list_id]": "L1",
+                "data[email]": "x@y.z",
+            }
+        ).encode()
+        req = urllib.request.Request(
+            f"{base}/webhooks/mailchimp.form?accessKey={key}",
+            data=form,
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 201
+
+    def test_route_dots_are_literal(self, server):
+        base, key, _ = server
+        status, _ = _call(f"{base}/eventsXjson?accessKey={key}")
+        assert status == 404

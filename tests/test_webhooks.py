@@ -1,0 +1,146 @@
+"""Webhook connector golden tests (reference SegmentIOConnectorSpec /
+MailChimpConnectorSpec pattern: payload in → event JSON out)."""
+
+import pytest
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.serving.webhooks import (
+    ConnectorError,
+    MailChimpConnector,
+    SegmentIOConnector,
+)
+
+
+class TestSegmentIO:
+    def test_track(self):
+        out = SegmentIOConnector().to_event_json(
+            {
+                "type": "track",
+                "userId": "u1",
+                "event": "Signed Up",
+                "properties": {"plan": "pro"},
+                "timestamp": "2026-01-01T00:00:00Z",
+                "context": {"ip": "1.2.3.4"},
+            }
+        )
+        assert out["event"] == "track"
+        assert out["entityType"] == "user"
+        assert out["entityId"] == "u1"
+        assert out["properties"]["event"] == "Signed Up"
+        assert out["properties"]["properties"] == {"plan": "pro"}
+        assert out["properties"]["context"] == {"ip": "1.2.3.4"}
+        Event.from_json_dict(out)  # must be a valid event
+
+    def test_identify_uses_anonymous_id_fallback(self):
+        out = SegmentIOConnector().to_event_json(
+            {"type": "identify", "anonymousId": "anon", "traits": {"a": 1}}
+        )
+        assert out["entityId"] == "anon"
+        assert out["properties"]["traits"] == {"a": 1}
+
+    def test_alias_group_page_screen(self):
+        c = SegmentIOConnector()
+        assert c.to_event_json(
+            {"type": "alias", "userId": "u", "previousId": "p"}
+        )["properties"]["previous_id"] == "p"
+        assert c.to_event_json(
+            {"type": "group", "userId": "u", "groupId": "g"}
+        )["properties"]["group_id"] == "g"
+        for t in ("page", "screen"):
+            assert c.to_event_json(
+                {"type": t, "userId": "u", "name": "Home"}
+            )["properties"]["name"] == "Home"
+
+    def test_missing_user_raises(self):
+        with pytest.raises(ConnectorError, match="userId"):
+            SegmentIOConnector().to_event_json({"type": "track", "event": "x"})
+
+    def test_unknown_type_raises(self):
+        with pytest.raises(ConnectorError, match="unknown type"):
+            SegmentIOConnector().to_event_json({"type": "zap", "userId": "u"})
+
+
+class TestMailChimp:
+    def test_subscribe(self):
+        out = MailChimpConnector().to_event_json(
+            {
+                "type": "subscribe",
+                "fired_at": "2009-03-26 21:35:57",
+                "data[id]": "8a25ff1d98",
+                "data[list_id]": "a6b5da1054",
+                "data[email]": "api@mailchimp.com",
+                "data[email_type]": "html",
+                "data[merges][EMAIL]": "api@mailchimp.com",
+                "data[merges][FNAME]": "MailChimp",
+                "data[merges][LNAME]": "API",
+                "data[ip_opt]": "10.20.10.30",
+                "data[ip_signup]": "10.20.10.30",
+            }
+        )
+        assert out["event"] == "subscribe"
+        assert out["entityId"] == "8a25ff1d98"
+        assert out["targetEntityType"] == "list"
+        assert out["targetEntityId"] == "a6b5da1054"
+        assert out["properties"]["merges"]["FNAME"] == "MailChimp"
+        assert out["eventTime"].startswith("2009-03-26T21:35:57")
+        Event.from_json_dict(out)
+
+    def test_unsubscribe_carries_action_reason(self):
+        out = MailChimpConnector().to_event_json(
+            {
+                "type": "unsubscribe",
+                "fired_at": "2009-03-26 21:40:57",
+                "data[action]": "unsub",
+                "data[reason]": "manual",
+                "data[id]": "x",
+                "data[list_id]": "l",
+                "data[email]": "e@x.com",
+            }
+        )
+        assert out["properties"]["action"] == "unsub"
+        assert out["properties"]["reason"] == "manual"
+
+    def test_upemail_cleaned_campaign(self):
+        c = MailChimpConnector()
+        up = c.to_event_json(
+            {
+                "type": "upemail",
+                "fired_at": "2009-03-26 21:40:57",
+                "data[list_id]": "l",
+                "data[new_email]": "n@x.com",
+                "data[old_email]": "o@x.com",
+            }
+        )
+        assert up["entityType"] == "list"
+        cleaned = c.to_event_json(
+            {
+                "type": "cleaned",
+                "fired_at": "2009-03-26 21:40:57",
+                "data[list_id]": "l",
+                "data[email]": "bad@x.com",
+                "data[reason]": "hard",
+            }
+        )
+        assert cleaned["properties"]["reason"] == "hard"
+        camp = c.to_event_json(
+            {
+                "type": "campaign",
+                "fired_at": "2009-03-26 21:40:57",
+                "data[id]": "c1",
+                "data[subject]": "Hello",
+            }
+        )
+        assert camp["entityType"] == "campaign"
+
+    def test_missing_type_and_unknown_type(self):
+        with pytest.raises(ConnectorError, match="required"):
+            MailChimpConnector().to_event_json({})
+        with pytest.raises(ConnectorError, match="unknown"):
+            MailChimpConnector().to_event_json({"type": "zap"})
+
+    def test_missing_required_field(self):
+        with pytest.raises(ConnectorError, match="data\\[id\\]"):
+            MailChimpConnector().to_event_json(
+                {"type": "subscribe", "fired_at": "2009-03-26 21:35:57",
+                 "data[list_id]": "l", "data[email]": "e@x.com"}
+            )
