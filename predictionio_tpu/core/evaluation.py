@@ -1,0 +1,270 @@
+"""Evaluation & tuning: Metric library + MetricEvaluator + Evaluation.
+
+Capability parity with the reference:
+
+* ``Metric`` hierarchy (controller/Metric.scala:36-266): Average /
+  OptionAverage / Stdev / OptionStdev / Sum / Zero metrics over
+  (evalInfo, query, prediction, actual) tuples. The reference computes
+  these with Spark ``StatCounter``; here points are host floats (the
+  heavy part — batch prediction — already ran on the mesh).
+* ``MetricEvaluator`` (controller/MetricEvaluator.scala:182-259): scores
+  every candidate EngineParams, tracks the best by the metric's
+  ordering, optionally writes the winning variant JSON
+  (``outputPath="best.json"``).
+* ``Evaluation`` (controller/Evaluation.scala:31-122): engine + metric +
+  params grid, the unit ``run_evaluation`` executes.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import json
+import logging
+import math
+from typing import Any, Callable, Generic, Sequence, TypeVar
+
+from predictionio_tpu.core.controller import params_to_json
+from predictionio_tpu.core.engine import Engine, EngineParams, WorkflowParams
+from predictionio_tpu.parallel.mesh import ComputeContext
+
+logger = logging.getLogger(__name__)
+
+R = TypeVar("R")
+
+#: eval output shape: per fold, (evalInfo, [(query, prediction, actual)])
+EvalData = Sequence[tuple[Any, Sequence[tuple[Any, Any, Any]]]]
+
+
+class Metric(abc.ABC, Generic[R]):
+    """Score one engine-params candidate from its eval output."""
+
+    #: ordering: larger is better (reference Metric's implicit Ordering)
+    higher_is_better: bool = True
+
+    @property
+    def header(self) -> str:
+        return type(self).__name__
+
+    @abc.abstractmethod
+    def calculate(self, eval_data: EvalData) -> R: ...
+
+    def compare(self, a: R, b: R) -> int:
+        sign = 1 if self.higher_is_better else -1
+        return sign * ((a > b) - (a < b))
+
+
+class PointMetric(Metric[float]):
+    """Base for per-(q, p, a) point metrics."""
+
+    @abc.abstractmethod
+    def calculate_point(self, eval_info, query, prediction, actual) -> (
+        float | None
+    ): ...
+
+    def _points(self, eval_data: EvalData) -> list[float]:
+        out = []
+        for eval_info, qpa in eval_data:
+            for q, p, a in qpa:
+                point = self.calculate_point(eval_info, q, p, a)
+                if point is not None:
+                    out.append(float(point))
+        return out
+
+
+class AverageMetric(PointMetric):
+    """Mean of points (reference AverageMetric; None points are an error
+    in the reference — use OptionAverageMetric to skip)."""
+
+    def calculate(self, eval_data: EvalData) -> float:
+        points = self._points(eval_data)
+        return sum(points) / len(points) if points else float("-inf")
+
+
+class OptionAverageMetric(AverageMetric):
+    """calculate_point may return None to exclude a point."""
+
+
+class SumMetric(PointMetric):
+    def calculate(self, eval_data: EvalData) -> float:
+        return sum(self._points(eval_data))
+
+
+class StdevMetric(PointMetric):
+    higher_is_better = False
+
+    def calculate(self, eval_data: EvalData) -> float:
+        points = self._points(eval_data)
+        if len(points) < 2:
+            return 0.0
+        mean = sum(points) / len(points)
+        return math.sqrt(
+            sum((x - mean) ** 2 for x in points) / len(points)
+        )
+
+
+class OptionStdevMetric(StdevMetric):
+    pass
+
+
+class ZeroMetric(Metric[float]):
+    """Always 0 (reference ZeroMetric — placeholder for eval-only runs)."""
+
+    def calculate(self, eval_data: EvalData) -> float:
+        return 0.0
+
+
+@dataclasses.dataclass
+class MetricScores:
+    score: Any
+    other_scores: list[Any]
+
+
+@dataclasses.dataclass
+class MetricEvaluatorResult:
+    """Reference MetricEvaluatorResult (MetricEvaluator.scala:61-107)."""
+
+    best_score: MetricScores
+    best_engine_params: EngineParams
+    best_idx: int
+    metric_header: str
+    other_metric_headers: list[str]
+    engine_params_scores: list[tuple[EngineParams, MetricScores]]
+
+    def to_one_liner(self) -> str:
+        return (
+            f"[{self.metric_header}] best: {self.best_score.score} "
+            f"(candidate {self.best_idx + 1}/"
+            f"{len(self.engine_params_scores)})"
+        )
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "metricHeader": self.metric_header,
+                "bestScore": self.best_score.score,
+                "bestIdx": self.best_idx,
+                "bestEngineParams": _engine_params_json(
+                    self.best_engine_params
+                ),
+                "scores": [
+                    s.score for _p, s in self.engine_params_scores
+                ],
+            }
+        )
+
+    def to_html(self) -> str:
+        rows = "".join(
+            f"<tr><td>{i}</td><td>{s.score}</td></tr>"
+            for i, (_p, s) in enumerate(self.engine_params_scores)
+        )
+        return (
+            f"<h3>{self.metric_header}</h3><p>best: "
+            f"{self.best_score.score} (candidate {self.best_idx})</p>"
+            f"<table>{rows}</table>"
+        )
+
+
+def _engine_params_json(params: EngineParams) -> dict:
+    return {
+        "datasource": {
+            "name": params.data_source[0],
+            "params": params_to_json(params.data_source[1]),
+        },
+        "preparator": {
+            "name": params.preparator[0],
+            "params": params_to_json(params.preparator[1]),
+        },
+        "algorithms": [
+            {"name": n, "params": params_to_json(p)}
+            for n, p in params.algorithms
+        ],
+        "serving": {
+            "name": params.serving[0],
+            "params": params_to_json(params.serving[1]),
+        },
+    }
+
+
+class MetricEvaluator:
+    """Score every candidate, pick the best (MetricEvaluator.scala:215-259)."""
+
+    def __init__(
+        self,
+        metric: Metric,
+        other_metrics: Sequence[Metric] = (),
+        output_path: str | None = None,
+    ):
+        self.metric = metric
+        self.other_metrics = list(other_metrics)
+        self.output_path = output_path
+
+    def evaluate(
+        self,
+        ctx: ComputeContext,
+        engine: Engine,
+        engine_params_list: Sequence[EngineParams],
+        workflow: WorkflowParams | None = None,
+    ) -> MetricEvaluatorResult:
+        if not engine_params_list:
+            raise ValueError("engine_params_list must not be empty")
+        scores: list[tuple[EngineParams, MetricScores]] = []
+        for i, params in enumerate(engine_params_list):
+            eval_data = engine.eval(ctx, params, workflow)
+            score = MetricScores(
+                score=self.metric.calculate(eval_data),
+                other_scores=[
+                    m.calculate(eval_data) for m in self.other_metrics
+                ],
+            )
+            logger.info(
+                "candidate %d/%d: %s = %s",
+                i + 1,
+                len(engine_params_list),
+                self.metric.header,
+                score.score,
+            )
+            scores.append((params, score))
+        best_idx = 0
+        for i in range(1, len(scores)):
+            if (
+                self.metric.compare(
+                    scores[i][1].score, scores[best_idx][1].score
+                )
+                > 0
+            ):
+                best_idx = i
+        result = MetricEvaluatorResult(
+            best_score=scores[best_idx][1],
+            best_engine_params=scores[best_idx][0],
+            best_idx=best_idx,
+            metric_header=self.metric.header,
+            other_metric_headers=[m.header for m in self.other_metrics],
+            engine_params_scores=scores,
+        )
+        if self.output_path:
+            with open(self.output_path, "w") as f:
+                json.dump(
+                    _engine_params_json(result.best_engine_params),
+                    f,
+                    indent=2,
+                )
+            logger.info("best engine params written to %s", self.output_path)
+        return result
+
+
+@dataclasses.dataclass
+class Evaluation:
+    """Engine + metric + candidate grid (reference Evaluation.scala:31-122;
+    the grid is a plain list — the EngineParamsGenerator equivalent is
+    any callable producing it)."""
+
+    engine: Engine
+    metric: Metric
+    engine_params_list: Sequence[EngineParams]
+    other_metrics: Sequence[Metric] = ()
+    output_path: str | None = None
+
+
+#: EngineParamsGenerator (reference EngineParamsGenerator.scala:27-43)
+EngineParamsGenerator = Callable[[], Sequence[EngineParams]]

@@ -125,6 +125,71 @@ def run_train(
         raise
 
 
+def run_evaluation(
+    evaluation,
+    batch: str = "",
+    workflow: WorkflowParams | None = None,
+    ctx: ComputeContext | None = None,
+    storage: Storage | None = None,
+):
+    """Run an Evaluation; returns (instance_id, MetricEvaluatorResult).
+
+    Lifecycle mirrors the reference (CoreWorkflow.runEvaluation,
+    workflow/CoreWorkflow.scala:100-157): EvaluationInstance INIT →
+    EVALCOMPLETED with one-liner / HTML / JSON results persisted."""
+    from predictionio_tpu.core.evaluation import MetricEvaluator
+    from predictionio_tpu.data.storage import EvaluationInstance
+
+    workflow = workflow or WorkflowParams()
+    storage = storage or get_storage()
+    instances = storage.get_meta_data_evaluation_instances()
+    instance_id = instances.insert(
+        EvaluationInstance(
+            id="",
+            status="INIT",
+            start_time=_now(),
+            end_time=_now(),
+            evaluation_class=type(evaluation).__name__,
+            batch=batch,
+        )
+    )
+    instance = instances.get(instance_id)
+    ctx = ctx or ComputeContext.create(batch=batch or "evaluation")
+    try:
+        evaluator = MetricEvaluator(
+            metric=evaluation.metric,
+            other_metrics=evaluation.other_metrics,
+            output_path=evaluation.output_path,
+        )
+        result = evaluator.evaluate(
+            ctx, evaluation.engine, evaluation.engine_params_list, workflow
+        )
+    except Exception:
+        instances.update(
+            EvaluationInstance(
+                **{
+                    **instance.__dict__,
+                    "status": "FAILED",
+                    "end_time": _now(),
+                }
+            )
+        )
+        raise
+    instances.update(
+        EvaluationInstance(
+            **{
+                **instance.__dict__,
+                "status": "EVALCOMPLETED",
+                "end_time": _now(),
+                "evaluator_results": result.to_one_liner(),
+                "evaluator_results_html": result.to_html(),
+                "evaluator_results_json": result.to_json(),
+            }
+        )
+    )
+    return instance_id, result
+
+
 def load_deployment(
     engine: Engine,
     params: EngineParams,

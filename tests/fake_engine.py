@@ -46,6 +46,8 @@ class FakeDataSource(DataSource):
         return FakeTD(id=self.params.id, error=self.params.error)
 
     def read_eval(self, ctx):
+        if self.params.error:
+            raise ValueError("data source eval error")
         # two folds; queries are ints, actual = query * 10
         return [
             (

@@ -1,0 +1,183 @@
+"""Metric library, MetricEvaluator, FastEvalEngine, and run_evaluation
+tests (reference MetricEvaluatorTest / MetricTest / FastEvalEngineTest —
+the latter asserts cache-hit counts per prefix, which we mirror)."""
+
+import json
+
+import pytest
+
+from fake_engine import (
+    FakeAlgorithm,
+    FakeDataSource,
+    FakeParams,
+    FakePreparator,
+    FakeServing,
+)
+from predictionio_tpu.core import Engine, EngineParams
+from predictionio_tpu.core.evaluation import (
+    AverageMetric,
+    Evaluation,
+    MetricEvaluator,
+    OptionAverageMetric,
+    StdevMetric,
+    SumMetric,
+    ZeroMetric,
+)
+from predictionio_tpu.core.fasteval import FastEvalEngine
+from predictionio_tpu.core.workflow import run_evaluation
+from predictionio_tpu.parallel.mesh import ComputeContext
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return ComputeContext.create(batch="eval-test")
+
+
+class QueryEcho(AverageMetric):
+    """Score = prediction value (fake predictions encode the pipeline)."""
+
+    def calculate_point(self, eval_info, q, p, a):
+        return float(p)
+
+
+class SkipOdd(OptionAverageMetric):
+    def calculate_point(self, eval_info, q, p, a):
+        return None if q % 2 else float(p)
+
+
+def _engine(cls=Engine):
+    return cls(FakeDataSource, FakePreparator, FakeAlgorithm, FakeServing)
+
+
+def _params(algo_id):
+    return EngineParams(
+        data_source=("", FakeParams(id=1)),
+        preparator=("", FakeParams(id=2)),
+        algorithms=[("", FakeParams(id=algo_id))],
+        serving=("", FakeParams()),
+    )
+
+
+_FAKE_DATA = [
+    ({"f": 0}, [(0, 10.0, 0), (1, 20.0, 0), (2, 30.0, 0)]),
+]
+
+
+class TestMetrics:
+    def test_average(self):
+        assert QueryEcho().calculate(_FAKE_DATA) == 20.0
+
+    def test_option_average_skips_none(self):
+        assert SkipOdd().calculate(_FAKE_DATA) == 20.0  # mean(10, 30)
+
+    def test_sum(self):
+        class S(SumMetric):
+            def calculate_point(self, ei, q, p, a):
+                return float(p)
+
+        assert S().calculate(_FAKE_DATA) == 60.0
+
+    def test_stdev_lower_is_better(self):
+        class S(StdevMetric):
+            def calculate_point(self, ei, q, p, a):
+                return float(p)
+
+        m = S()
+        assert m.calculate(_FAKE_DATA) == pytest.approx(8.1649, rel=1e-3)
+        assert m.compare(1.0, 2.0) > 0  # lower stdev wins
+
+    def test_zero(self):
+        assert ZeroMetric().calculate(_FAKE_DATA) == 0.0
+
+
+class TestMetricEvaluator:
+    def test_picks_best_and_writes_json(self, ctx, tmp_path):
+        out = tmp_path / "best.json"
+        evaluator = MetricEvaluator(QueryEcho(), output_path=str(out))
+        # prediction = 1000*ds + 100*prep + 10*algo + q; higher algo wins
+        result = evaluator.evaluate(
+            ctx, _engine(), [_params(3), _params(9), _params(5)]
+        )
+        assert result.best_idx == 1
+        assert result.best_engine_params.algorithms[0][1].id == 9
+        assert "best" in result.to_one_liner()
+        written = json.loads(out.read_text())
+        assert written["algorithms"][0]["params"]["id"] == 9
+
+    def test_empty_params_list_raises(self, ctx):
+        with pytest.raises(ValueError):
+            MetricEvaluator(QueryEcho()).evaluate(ctx, _engine(), [])
+
+
+class TestFastEvalEngine:
+    def test_prefix_cache_hits(self, ctx):
+        engine = _engine(FastEvalEngine)
+        evaluator = MetricEvaluator(QueryEcho())
+        # 3 candidates share data source + preparator; differ in algo
+        evaluator.evaluate(
+            ctx, engine, [_params(3), _params(5), _params(7)]
+        )
+        # shared prefixes computed exactly once: 1 data-source read,
+        # 2 fold-preparations; per-algo stages once per distinct algo
+        assert len(engine._data_source_cache) == 1
+        assert len(engine._preparator_cache) == 2
+        assert len(engine._algorithms_cache) == 3 * 2  # 3 algos × 2 folds
+        assert engine.cache_hits["data_source"] > 0
+        assert engine.cache_hits["preparator"] > 0
+        assert engine.cache_hits["algorithms"] == 0  # all algos distinct
+
+    def test_identical_candidate_full_reuse(self, ctx):
+        engine = _engine(FastEvalEngine)
+        evaluator = MetricEvaluator(QueryEcho())
+        r = evaluator.evaluate(ctx, engine, [_params(3), _params(3)])
+        # the predict-level cache short-circuits the whole pipeline
+        assert engine.cache_hits["predict"] == 2  # 2 folds reused
+        assert len(engine._algorithms_cache) == 2  # trained once per fold
+        # identical scores
+        scores = [s.score for _p, s in r.engine_params_scores]
+        assert scores[0] == scores[1]
+
+    def test_fasteval_matches_plain_engine(self, ctx):
+        plain = MetricEvaluator(QueryEcho()).evaluate(
+            ctx, _engine(), [_params(4)]
+        )
+        fast = MetricEvaluator(QueryEcho()).evaluate(
+            ctx, _engine(FastEvalEngine), [_params(4)]
+        )
+        assert plain.best_score.score == fast.best_score.score
+
+
+class TestRunEvaluation:
+    def test_lifecycle_and_results_persisted(self, ctx, memory_storage):
+        evaluation = Evaluation(
+            engine=_engine(FastEvalEngine),
+            metric=QueryEcho(),
+            engine_params_list=[_params(3), _params(8)],
+            other_metrics=[ZeroMetric()],
+        )
+        iid, result = run_evaluation(
+            evaluation, ctx=ctx, storage=memory_storage
+        )
+        inst = memory_storage.get_meta_data_evaluation_instances().get(iid)
+        assert inst.status == "EVALCOMPLETED"
+        assert "best" in inst.evaluator_results
+        parsed = json.loads(inst.evaluator_results_json)
+        assert parsed["bestIdx"] == 1
+        assert "<table>" in inst.evaluator_results_html
+        assert result.best_engine_params.algorithms[0][1].id == 8
+
+    def test_failure_marks_instance(self, ctx, memory_storage):
+        bad = Evaluation(
+            engine=_engine(),
+            metric=QueryEcho(),
+            engine_params_list=[
+                EngineParams(
+                    data_source=("", FakeParams(id=1, error=True)),
+                    algorithms=[("", FakeParams(id=3))],
+                )
+            ],
+        )
+        with pytest.raises(ValueError):
+            run_evaluation(bad, ctx=ctx, storage=memory_storage)
+        insts = memory_storage.get_meta_data_evaluation_instances().get_all()
+        assert [i.status for i in insts] == ["FAILED"]
