@@ -1,0 +1,1 @@
+"""CLI (L6): the ``pio``-style console."""

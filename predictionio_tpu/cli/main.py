@@ -1,0 +1,478 @@
+"""``pio``-style console (reference tools/.../console/Console.scala:186-677).
+
+Verbs: version, status, app (new/list/show/delete/data-delete/
+channel-new/channel-delete), accesskey (new/list/delete), build, train,
+eval, deploy, undeploy, eventserver, dashboard, adminserver, export,
+import, template (list).
+
+Where the reference shells out to spark-submit (Runner.scala:92-210),
+this console runs workflows in-process: multi-host TPU runs launch this
+same entry point once per host with ``PIO_*`` coordination env set
+(see predictionio_tpu/parallel/distributed.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from predictionio_tpu.version import __version__
+
+
+def _load_variant(path: str | None) -> dict:
+    if not path:
+        return {}
+    with open(path) as f:
+        return json.load(f)
+
+
+def _resolve(args) -> tuple:
+    """(engine, engine_params, engine_id, variant_name) from CLI args."""
+    from predictionio_tpu.core.registry import resolve_engine_factory
+
+    variant = _load_variant(getattr(args, "variant", None))
+    factory_name = args.engine or variant.get("engineFactory")
+    if not factory_name:
+        raise SystemExit(
+            "error: --engine (or an engine.json with engineFactory) "
+            "is required"
+        )
+    engine = resolve_engine_factory(factory_name)()
+    params = engine.params_from_variant(variant)
+    engine_id = getattr(args, "engine_id", None) or variant.get(
+        "id", factory_name
+    )
+    return engine, params, engine_id, variant.get("variant", "default")
+
+
+def _mesh_ctx(args):
+    from predictionio_tpu.parallel import distributed
+    from predictionio_tpu.parallel.mesh import ComputeContext
+
+    distributed.initialize()
+    mesh_shape = None
+    if getattr(args, "mesh_shape", None):
+        mesh_shape = tuple(int(x) for x in args.mesh_shape.split(","))
+    return ComputeContext.create(
+        batch=getattr(args, "batch", "") or "", mesh_shape=mesh_shape
+    )
+
+
+# -- command implementations ----------------------------------------------
+
+
+def cmd_version(args) -> int:
+    print(__version__)
+    return 0
+
+
+def cmd_status(args) -> int:
+    """Reference Console.status:1035-1107: verify storage + compute."""
+    import jax
+
+    from predictionio_tpu.data.storage import get_storage
+
+    print(f"PredictionIO-TPU {__version__}")
+    devices = jax.devices()
+    print(
+        f"Compute: {len(devices)} {devices[0].platform} device(s): "
+        f"{[str(d) for d in devices[:8]]}"
+    )
+    problems = get_storage().verify_all_data_objects()
+    if problems:
+        for p in problems:
+            print(f"[ERROR] {p}")
+        print("Storage status: FAILED")
+        return 1
+    print("Storage status: OK")
+    print("Your system is all ready to go.")
+    return 0
+
+
+def cmd_app(args) -> int:
+    from predictionio_tpu.cli import commands
+    from predictionio_tpu.data.storage import get_storage
+
+    storage = get_storage()
+    if args.app_command == "new":
+        info = commands.create_app(
+            args.name,
+            description=args.description,
+            access_key=args.access_key or "",
+            storage=storage,
+        )
+        print(f"Created a new app: {args.name} (id {info['app_id']})")
+        print(f"Access Key: {info['access_key']}")
+    elif args.app_command == "list":
+        for app in storage.get_meta_data_apps().get_all():
+            print(f"{app.id}\t{app.name}\t{app.description or ''}")
+    elif args.app_command == "show":
+        print(json.dumps(commands.show_app(args.name, storage), indent=2))
+    elif args.app_command == "delete":
+        commands.delete_app(args.name, storage)
+        print(f"Deleted app {args.name}.")
+    elif args.app_command == "data-delete":
+        commands.delete_app_data(args.name, args.channel, storage)
+        print(f"Deleted data of app {args.name}.")
+    elif args.app_command == "channel-new":
+        cid = commands.create_channel(args.name, args.channel, storage)
+        print(f"Created channel {args.channel} (id {cid}).")
+    elif args.app_command == "channel-delete":
+        commands.delete_channel(args.name, args.channel, storage)
+        print(f"Deleted channel {args.channel}.")
+    return 0
+
+
+def cmd_accesskey(args) -> int:
+    from predictionio_tpu.cli import commands
+    from predictionio_tpu.data.storage import get_storage
+
+    storage = get_storage()
+    if args.ak_command == "new":
+        events = tuple(args.events.split(",")) if args.events else ()
+        key = commands.new_access_key(args.app_name, events, storage)
+        print(f"Access Key: {key}")
+    elif args.ak_command == "list":
+        keys = storage.get_meta_data_access_keys()
+        apps = storage.get_meta_data_apps()
+        if args.app_name:
+            app = apps.get_by_name(args.app_name)
+            rows = keys.get_by_app_id(app.id) if app else []
+        else:
+            rows = keys.get_all()
+        for k in rows:
+            print(f"{k.key}\t{k.appid}\t{','.join(k.events)}")
+    elif args.ak_command == "delete":
+        ok = storage.get_meta_data_access_keys().delete(args.key)
+        print("Deleted." if ok else "Key not found.")
+        return 0 if ok else 1
+    return 0
+
+
+def cmd_build(args) -> int:
+    """Python needs no compile; validate the engine + variant instead
+    (the useful part of ``pio build``)."""
+    engine, params, engine_id, _ = _resolve(args)
+    print(
+        f"Engine {engine_id} OK: "
+        f"{len(engine.algorithm_classes)} algorithm class(es), "
+        f"{len(params.algorithms)} configured"
+    )
+    return 0
+
+
+def cmd_train(args) -> int:
+    from predictionio_tpu.core.engine import WorkflowParams
+    from predictionio_tpu.core.workflow import run_train
+
+    engine, params, engine_id, variant = _resolve(args)
+    workflow = WorkflowParams(
+        batch=args.batch or "",
+        save_model=not args.no_save_model,
+        skip_sanity_check=args.skip_sanity_check,
+        stop_after_read=args.stop_after_read,
+        stop_after_prepare=args.stop_after_prepare,
+    )
+    instance_id = run_train(
+        engine,
+        params,
+        engine_id=engine_id,
+        engine_variant=variant,
+        engine_factory=args.engine or "",
+        workflow=workflow,
+        ctx=_mesh_ctx(args),
+    )
+    print(f"Training completed. Engine instance: {instance_id}")
+    return 0
+
+
+def cmd_eval(args) -> int:
+    from predictionio_tpu.core.registry import resolve_engine_factory
+    from predictionio_tpu.core.workflow import run_evaluation
+
+    factory = resolve_engine_factory(args.evaluation)
+    evaluation = factory() if callable(factory) else factory
+    instance_id, result = run_evaluation(
+        evaluation, batch=args.batch or "", ctx=_mesh_ctx(args)
+    )
+    print(result.to_one_liner())
+    print(f"Evaluation instance: {instance_id}")
+    return 0
+
+
+def cmd_deploy(args) -> int:
+    from predictionio_tpu.serving.engine_server import EngineServer
+
+    engine, params, engine_id, variant = _resolve(args)
+    feedback_app_id = None
+    if args.feedback:
+        from predictionio_tpu.data.storage import get_storage
+
+        app = get_storage().get_meta_data_apps().get_by_name(
+            args.event_server_app or ""
+        )
+        if app is None:
+            raise SystemExit(
+                "error: --feedback requires --event-server-app <existing app>"
+            )
+        feedback_app_id = app.id
+    server = EngineServer(
+        engine,
+        params,
+        engine_id=engine_id,
+        engine_variant=variant,
+        ctx=_mesh_ctx(args),
+        feedback=args.feedback,
+        feedback_app_id=feedback_app_id,
+    )
+    http = server.serve(host=args.ip, port=args.port)
+    print(f"Engine server is listening on {args.ip}:{http.port}")
+    try:
+        http.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_undeploy(args) -> int:
+    import urllib.request
+
+    url = f"http://{args.ip}:{args.port}/stop"
+    try:
+        with urllib.request.urlopen(
+            urllib.request.Request(url, method="POST"), timeout=10
+        ) as resp:
+            print(resp.read().decode())
+    except Exception as e:  # noqa: BLE001
+        print(f"Undeploy failed: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_eventserver(args) -> int:
+    from predictionio_tpu.serving.event_server import create_event_server
+
+    http = create_event_server(
+        host=args.ip, port=args.port, stats=args.stats
+    )
+    print(f"Event server is listening on {args.ip}:{http.port}")
+    try:
+        http.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_dashboard(args) -> int:
+    from predictionio_tpu.serving.dashboard import create_dashboard
+
+    http = create_dashboard(host=args.ip, port=args.port)
+    print(f"Dashboard is listening on {args.ip}:{http.port}")
+    try:
+        http.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_adminserver(args) -> int:
+    from predictionio_tpu.serving.admin import create_admin_server
+
+    http = create_admin_server(host=args.ip, port=args.port)
+    print(f"Admin server is listening on {args.ip}:{http.port}")
+    try:
+        http.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_export(args) -> int:
+    """Events → JSON lines (reference export/EventsToFile.scala:40-104)."""
+    from predictionio_tpu.data.store import EventStore
+
+    store = EventStore()
+    n = 0
+    with open(args.output, "w") as f:
+        for event in store.find(args.app_name, channel_name=args.channel):
+            f.write(json.dumps(event.to_json_dict()) + "\n")
+            n += 1
+    print(f"Exported {n} events to {args.output}.")
+    return 0
+
+
+def cmd_import(args) -> int:
+    """JSON lines → events (reference imprt/FileToEvents.scala:41-103)."""
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.store import EventStore
+    from predictionio_tpu.data.storage import get_storage
+
+    store = EventStore()
+    app_id, channel_id = store._resolve(args.app_name, args.channel)
+    events_backend = get_storage().get_events()
+    events_backend.init(app_id, channel_id)
+    batch, n = [], 0
+    with open(args.input) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            batch.append(Event.from_json_dict(json.loads(line)))
+            if len(batch) >= 500:
+                events_backend.insert_batch(batch, app_id, channel_id)
+                n += len(batch)
+                batch = []
+    if batch:
+        events_backend.insert_batch(batch, app_id, channel_id)
+        n += len(batch)
+    print(f"Imported {n} events.")
+    return 0
+
+
+def cmd_template(args) -> int:
+    from predictionio_tpu.core.registry import engine_registry
+    import predictionio_tpu.models  # noqa: F401  (registers built-ins)
+
+    for name in sorted(engine_registry()):
+        print(name)
+    return 0
+
+
+# -- parser ----------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="pio-tpu",
+        description="TPU-native PredictionIO-class ML server console",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("version").set_defaults(func=cmd_version)
+    sub.add_parser("status").set_defaults(func=cmd_status)
+
+    p = sub.add_parser("app")
+    ap = p.add_subparsers(dest="app_command", required=True)
+    new = ap.add_parser("new")
+    new.add_argument("name")
+    new.add_argument("--description")
+    new.add_argument("--access-key", dest="access_key")
+    ap.add_parser("list")
+    for verb in ("show", "delete"):
+        x = ap.add_parser(verb)
+        x.add_argument("name")
+    dd = ap.add_parser("data-delete")
+    dd.add_argument("name")
+    dd.add_argument("--channel")
+    for verb in ("channel-new", "channel-delete"):
+        x = ap.add_parser(verb)
+        x.add_argument("name")
+        x.add_argument("channel")
+    p.set_defaults(func=cmd_app)
+
+    p = sub.add_parser("accesskey")
+    akp = p.add_subparsers(dest="ak_command", required=True)
+    aknew = akp.add_parser("new")
+    aknew.add_argument("app_name")
+    aknew.add_argument("--events", default="")
+    aklist = akp.add_parser("list")
+    aklist.add_argument("app_name", nargs="?")
+    akdel = akp.add_parser("delete")
+    akdel.add_argument("key")
+    p.set_defaults(func=cmd_accesskey)
+
+    def _engine_args(p, mesh=True):
+        p.add_argument("--engine", help="registered name or module:factory")
+        p.add_argument("--variant", help="path to engine.json")
+        p.add_argument("--engine-id", dest="engine_id")
+        p.add_argument("--batch", default="")
+        if mesh:
+            p.add_argument(
+                "--mesh-shape",
+                dest="mesh_shape",
+                help="data,model mesh shape, e.g. 4,2",
+            )
+
+    p = sub.add_parser("build")
+    _engine_args(p, mesh=False)
+    p.set_defaults(func=cmd_build)
+
+    p = sub.add_parser("train")
+    _engine_args(p)
+    p.add_argument("--skip-sanity-check", action="store_true")
+    p.add_argument("--stop-after-read", action="store_true")
+    p.add_argument("--stop-after-prepare", action="store_true")
+    p.add_argument("--no-save-model", action="store_true")
+    p.set_defaults(func=cmd_train)
+
+    p = sub.add_parser("eval")
+    p.add_argument(
+        "evaluation", help="module:attr producing an Evaluation"
+    )
+    p.add_argument("--batch", default="")
+    p.add_argument("--mesh-shape", dest="mesh_shape")
+    p.set_defaults(func=cmd_eval)
+
+    p = sub.add_parser("deploy")
+    _engine_args(p)
+    p.add_argument("--ip", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--feedback", action="store_true")
+    p.add_argument("--event-server-app", dest="event_server_app")
+    p.set_defaults(func=cmd_deploy)
+
+    p = sub.add_parser("undeploy")
+    p.add_argument("--ip", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.set_defaults(func=cmd_undeploy)
+
+    p = sub.add_parser("eventserver")
+    p.add_argument("--ip", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=7070)
+    p.add_argument("--stats", action="store_true")
+    p.set_defaults(func=cmd_eventserver)
+
+    p = sub.add_parser("dashboard")
+    p.add_argument("--ip", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=9000)
+    p.set_defaults(func=cmd_dashboard)
+
+    p = sub.add_parser("adminserver")
+    p.add_argument("--ip", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=7071)
+    p.set_defaults(func=cmd_adminserver)
+
+    p = sub.add_parser("export")
+    p.add_argument("--appname", dest="app_name", required=True)
+    p.add_argument("--channel")
+    p.add_argument("--output", required=True)
+    p.set_defaults(func=cmd_export)
+
+    p = sub.add_parser("import")
+    p.add_argument("--appname", dest="app_name", required=True)
+    p.add_argument("--channel")
+    p.add_argument("--input", required=True)
+    p.set_defaults(func=cmd_import)
+
+    p = sub.add_parser("template")
+    tp = p.add_subparsers(dest="template_command", required=True)
+    tp.add_parser("list")
+    p.set_defaults(func=cmd_template)
+
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    from predictionio_tpu.cli.commands import CommandError
+
+    args = build_parser().parse_args(argv)
+    try:
+        return args.func(args)
+    except CommandError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,221 @@
+"""E-commerce recommendation template — ALS + serve-time business rules.
+
+Capability parity with the reference
+``examples/scala-parallel-ecommercerecommendation`` (train-with-rate-event
+variant, ECommAlgorithm.scala): implicit ALS over view/buy events, and a
+predict path that applies live business rules — exclude items the user
+has already seen (read from the event store *at predict time*, the
+LEventStore pattern), exclude globally unavailable items (latest
+``$set`` of the ``constraint`` entity ``unavailableItems``), and apply
+category / whiteList / blackList filters. Unknown users fall back to
+popularity (interaction-count) ranking.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.core import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    IdentityPreparator,
+    Params,
+    register_engine,
+)
+from predictionio_tpu.core.controller import SanityCheck
+from predictionio_tpu.data.eventframe import Interactions
+from predictionio_tpu.data.store import EventStore
+from predictionio_tpu.ops import similarity
+from predictionio_tpu.ops.als import train_als
+from predictionio_tpu.parallel.mesh import ComputeContext
+from predictionio_tpu.utils.bimap import BiMap
+
+
+@dataclasses.dataclass(frozen=True)
+class ECommDataSourceParams(Params):
+    app_name: str = "MyApp"
+    event_names: tuple[str, ...] = ("view", "buy")
+    item_entity_type: str = "item"
+
+
+@dataclasses.dataclass
+class ECommTrainingData(SanityCheck):
+    interactions: Interactions
+    item_categories: dict[str, list[str]]
+
+    def sanity_check(self) -> None:
+        if self.interactions.nnz == 0:
+            raise ValueError("no view/buy events found")
+
+
+class ECommDataSource(DataSource):
+    params_class = ECommDataSourceParams
+
+    def read_training(self, ctx: ComputeContext) -> ECommTrainingData:
+        p = self.params
+        store = EventStore()
+        frame = store.frame(p.app_name, event_names=list(p.event_names))
+        props = store.aggregate_properties(
+            p.app_name, entity_type=p.item_entity_type
+        )
+        return ECommTrainingData(
+            interactions=frame.to_interactions().dedupe_sum(),
+            item_categories={
+                eid: [str(c) for c in pm.get("categories") or []]
+                for eid, pm in props.items()
+            },
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ECommAlgorithmParams(Params):
+    app_name: str = "MyApp"          # for serve-time event reads
+    seen_events: tuple[str, ...] = ("view", "buy")
+    unseen_only: bool = True
+    rank: int = 16
+    num_iterations: int = 10
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    seed: int = 5
+    block_len: int = 64
+    row_chunk: int = 256
+
+
+@dataclasses.dataclass
+class ECommModel:
+    user_factors: np.ndarray
+    item_factors: np.ndarray
+    user_map: BiMap
+    item_map: BiMap
+    item_categories: dict[str, list[str]]
+    popularity: np.ndarray  # [I] interaction counts (cold-user fallback)
+
+
+class ECommAlgorithm(Algorithm):
+    params_class = ECommAlgorithmParams
+
+    def train(self, ctx: ComputeContext, pd: ECommTrainingData) -> ECommModel:
+        p = self.params
+        inter = pd.interactions
+        factors = train_als(
+            ctx,
+            inter.rows,
+            inter.cols,
+            inter.values,
+            n_users=inter.n_rows,
+            n_items=inter.n_cols,
+            rank=p.rank,
+            iterations=p.num_iterations,
+            reg=p.lambda_,
+            alpha=p.alpha,
+            implicit=True,
+            seed=p.seed,
+            block_len=p.block_len,
+            row_chunk=p.row_chunk,
+        )
+        popularity = np.bincount(
+            inter.cols, weights=inter.values, minlength=inter.n_cols
+        ).astype(np.float32)
+        return ECommModel(
+            user_factors=factors.user_factors,
+            item_factors=factors.item_factors,
+            user_map=inter.entity_map,
+            item_map=inter.target_map,
+            item_categories=pd.item_categories,
+            popularity=popularity,
+        )
+
+    # -- serve-time business rules (reference ECommAlgorithm.predict) -----
+    def _seen_items(self, user: str) -> set[str]:
+        if not self.params.unseen_only:
+            return set()
+        try:
+            events = EventStore().find_by_entity(
+                self.params.app_name,
+                entity_type="user",
+                entity_id=user,
+                event_names=list(self.params.seen_events),
+            )
+        except Exception:  # store unavailable → serve without the rule
+            return set()
+        return {
+            e.target_entity_id for e in events if e.target_entity_id
+        }
+
+    def _unavailable_items(self) -> set[str]:
+        """Latest ``$set`` of constraint entity ``unavailableItems``
+        (reference reads it per-predict so ops can update availability
+        without retraining)."""
+        try:
+            events = EventStore().find_by_entity(
+                self.params.app_name,
+                entity_type="constraint",
+                entity_id="unavailableItems",
+                event_names=["$set"],
+                limit=1,
+                latest=True,
+            )
+        except Exception:
+            return set()
+        if not events:
+            return set()
+        return {
+            str(i) for i in events[0].properties.get("items") or []
+        }
+
+    def predict(self, model: ECommModel, query: dict) -> dict:
+        user = str(query.get("user", ""))
+        num = int(query.get("num", 10))
+        user_idx = model.user_map.get(user, -1)
+        n_items = len(model.item_factors)
+        if user_idx >= 0:
+            qvec = model.user_factors[user_idx][None, :]
+            k = min(1 << max(0, (4 * num - 1)).bit_length(), n_items)
+            scores, cand = similarity.top_k_dot(
+                jnp.asarray(qvec), jnp.asarray(model.item_factors), k
+            )
+            scores, cand = np.asarray(scores)[0], np.asarray(cand)[0]
+        else:
+            # cold user: popularity ranking (reference falls back to
+            # popular-items scoring)
+            order = np.argsort(-model.popularity)
+            cand = order[: min(4 * num, n_items)]
+            scores = model.popularity[cand]
+
+        seen = self._seen_items(user)
+        unavailable = self._unavailable_items()
+        categories = set(query.get("categories") or [])
+        white = set(query.get("whiteList") or [])
+        black = set(query.get("blackList") or [])
+        out = []
+        for score, ci in zip(scores, cand):
+            item = model.item_map.inverse(int(ci))
+            if item in seen or item in unavailable or item in black:
+                continue
+            if white and item not in white:
+                continue
+            if categories and not (
+                categories & set(model.item_categories.get(item, []))
+            ):
+                continue
+            out.append({"item": item, "score": float(score)})
+            if len(out) >= num:
+                break
+        return {"itemScores": out}
+
+
+def ecommerce_engine() -> Engine:
+    return Engine(
+        ECommDataSource,
+        IdentityPreparator,
+        {"ecomm": ECommAlgorithm},
+        FirstServing,
+    )
+
+
+register_engine("ecommerce", ecommerce_engine)

@@ -1,0 +1,65 @@
+"""Evaluation dashboard (reference tools/.../dashboard/Dashboard.scala:44-158,
+default port 9000): lists completed evaluation instances with their
+metric results; per-instance drill-down renders the stored HTML report.
+"""
+
+from __future__ import annotations
+
+import html
+
+from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.serving.http import (
+    HTTPError,
+    HTTPServer,
+    Request,
+    Response,
+    Router,
+)
+
+
+class Dashboard:
+    def __init__(self, storage: Storage | None = None):
+        self._storage = storage or get_storage()
+        self.router = Router()
+        self.router.route("GET", "/", self._index)
+        self.router.route("GET", "/engine_instances/<iid>", self._detail)
+
+    def _index(self, request: Request) -> Response:
+        instances = (
+            self._storage.get_meta_data_evaluation_instances().get_completed()
+        )
+        rows = "".join(
+            f"<tr><td><a href='/engine_instances/{i.id}'>{i.id[:8]}</a></td>"
+            f"<td>{html.escape(i.evaluation_class)}</td>"
+            f"<td>{i.start_time.isoformat()}</td>"
+            f"<td>{html.escape(i.evaluator_results)}</td></tr>"
+            for i in instances
+        )
+        body = (
+            "<html><head><title>PredictionIO-TPU Dashboard</title></head>"
+            "<body><h1>Completed Evaluations</h1>"
+            "<table border='1'><tr><th>id</th><th>evaluation</th>"
+            f"<th>started</th><th>result</th></tr>{rows}</table>"
+            "</body></html>"
+        )
+        return Response(200, body, content_type="text/html")
+
+    def _detail(self, request: Request) -> Response:
+        iid = request.path_params["iid"]
+        inst = self._storage.get_meta_data_evaluation_instances().get(iid)
+        if inst is None:
+            raise HTTPError(404, "evaluation instance not found")
+        body = (
+            f"<html><body><h1>Evaluation {inst.id}</h1>"
+            f"<p>{html.escape(inst.evaluator_results)}</p>"
+            f"{inst.evaluator_results_html}"
+            f"<h2>JSON</h2><pre>{html.escape(inst.evaluator_results_json)}"
+            "</pre></body></html>"
+        )
+        return Response(200, body, content_type="text/html")
+
+
+def create_dashboard(
+    host: str = "0.0.0.0", port: int = 9000, storage: Storage | None = None
+) -> HTTPServer:
+    return HTTPServer(Dashboard(storage).router, host=host, port=port)

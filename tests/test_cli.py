@@ -1,0 +1,276 @@
+"""CLI console tests (reference console/Console.scala command tree),
+plus dashboard and admin server REST."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.cli.main import main
+from predictionio_tpu.data import DataMap, Event
+
+
+@pytest.fixture()
+def cli(memory_storage, capsys):
+    """Run the CLI against the process-default (memory) storage."""
+
+    def run(*argv):
+        code = main(list(argv))
+        out = capsys.readouterr()
+        return code, out.out, out.err
+
+    return run
+
+
+class TestAppCommands:
+    def test_app_lifecycle(self, cli, memory_storage):
+        code, out, _ = cli("app", "new", "myapp", "--description", "d")
+        assert code == 0 and "Access Key:" in out
+        code, out, _ = cli("app", "list")
+        assert "myapp" in out
+        code, out, _ = cli("app", "show", "myapp")
+        info = json.loads(out)
+        assert info["name"] == "myapp" and len(info["accessKeys"]) == 1
+        # duplicate rejected
+        code, _, err = cli("app", "new", "myapp")
+        assert code == 1 and "already exists" in err
+        code, out, _ = cli("app", "delete", "myapp")
+        assert code == 0
+        code, out, _ = cli("app", "list")
+        assert "myapp" not in out
+
+    def test_channels(self, cli, memory_storage):
+        cli("app", "new", "chapp")
+        code, out, _ = cli("app", "channel-new", "chapp", "ch1")
+        assert code == 0
+        code, out, _ = cli("app", "show", "chapp")
+        assert json.loads(out)["channels"][0]["name"] == "ch1"
+        code, _, err = cli("app", "channel-new", "chapp", "bad name!")
+        assert code == 1
+        code, out, _ = cli("app", "channel-delete", "chapp", "ch1")
+        assert code == 0
+
+    def test_accesskey(self, cli, memory_storage):
+        cli("app", "new", "akapp")
+        code, out, _ = cli(
+            "accesskey", "new", "akapp", "--events", "view,buy"
+        )
+        assert code == 0
+        key = out.strip().split(": ")[1]
+        code, out, _ = cli("accesskey", "list", "akapp")
+        assert key in out and "view,buy" in out
+        code, out, _ = cli("accesskey", "delete", key)
+        assert code == 0
+
+    def test_data_delete(self, cli, memory_storage):
+        cli("app", "new", "ddapp")
+        app = memory_storage.get_meta_data_apps().get_by_name("ddapp")
+        memory_storage.get_events().insert(
+            Event(event="view", entity_type="u", entity_id="1"), app.id
+        )
+        code, _, _ = cli("app", "data-delete", "ddapp")
+        assert code == 0
+        assert list(memory_storage.get_events().find(app.id)) == []
+
+
+class TestStatusVersionTemplates:
+    def test_version(self, cli):
+        code, out, _ = cli("version")
+        assert code == 0 and out.strip()
+
+    def test_status_ok(self, cli, memory_storage):
+        code, out, _ = cli("status")
+        assert code == 0
+        assert "all ready to go" in out
+
+    def test_template_list(self, cli):
+        code, out, _ = cli("template", "list")
+        assert code == 0
+        for name in (
+            "classification",
+            "recommendation",
+            "similarproduct",
+            "ecommerce",
+        ):
+            assert name in out
+
+
+class TestBuildTrainExportImport:
+    def _seed(self, cli, storage):
+        cli("app", "new", "clfapp")
+        app = storage.get_meta_data_apps().get_by_name("clfapp")
+        events = storage.get_events()
+        rng = np.random.default_rng(0)
+        for i in range(30):
+            label = i % 2
+            base = [8.0, 1.0, 1.0] if label == 0 else [1.0, 1.0, 8.0]
+            f = np.clip(np.asarray(base) + rng.poisson(1.0, 3), 0, None)
+            events.insert(
+                Event(
+                    event="$set",
+                    entity_type="user",
+                    entity_id=f"u{i}",
+                    properties=DataMap(
+                        {
+                            "attr0": float(f[0]),
+                            "attr1": float(f[1]),
+                            "attr2": float(f[2]),
+                            "plan": str(label),
+                        }
+                    ),
+                ),
+                app.id,
+            )
+
+    def test_build_validates_variant(self, cli, tmp_path):
+        variant = tmp_path / "engine.json"
+        variant.write_text(
+            json.dumps(
+                {
+                    "id": "clf-test",
+                    "engineFactory": "classification",
+                    "datasource": {"params": {"app_name": "clfapp"}},
+                    "algorithms": [
+                        {"name": "naive", "params": {"lambda_": 0.5}}
+                    ],
+                }
+            )
+        )
+        code, out, _ = cli("build", "--variant", str(variant))
+        assert code == 0 and "OK" in out
+
+    def test_build_rejects_bad_params(self, cli, tmp_path):
+        variant = tmp_path / "engine.json"
+        variant.write_text(
+            json.dumps(
+                {
+                    "engineFactory": "classification",
+                    "algorithms": [
+                        {"name": "naive", "params": {"lambdaaa": 0.5}}
+                    ],
+                }
+            )
+        )
+        with pytest.raises(Exception, match="unknown params"):
+            cli("build", "--variant", str(variant))
+
+    def test_train_via_cli_and_variant(self, cli, memory_storage, tmp_path):
+        self._seed(cli, memory_storage)
+        variant = tmp_path / "engine.json"
+        variant.write_text(
+            json.dumps(
+                {
+                    "id": "clf-cli",
+                    "engineFactory": "classification",
+                    "datasource": {"params": {"app_name": "clfapp"}},
+                }
+            )
+        )
+        code, out, _ = cli("train", "--variant", str(variant))
+        assert code == 0 and "Training completed" in out
+        insts = memory_storage.get_meta_data_engine_instances().get_all()
+        assert insts[0].engine_id == "clf-cli"
+        assert insts[0].status == "COMPLETED"
+
+    def test_export_import_roundtrip(self, cli, memory_storage, tmp_path):
+        self._seed(cli, memory_storage)
+        out_file = tmp_path / "events.jsonl"
+        code, out, _ = cli(
+            "export", "--appname", "clfapp", "--output", str(out_file)
+        )
+        assert code == 0 and "Exported 30" in out
+        cli("app", "new", "copyapp")
+        code, out, _ = cli(
+            "import",
+            "--appname",
+            "copyapp",
+            "--input",
+            str(out_file),
+        )
+        assert code == 0 and "Imported 30" in out
+        app = memory_storage.get_meta_data_apps().get_by_name("copyapp")
+        assert len(list(memory_storage.get_events().find(app.id))) == 30
+
+
+def _call(url, method="GET", body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            ct = resp.headers.get("Content-Type", "")
+            raw = resp.read()
+            return resp.status, (
+                json.loads(raw) if "json" in ct else raw.decode()
+            )
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+class TestAdminServer:
+    def test_app_rest(self, memory_storage):
+        from predictionio_tpu.serving.admin import create_admin_server
+
+        http = create_admin_server(
+            host="127.0.0.1", port=0, storage=memory_storage
+        )
+        http.start()
+        base = f"http://127.0.0.1:{http.port}"
+        try:
+            assert _call(f"{base}/")[1] == {"status": "alive"}
+            status, body = _call(
+                f"{base}/cmd/app", "POST", {"name": "adminapp"}
+            )
+            assert status == 201 and body["accessKey"]
+            status, body = _call(f"{base}/cmd/app")
+            assert [a["name"] for a in body] == ["adminapp"]
+            # duplicate → 409
+            status, _ = _call(
+                f"{base}/cmd/app", "POST", {"name": "adminapp"}
+            )
+            assert status == 409
+            status, _ = _call(f"{base}/cmd/app/adminapp/data", "DELETE")
+            assert status == 200
+            status, _ = _call(f"{base}/cmd/app/adminapp", "DELETE")
+            assert status == 200
+            status, _ = _call(f"{base}/cmd/app/nope", "DELETE")
+            assert status == 404
+        finally:
+            http.shutdown()
+
+
+class TestDashboard:
+    def test_lists_completed_evaluations(self, memory_storage):
+        import datetime as dt
+
+        from predictionio_tpu.data.storage import EvaluationInstance
+        from predictionio_tpu.serving.dashboard import create_dashboard
+
+        memory_storage.get_meta_data_evaluation_instances().insert(
+            EvaluationInstance(
+                id="eval1",
+                status="EVALCOMPLETED",
+                start_time=dt.datetime.now(dt.timezone.utc),
+                end_time=dt.datetime.now(dt.timezone.utc),
+                evaluation_class="MyEval",
+                evaluator_results="[Metric] best: 0.9",
+                evaluator_results_html="<table><tr><td>0.9</td></tr></table>",
+                evaluator_results_json='{"bestScore": 0.9}',
+            )
+        )
+        http = create_dashboard(
+            host="127.0.0.1", port=0, storage=memory_storage
+        )
+        http.start()
+        base = f"http://127.0.0.1:{http.port}"
+        try:
+            status, body = _call(f"{base}/")
+            assert status == 200
+            assert "MyEval" in body and "eval1"[:8] in body
+            status, body = _call(f"{base}/engine_instances/eval1")
+            assert status == 200 and "0.9" in body
+            status, _ = _call(f"{base}/engine_instances/nope")
+            assert status == 404
+        finally:
+            http.shutdown()

@@ -1,0 +1,236 @@
+"""Similar-product + e-commerce template tests (reference
+examples/scala-parallel-similarproduct multi variant +
+scala-parallel-ecommercerecommendation behavior)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core import EngineParams
+from predictionio_tpu.core.workflow import load_deployment, run_train
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import App
+from predictionio_tpu.models.ecommerce import (
+    ECommAlgorithmParams,
+    ECommDataSourceParams,
+    ecommerce_engine,
+)
+from predictionio_tpu.models.similarproduct import (
+    SimilarALSParams,
+    SimilarDataSourceParams,
+    similarproduct_engine,
+)
+from predictionio_tpu.parallel.mesh import ComputeContext
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return ComputeContext.create(batch="tpl-test")
+
+
+def _seed(storage, app_name, n_users=24, n_items=16):
+    """Two taste clusters + item categories + like events."""
+    app_id = storage.get_meta_data_apps().insert(App(id=0, name=app_name))
+    events = storage.get_events()
+    events.init(app_id)
+    rng = np.random.default_rng(1)
+    for i in range(n_items):
+        events.insert(
+            Event(
+                event="$set",
+                entity_type="item",
+                entity_id=f"i{i}",
+                properties=DataMap(
+                    {"categories": ["even" if i % 2 == 0 else "odd"]}
+                ),
+            ),
+            app_id,
+        )
+    for u in range(n_users):
+        cluster = [i for i in range(n_items) if i % 2 == u % 2]
+        for i in rng.choice(cluster, 6, replace=False):
+            events.insert(
+                Event(
+                    event="view",
+                    entity_type="user",
+                    entity_id=f"u{u}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{i}",
+                ),
+                app_id,
+            )
+        for i in rng.choice(cluster, 2, replace=False):
+            events.insert(
+                Event(
+                    event="like" if app_name == "simapp" else "buy",
+                    entity_type="user",
+                    entity_id=f"u{u}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{i}",
+                ),
+                app_id,
+            )
+    return app_id
+
+
+_ALS_SMALL = dict(
+    rank=8, num_iterations=6, alpha=4.0, block_len=8, row_chunk=8
+)
+
+
+class TestSimilarProduct:
+    def _params(self, multi=False):
+        algos = [("als", SimilarALSParams(event_name="view", **_ALS_SMALL))]
+        if multi:
+            algos.append(
+                ("als", SimilarALSParams(event_name="like", **_ALS_SMALL))
+            )
+        return EngineParams(
+            data_source=("view", SimilarDataSourceParams(app_name="simapp")),
+            algorithms=algos,
+        )
+
+    def test_similar_items_same_cluster(self, ctx, memory_storage):
+        _seed(memory_storage, "simapp")
+        engine = similarproduct_engine()
+        run_train(
+            engine, self._params(), engine_id="sim", ctx=ctx,
+            storage=memory_storage,
+        )
+        _, algos, models, serving = load_deployment(
+            engine, self._params(), engine_id="sim", ctx=ctx,
+            storage=memory_storage,
+        )
+        q = {"items": ["i0"], "num": 5}
+        result = serving.serve(
+            q, [a.predict(m, q) for a, m in zip(algos, models)]
+        )
+        items = [s["item"] for s in result["itemScores"]]
+        assert len(items) == 5
+        assert "i0" not in items  # query item excluded
+        even_hits = sum(1 for it in items if int(it[1:]) % 2 == 0)
+        assert even_hits >= 4
+
+    def test_filters(self, ctx, memory_storage):
+        _seed(memory_storage, "simapp")
+        engine = similarproduct_engine()
+        run_train(
+            engine, self._params(), engine_id="sim", ctx=ctx,
+            storage=memory_storage,
+        )
+        _, algos, models, serving = load_deployment(
+            engine, self._params(), engine_id="sim", ctx=ctx,
+            storage=memory_storage,
+        )
+        algo, model = algos[0], models[0]
+        # category filter
+        r = algo.predict(
+            model, {"items": ["i0"], "num": 4, "categories": ["odd"]}
+        )
+        assert all(
+            int(s["item"][1:]) % 2 == 1 for s in r["itemScores"]
+        )
+        # blackList
+        r = algo.predict(
+            model, {"items": ["i0"], "num": 4, "blackList": ["i2", "i4"]}
+        )
+        assert not {"i2", "i4"} & {s["item"] for s in r["itemScores"]}
+        # whiteList
+        r = algo.predict(
+            model, {"items": ["i0"], "num": 4, "whiteList": ["i6", "i8"]}
+        )
+        assert {s["item"] for s in r["itemScores"]} <= {"i6", "i8"}
+        # unknown item → empty
+        assert algo.predict(model, {"items": ["zz"], "num": 3}) == {
+            "itemScores": []
+        }
+
+    def test_multi_algorithm_combines(self, ctx, memory_storage):
+        _seed(memory_storage, "simapp")
+        engine = similarproduct_engine()
+        params = self._params(multi=True)
+        run_train(
+            engine, params, engine_id="sim2", ctx=ctx,
+            storage=memory_storage,
+        )
+        _, algos, models, serving = load_deployment(
+            engine, params, engine_id="sim2", ctx=ctx,
+            storage=memory_storage,
+        )
+        assert len(algos) == 2
+        q = {"items": ["i0"], "num": 5}
+        result = serving.serve(
+            q, [a.predict(m, q) for a, m in zip(algos, models)]
+        )
+        assert len(result["itemScores"]) == 5
+
+
+class TestECommerce:
+    def _params(self):
+        return EngineParams(
+            data_source=("", ECommDataSourceParams(app_name="ecomapp")),
+            algorithms=[
+                (
+                    "ecomm",
+                    ECommAlgorithmParams(app_name="ecomapp", **_ALS_SMALL),
+                )
+            ],
+        )
+
+    @pytest.fixture()
+    def deployed(self, ctx, memory_storage):
+        app_id = _seed(memory_storage, "ecomapp")
+        engine = ecommerce_engine()
+        run_train(
+            engine, self._params(), engine_id="ecom", ctx=ctx,
+            storage=memory_storage,
+        )
+        _, algos, models, _ = load_deployment(
+            engine, self._params(), engine_id="ecom", ctx=ctx,
+            storage=memory_storage,
+        )
+        return app_id, algos[0], models[0], memory_storage
+
+    def test_seen_items_excluded(self, deployed):
+        app_id, algo, model, storage = deployed
+        seen = {
+            e.target_entity_id
+            for e in storage.get_events().find(
+                app_id, entity_id="u0", event_names=["view", "buy"]
+            )
+        }
+        r = algo.predict(model, {"user": "u0", "num": 6})
+        recommended = {s["item"] for s in r["itemScores"]}
+        assert recommended
+        assert not (recommended & seen)
+
+    def test_unavailable_items_constraint_live(self, deployed):
+        app_id, algo, model, storage = deployed
+        r1 = algo.predict(model, {"user": "u0", "num": 4})
+        top = r1["itemScores"][0]["item"]
+        # ops marks the top item unavailable — no retrain needed
+        storage.get_events().insert(
+            Event(
+                event="$set",
+                entity_type="constraint",
+                entity_id="unavailableItems",
+                properties=DataMap({"items": [top]}),
+            ),
+            app_id,
+        )
+        r2 = algo.predict(model, {"user": "u0", "num": 4})
+        assert top not in {s["item"] for s in r2["itemScores"]}
+
+    def test_cold_user_popularity_fallback(self, deployed):
+        _app_id, algo, model, _storage = deployed
+        r = algo.predict(model, {"user": "stranger", "num": 5})
+        assert len(r["itemScores"]) == 5
+        scores = [s["score"] for s in r["itemScores"]]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_category_filter(self, deployed):
+        _app_id, algo, model, _storage = deployed
+        r = algo.predict(
+            model, {"user": "u1", "num": 4, "categories": ["odd"]}
+        )
+        assert r["itemScores"]
+        assert all(int(s["item"][1:]) % 2 == 1 for s in r["itemScores"])
