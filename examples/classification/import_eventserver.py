@@ -1,0 +1,36 @@
+"""Seed the classification quickstart with $set attribute events
+(counterpart of the reference's
+examples/scala-parallel-classification/*/data/import_eventserver.py)."""
+
+import argparse
+import random
+
+from predictionio_tpu.client import EventClient
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--access-key", required=True)
+    parser.add_argument("--url", default="http://127.0.0.1:7070")
+    parser.add_argument("--n", type=int, default=100)
+    args = parser.parse_args()
+
+    client = EventClient(args.access_key, args.url)
+    random.seed(7)
+    for i in range(args.n):
+        label = i % 2
+        base = (8.0, 1.0, 1.0) if label == 0 else (1.0, 1.0, 8.0)
+        client.set_user(
+            f"u{i}",
+            properties={
+                "attr0": base[0] + random.random(),
+                "attr1": base[1] + random.random(),
+                "attr2": base[2] + random.random(),
+                "plan": str(label),
+            },
+        )
+    print(f"{args.n} users imported.")
+
+
+if __name__ == "__main__":
+    main()

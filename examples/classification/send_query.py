@@ -1,0 +1,24 @@
+"""Query the deployed classifier: predicts the plan label for a
+feature vector."""
+
+import argparse
+import json
+
+from predictionio_tpu.client import EngineClient
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--url", default="http://127.0.0.1:8000")
+    parser.add_argument(
+        "--features", default="9.0,1.0,0.5",
+        help="comma-separated attr values",
+    )
+    args = parser.parse_args()
+    features = [float(x) for x in args.features.split(",")]
+    result = EngineClient(args.url).send_query({"features": features})
+    print(json.dumps(result, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,22 @@
+"""Query personalized recommendations with business rules applied."""
+
+import argparse
+import json
+
+from predictionio_tpu.client import EngineClient
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--url", default="http://127.0.0.1:8000")
+    parser.add_argument("--user", default="u1")
+    parser.add_argument("--num", type=int, default=4)
+    args = parser.parse_args()
+    result = EngineClient(args.url).send_query(
+        {"user": args.user, "num": args.num}
+    )
+    print(json.dumps(result, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""Seed the recommendation quickstart with rate events
+(counterpart of the reference's
+examples/scala-parallel-recommendation/*/data/import_eventserver.py).
+
+Usage:
+    pio-tpu app new MyRecApp          # note the access key
+    pio-tpu eventserver &             # default :7070
+    python import_eventserver.py --access-key <KEY> [--url http://...:7070]
+"""
+
+import argparse
+import random
+
+from predictionio_tpu.client import EventClient
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--access-key", required=True)
+    parser.add_argument("--url", default="http://127.0.0.1:7070")
+    parser.add_argument("--users", type=int, default=100)
+    parser.add_argument("--items", type=int, default=50)
+    args = parser.parse_args()
+
+    client = EventClient(args.access_key, args.url)
+    random.seed(3)
+    count = 0
+    for u in range(args.users):
+        # two taste clusters so recommendations are assertable
+        liked = [i for i in range(args.items) if i % 2 == u % 2]
+        for i in random.sample(liked, min(10, len(liked))):
+            client.record_user_action_on_item(
+                "rate",
+                f"u{u}",
+                f"i{i}",
+                properties={"rating": float(random.randint(3, 5))},
+            )
+            count += 1
+    print(f"{count} events imported.")
+
+
+if __name__ == "__main__":
+    main()

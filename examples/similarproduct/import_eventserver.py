@@ -1,0 +1,41 @@
+"""Seed the similar-product quickstart: item $set properties with
+categories plus view/like events (counterpart of the reference's
+examples/scala-parallel-similarproduct/*/data/import_eventserver.py)."""
+
+import argparse
+import random
+
+from predictionio_tpu.client import EventClient
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--access-key", required=True)
+    parser.add_argument("--url", default="http://127.0.0.1:7070")
+    parser.add_argument("--users", type=int, default=50)
+    parser.add_argument("--items", type=int, default=30)
+    args = parser.parse_args()
+
+    client = EventClient(args.access_key, args.url)
+    random.seed(11)
+    for i in range(args.items):
+        client.set_item(
+            f"i{i}",
+            properties={
+                "categories": ["even" if i % 2 == 0 else "odd"]
+            },
+        )
+    count = 0
+    for u in range(args.users):
+        cluster = [i for i in range(args.items) if i % 2 == u % 2]
+        for i in random.sample(cluster, min(8, len(cluster))):
+            client.record_user_action_on_item("view", f"u{u}", f"i{i}")
+            count += 1
+        for i in random.sample(cluster, min(2, len(cluster))):
+            client.record_user_action_on_item("like", f"u{u}", f"i{i}")
+            count += 1
+    print(f"{args.items} items + {count} events imported.")
+
+
+if __name__ == "__main__":
+    main()

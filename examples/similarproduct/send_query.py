@@ -1,0 +1,23 @@
+"""Query items similar to the given items, with optional filters."""
+
+import argparse
+import json
+
+from predictionio_tpu.client import EngineClient
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--url", default="http://127.0.0.1:8000")
+    parser.add_argument("--items", default="i0", help="comma-separated")
+    parser.add_argument("--num", type=int, default=4)
+    parser.add_argument("--categories", default=None)
+    args = parser.parse_args()
+    query = {"items": args.items.split(","), "num": args.num}
+    if args.categories:
+        query["categories"] = args.categories.split(",")
+    print(json.dumps(EngineClient(args.url).send_query(query), indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Build the native event-log library. Invoked automatically by
+# predictionio_tpu/data/storage/eventlog.py on first use.
+set -euo pipefail
+cd "$(dirname "$0")"
+g++ -O3 -std=c++17 -shared -fPIC -o libpio_eventlog.so eventlog.cc
+echo "built $(pwd)/libpio_eventlog.so"

@@ -1,0 +1,329 @@
+// Native event log — append-only binary event store with a persistent
+// string dictionary (interner) and columnar scans.
+//
+// Role in the framework: the high-write-throughput event store the
+// reference delegates to HBase (data/.../storage/hbase, SURVEY.md §2.4)
+// and the native data-loader path: scans return *columnar* arrays of
+// interned ids — directly consumable as dense matrix indices — instead
+// of per-event objects, solving the string-id→dense-index bottleneck at
+// scale (SURVEY.md §7 hard-part (b): BiMap.collect "won't fly").
+//
+// Files per log directory:
+//   dict.bin — length-prefixed strings; position = interned id
+//   log.bin  — framed records (see layout below)
+//
+// Record layout (little-endian):
+//   u32 total_len (bytes after this field)
+//   u8  kind      (1 = put, 2 = delete-tombstone)
+//   f64 event_time, f64 creation_time
+//   u32 event, u32 entity_type, u32 entity_id          (dict ids)
+//   i32 target_entity_type, i32 target_entity_id       (-1 = absent)
+//   u32 id_len,   bytes event_id
+//   u32 blob_len, bytes blob (JSON: properties/tags/prId)
+//
+// Thread-safety: callers serialize appends (the Python wrapper holds a
+// lock); scans open their own read handle on the finished prefix.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+struct Log {
+  std::string dir;
+  FILE* log_file = nullptr;   // append handle
+  FILE* dict_file = nullptr;  // append handle
+  std::unordered_map<std::string, uint32_t> dict;
+  std::vector<std::string> strings;
+
+  std::string log_path() const { return dir + "/log.bin"; }
+  std::string dict_path() const { return dir + "/dict.bin"; }
+};
+
+bool load_dict(Log* log) {
+  FILE* f = std::fopen(log->dict_path().c_str(), "rb");
+  if (f == nullptr) return true;  // fresh log
+  for (;;) {
+    uint32_t len;
+    if (std::fread(&len, 4, 1, f) != 1) break;
+    std::string s(len, '\0');
+    if (len > 0 && std::fread(&s[0], 1, len, f) != len) break;
+    log->dict.emplace(s, static_cast<uint32_t>(log->strings.size()));
+    log->strings.push_back(std::move(s));
+  }
+  std::fclose(f);
+  return true;
+}
+
+// Columnar scan result; freed as one unit by pio_result_free.
+struct ScanResult {
+  uint64_t n = 0;
+  double* event_time = nullptr;
+  double* creation_time = nullptr;
+  uint32_t* event = nullptr;
+  uint32_t* entity_type = nullptr;
+  uint32_t* entity_id = nullptr;
+  int32_t* target_entity_type = nullptr;
+  int32_t* target_entity_id = nullptr;
+  // per-record varlen section: [u32 id_len][id][u32 blob_len][blob]
+  uint8_t* varlen = nullptr;
+  uint64_t varlen_len = 0;
+};
+
+struct Rec {
+  uint8_t kind;
+  double etime, ctime;
+  uint32_t ev, ety, eid;
+  int32_t tty, tid;
+  const uint8_t* id;
+  uint32_t id_len;
+  const uint8_t* blob;
+  uint32_t blob_len;
+};
+
+bool parse_record(const uint8_t* p, const uint8_t* end, Rec* r,
+                  const uint8_t** next) {
+  if (p + 4 > end) return false;
+  uint32_t total;
+  std::memcpy(&total, p, 4);
+  const uint8_t* body = p + 4;
+  if (body + total > end) return false;  // torn tail write — stop
+  const uint8_t* q = body;
+  r->kind = *q++;
+  std::memcpy(&r->etime, q, 8); q += 8;
+  std::memcpy(&r->ctime, q, 8); q += 8;
+  std::memcpy(&r->ev, q, 4); q += 4;
+  std::memcpy(&r->ety, q, 4); q += 4;
+  std::memcpy(&r->eid, q, 4); q += 4;
+  std::memcpy(&r->tty, q, 4); q += 4;
+  std::memcpy(&r->tid, q, 4); q += 4;
+  std::memcpy(&r->id_len, q, 4); q += 4;
+  r->id = q; q += r->id_len;
+  std::memcpy(&r->blob_len, q, 4); q += 4;
+  r->blob = q;
+  *next = body + total;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pio_log_open(const char* dir) {
+  Log* log = new Log();
+  log->dir = dir;
+  if (!load_dict(log)) { delete log; return nullptr; }
+  log->log_file = std::fopen(log->log_path().c_str(), "ab");
+  log->dict_file = std::fopen(log->dict_path().c_str(), "ab");
+  if (log->log_file == nullptr || log->dict_file == nullptr) {
+    if (log->log_file) std::fclose(log->log_file);
+    if (log->dict_file) std::fclose(log->dict_file);
+    delete log;
+    return nullptr;
+  }
+  return log;
+}
+
+void pio_log_close(void* handle) {
+  Log* log = static_cast<Log*>(handle);
+  std::fclose(log->log_file);
+  std::fclose(log->dict_file);
+  delete log;
+}
+
+void pio_log_sync(void* handle) {
+  Log* log = static_cast<Log*>(handle);
+  std::fflush(log->log_file);
+  std::fflush(log->dict_file);
+}
+
+// string → dict id (appending to the persistent dictionary when new)
+uint32_t pio_intern(void* handle, const uint8_t* s, uint32_t len) {
+  Log* log = static_cast<Log*>(handle);
+  std::string key(reinterpret_cast<const char*>(s), len);
+  auto it = log->dict.find(key);
+  if (it != log->dict.end()) return it->second;
+  uint32_t id = static_cast<uint32_t>(log->strings.size());
+  std::fwrite(&len, 4, 1, log->dict_file);
+  std::fwrite(s, 1, len, log->dict_file);
+  std::fflush(log->dict_file);
+  log->dict.emplace(key, id);
+  log->strings.push_back(std::move(key));
+  return id;
+}
+
+uint64_t pio_dict_size(void* handle) {
+  return static_cast<Log*>(handle)->strings.size();
+}
+
+// copy dict string `id` into out (returns its length; out may be null to size)
+uint32_t pio_dict_get(void* handle, uint32_t id, uint8_t* out,
+                      uint32_t out_cap) {
+  Log* log = static_cast<Log*>(handle);
+  if (id >= log->strings.size()) return 0;
+  const std::string& s = log->strings[id];
+  if (out != nullptr) {
+    uint32_t n = s.size() < out_cap ? (uint32_t)s.size() : out_cap;
+    std::memcpy(out, s.data(), n);
+  }
+  return static_cast<uint32_t>(s.size());
+}
+
+int pio_append(void* handle, uint8_t kind, double etime, double ctime,
+               uint32_t ev, uint32_t ety, uint32_t eid, int32_t tty,
+               int32_t tid, const uint8_t* id, uint32_t id_len,
+               const uint8_t* blob, uint32_t blob_len) {
+  Log* log = static_cast<Log*>(handle);
+  uint32_t total = 1 + 8 + 8 + 4 * 5 + 4 + id_len + 4 + blob_len;
+  std::vector<uint8_t> buf(4 + total);
+  uint8_t* q = buf.data();
+  std::memcpy(q, &total, 4); q += 4;
+  *q++ = kind;
+  std::memcpy(q, &etime, 8); q += 8;
+  std::memcpy(q, &ctime, 8); q += 8;
+  std::memcpy(q, &ev, 4); q += 4;
+  std::memcpy(q, &ety, 4); q += 4;
+  std::memcpy(q, &eid, 4); q += 4;
+  std::memcpy(q, &tty, 4); q += 4;
+  std::memcpy(q, &tid, 4); q += 4;
+  std::memcpy(q, &id_len, 4); q += 4;
+  std::memcpy(q, id, id_len); q += id_len;
+  std::memcpy(q, &blob_len, 4); q += 4;
+  std::memcpy(q, blob, blob_len);
+  size_t written = std::fwrite(buf.data(), 1, buf.size(), log->log_file);
+  if (written != buf.size()) return -1;
+  std::fflush(log->log_file);
+  return 0;
+}
+
+// Columnar scan. Filters: time range [t0, t1) with NaN = unbounded;
+// ev_filter: array of allowed event ids (n_ev = 0 → any);
+// ety/eid: -1 = any; tty/tid: -2 = any, -1 = must-be-absent, else match.
+// Delete tombstones suppress matching event ids. include_varlen=0 skips
+// copying ids/blobs (the pure-columnar fast path for training reads).
+ScanResult* pio_scan(void* handle, double t0, double t1,
+                     const uint32_t* ev_filter, uint32_t n_ev,
+                     int64_t ety, int64_t eid, int64_t tty, int64_t tid,
+                     int include_varlen) {
+  Log* log = static_cast<Log*>(handle);
+  std::fflush(log->log_file);
+  FILE* f = std::fopen(log->log_path().c_str(), "rb");
+  ScanResult* res = new ScanResult();
+  if (f == nullptr) return res;
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  std::vector<uint8_t> data(size);
+  if (size > 0 && std::fread(data.data(), 1, size, f) != (size_t)size) {
+    std::fclose(f);
+    return res;
+  }
+  std::fclose(f);
+
+  std::unordered_set<std::string> deleted;
+  const uint8_t* p = data.data();
+  const uint8_t* end = p + data.size();
+  Rec r;
+  const uint8_t* next;
+  // pass 1: tombstones
+  while (parse_record(p, end, &r, &next)) {
+    if (r.kind == 2) {
+      deleted.emplace(reinterpret_cast<const char*>(r.id), r.id_len);
+    }
+    p = next;
+  }
+  std::unordered_set<uint32_t> evs(ev_filter, ev_filter + n_ev);
+
+  std::vector<double> etimes, ctimes;
+  std::vector<uint32_t> evv, etyv, eidv;
+  std::vector<int32_t> ttyv, tidv;
+  std::vector<uint8_t> varlen;
+  p = data.data();
+  while (parse_record(p, end, &r, &next)) {
+    p = next;
+    if (r.kind != 1) continue;
+    if (t0 == t0 && r.etime < t0) continue;  // t0==t0 ⇔ not NaN
+    if (t1 == t1 && r.etime >= t1) continue;
+    if (n_ev > 0 && evs.find(r.ev) == evs.end()) continue;
+    if (ety >= 0 && r.ety != (uint32_t)ety) continue;
+    if (eid >= 0 && r.eid != (uint32_t)eid) continue;
+    if (tty == -1 && r.tty != -1) continue;
+    if (tty >= 0 && r.tty != (int32_t)tty) continue;
+    if (tid == -1 && r.tid != -1) continue;
+    if (tid >= 0 && r.tid != (int32_t)tid) continue;
+    if (!deleted.empty() &&
+        deleted.count(std::string(
+            reinterpret_cast<const char*>(r.id), r.id_len)) > 0) {
+      continue;
+    }
+    etimes.push_back(r.etime);
+    ctimes.push_back(r.ctime);
+    evv.push_back(r.ev);
+    etyv.push_back(r.ety);
+    eidv.push_back(r.eid);
+    ttyv.push_back(r.tty);
+    tidv.push_back(r.tid);
+    if (include_varlen != 0) {
+      size_t off = varlen.size();
+      varlen.resize(off + 4 + r.id_len + 4 + r.blob_len);
+      uint8_t* q = varlen.data() + off;
+      std::memcpy(q, &r.id_len, 4); q += 4;
+      std::memcpy(q, r.id, r.id_len); q += r.id_len;
+      std::memcpy(q, &r.blob_len, 4); q += 4;
+      std::memcpy(q, r.blob, r.blob_len);
+    }
+  }
+
+  res->n = etimes.size();
+  auto copy = [](auto& vec) {
+    using T = typename std::remove_reference<decltype(vec)>::type::value_type;
+    T* out = static_cast<T*>(std::malloc(vec.size() * sizeof(T) + 1));
+    std::memcpy(out, vec.data(), vec.size() * sizeof(T));
+    return out;
+  };
+  res->event_time = copy(etimes);
+  res->creation_time = copy(ctimes);
+  res->event = copy(evv);
+  res->entity_type = copy(etyv);
+  res->entity_id = copy(eidv);
+  res->target_entity_type = copy(ttyv);
+  res->target_entity_id = copy(tidv);
+  res->varlen = copy(varlen);
+  res->varlen_len = varlen.size();
+  return res;
+}
+
+uint64_t pio_result_n(ScanResult* r) { return r->n; }
+double* pio_result_event_time(ScanResult* r) { return r->event_time; }
+double* pio_result_creation_time(ScanResult* r) { return r->creation_time; }
+uint32_t* pio_result_event(ScanResult* r) { return r->event; }
+uint32_t* pio_result_entity_type(ScanResult* r) { return r->entity_type; }
+uint32_t* pio_result_entity_id(ScanResult* r) { return r->entity_id; }
+int32_t* pio_result_target_entity_type(ScanResult* r) {
+  return r->target_entity_type;
+}
+int32_t* pio_result_target_entity_id(ScanResult* r) {
+  return r->target_entity_id;
+}
+uint8_t* pio_result_varlen(ScanResult* r) { return r->varlen; }
+uint64_t pio_result_varlen_len(ScanResult* r) { return r->varlen_len; }
+
+void pio_result_free(ScanResult* r) {
+  std::free(r->event_time);
+  std::free(r->creation_time);
+  std::free(r->event);
+  std::free(r->entity_type);
+  std::free(r->entity_id);
+  std::free(r->target_entity_type);
+  std::free(r->target_entity_id);
+  std::free(r->varlen);
+  delete r;
+}
+
+}  // extern "C"

@@ -1,0 +1,156 @@
+"""Python client SDK — EventClient + EngineClient.
+
+Capability parity with the PredictionIO client SDKs the reference's
+example seed scripts use (``examples/*/data/import_eventserver.py`` /
+``send_query.py``, SURVEY.md §2.8): a thin stdlib-only HTTP client for
+the Event Server (create/get/delete events, ``$set`` helpers, batch)
+and the Engine Server (``send_query``).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Mapping, Sequence
+
+
+class PIOClientError(RuntimeError):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+        self.message = message
+
+
+def _request(
+    url: str, method: str = "GET", body: Any = None, timeout: float = 10.0
+) -> Any:
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if data is not None:
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            raw = resp.read()
+            return json.loads(raw) if raw else None
+    except urllib.error.HTTPError as e:
+        try:
+            message = json.loads(e.read()).get("message", "")
+        except Exception:  # noqa: BLE001
+            message = ""
+        raise PIOClientError(e.code, message) from e
+
+
+class EventClient:
+    """Talks to the Event Server (default :7070)."""
+
+    def __init__(
+        self,
+        access_key: str,
+        url: str = "http://127.0.0.1:7070",
+        channel: str | None = None,
+    ):
+        self._base = url.rstrip("/")
+        self._key = access_key
+        self._channel = channel
+
+    def _qs(self, **extra) -> str:
+        params = {"accessKey": self._key}
+        if self._channel:
+            params["channel"] = self._channel
+        params.update({k: str(v) for k, v in extra.items()})
+        return urllib.parse.urlencode(params)
+
+    def create_event(
+        self,
+        event: str,
+        entity_type: str,
+        entity_id: str,
+        target_entity_type: str | None = None,
+        target_entity_id: str | None = None,
+        properties: Mapping[str, Any] | None = None,
+        event_time: _dt.datetime | str | None = None,
+    ) -> str:
+        body: dict[str, Any] = {
+            "event": event,
+            "entityType": entity_type,
+            "entityId": entity_id,
+        }
+        if target_entity_type is not None:
+            body["targetEntityType"] = target_entity_type
+            body["targetEntityId"] = target_entity_id
+        if properties:
+            body["properties"] = dict(properties)
+        if event_time is not None:
+            body["eventTime"] = (
+                event_time.isoformat()
+                if isinstance(event_time, _dt.datetime)
+                else event_time
+            )
+        out = _request(
+            f"{self._base}/events.json?{self._qs()}", "POST", body
+        )
+        return out["eventId"]
+
+    def create_events(self, events: Sequence[Mapping[str, Any]]) -> list:
+        """Batch insert (≤50 per request); returns per-event statuses."""
+        return _request(
+            f"{self._base}/batch/events.json?{self._qs()}",
+            "POST",
+            list(events),
+        )
+
+    # -- $set sugar (SDK set_user/set_item equivalents) -------------------
+    def set_user(self, uid: str, properties=None, event_time=None) -> str:
+        return self.create_event(
+            "$set", "user", uid, properties=properties, event_time=event_time
+        )
+
+    def set_item(self, iid: str, properties=None, event_time=None) -> str:
+        return self.create_event(
+            "$set", "item", iid, properties=properties, event_time=event_time
+        )
+
+    def record_user_action_on_item(
+        self, action: str, uid: str, iid: str, properties=None,
+        event_time=None,
+    ) -> str:
+        return self.create_event(
+            action,
+            "user",
+            uid,
+            target_entity_type="item",
+            target_entity_id=iid,
+            properties=properties,
+            event_time=event_time,
+        )
+
+    def get_event(self, event_id: str) -> dict:
+        eid = urllib.parse.quote(event_id, safe="")
+        return _request(f"{self._base}/events/{eid}.json?{self._qs()}")
+
+    def delete_event(self, event_id: str) -> None:
+        eid = urllib.parse.quote(event_id, safe="")
+        _request(
+            f"{self._base}/events/{eid}.json?{self._qs()}", "DELETE"
+        )
+
+    def find_events(self, **params) -> list[dict]:
+        return _request(f"{self._base}/events.json?{self._qs(**params)}")
+
+
+class EngineClient:
+    """Talks to the Engine Server (default :8000)."""
+
+    def __init__(self, url: str = "http://127.0.0.1:8000"):
+        self._base = url.rstrip("/")
+
+    def send_query(self, data: Mapping[str, Any], timeout: float = 30.0):
+        return _request(
+            f"{self._base}/queries.json", "POST", dict(data), timeout
+        )
+
+    def status(self) -> dict:
+        return _request(f"{self._base}/")

@@ -171,6 +171,9 @@ class Algorithm(_Controller, Generic[PD, M, Q, P], abc.ABC):
     """
 
     persistence_mode: PersistenceMode = PersistenceMode.AUTO
+    #: optional StepTimer injected by the workflow runtime; algorithms
+    #: may record per-step timings into it during train
+    timer = None
 
     @abc.abstractmethod
     def train(self, ctx: ComputeContext, prepared_data: PD) -> M: ...

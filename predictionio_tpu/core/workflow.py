@@ -34,6 +34,7 @@ from predictionio_tpu.data.storage import (
     get_storage,
 )
 from predictionio_tpu.parallel.mesh import ComputeContext
+from predictionio_tpu.utils.profiling import StepTimer, trace
 
 logger = logging.getLogger(__name__)
 
@@ -79,7 +80,17 @@ def run_train(
         # build algorithm instances once: the SAME objects train and (for
         # MANUAL persistence) save, so trained state is what gets saved
         algorithms = engine.make_algorithms(params)
-        models = engine.train(ctx, params, workflow, algorithms=algorithms)
+        timer = StepTimer()
+        for algo in algorithms:
+            algo.timer = timer
+        with timer.step("train/total"), trace():
+            models = engine.train(
+                ctx, params, workflow, algorithms=algorithms
+            )
+        timer.log_summary(prefix=f"[{engine_id}] ")
+        instance = EngineInstance(
+            **{**instance.__dict__, "env": {"timing": timer.to_json()}}
+        )
         if workflow.save_model:
             blob = serialize_models(instance_id, algorithms, models)
             storage.get_model_data_models().insert(

@@ -123,6 +123,17 @@ def _register_builtins() -> None:
             models=lambda config: localfs.LocalFSModels(config),
         ),
     )
+    # native C++ event log (events only, like the reference's hbase
+    # backend); registered lazily — the .so builds on first client use
+    from predictionio_tpu.data.storage import eventlog
+
+    register_backend(
+        "eventlog",
+        BackendSpec(
+            client=lambda config: eventlog.EventLogEvents(config),
+            events=lambda client: client,
+        ),
+    )
 
 
 _register_builtins()

@@ -1,0 +1,498 @@
+"""Native event-log storage backend (C++ via ctypes).
+
+The framework's native runtime piece: an append-only binary event store
+with a persistent string interner and *columnar* scans, playing the
+role of the reference's HBase event backend (high write throughput,
+time-range scans; data/.../storage/hbase, SURVEY.md §2.4) while also
+being the native data-loader: :meth:`EventLogEvents.interactions`
+returns dense-id COO arrays straight from the C++ scan — no per-event
+Python objects and no host-side re-interning — which is the intended
+training-read path at MovieLens-20M scale (SURVEY.md §7 hard-part (b)).
+
+The shared library builds on demand from ``native/eventlog.cc`` with
+g++ (see native/build.sh).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import datetime as _dt
+import json
+import os
+import struct
+import subprocess
+import threading
+import uuid
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.eventframe import Interactions
+from predictionio_tpu.data.storage.base import EventsBackend
+from predictionio_tpu.utils.bimap import BiMap
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    ))),
+    "native",
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libpio_eventlog.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load_library() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            subprocess.run(
+                ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                 "-o", _LIB_PATH,
+                 os.path.join(_NATIVE_DIR, "eventlog.cc")],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(_LIB_PATH)
+        c = ctypes
+        lib.pio_log_open.restype = c.c_void_p
+        lib.pio_log_open.argtypes = [c.c_char_p]
+        lib.pio_log_close.argtypes = [c.c_void_p]
+        lib.pio_log_sync.argtypes = [c.c_void_p]
+        lib.pio_intern.restype = c.c_uint32
+        lib.pio_intern.argtypes = [c.c_void_p, c.c_char_p, c.c_uint32]
+        lib.pio_dict_size.restype = c.c_uint64
+        lib.pio_dict_size.argtypes = [c.c_void_p]
+        lib.pio_dict_get.restype = c.c_uint32
+        lib.pio_dict_get.argtypes = [
+            c.c_void_p, c.c_uint32, c.c_char_p, c.c_uint32
+        ]
+        lib.pio_append.restype = c.c_int
+        lib.pio_append.argtypes = [
+            c.c_void_p, c.c_uint8, c.c_double, c.c_double,
+            c.c_uint32, c.c_uint32, c.c_uint32, c.c_int32, c.c_int32,
+            c.c_char_p, c.c_uint32, c.c_char_p, c.c_uint32,
+        ]
+        lib.pio_scan.restype = c.c_void_p
+        lib.pio_scan.argtypes = [
+            c.c_void_p, c.c_double, c.c_double,
+            c.POINTER(c.c_uint32), c.c_uint32,
+            c.c_int64, c.c_int64, c.c_int64, c.c_int64, c.c_int,
+        ]
+        for name, rtype in [
+            ("pio_result_n", c.c_uint64),
+            ("pio_result_event_time", c.POINTER(c.c_double)),
+            ("pio_result_creation_time", c.POINTER(c.c_double)),
+            ("pio_result_event", c.POINTER(c.c_uint32)),
+            ("pio_result_entity_type", c.POINTER(c.c_uint32)),
+            ("pio_result_entity_id", c.POINTER(c.c_uint32)),
+            ("pio_result_target_entity_type", c.POINTER(c.c_int32)),
+            ("pio_result_target_entity_id", c.POINTER(c.c_int32)),
+            ("pio_result_varlen", c.POINTER(c.c_uint8)),
+            ("pio_result_varlen_len", c.c_uint64),
+        ]:
+            fn = getattr(lib, name)
+            fn.restype = rtype
+            fn.argtypes = [c.c_void_p]
+        lib.pio_result_free.argtypes = [c.c_void_p]
+        _lib = lib
+        return lib
+
+
+_NAN = float("nan")
+
+
+class _Log:
+    """One (app, channel) log directory."""
+
+    def __init__(self, path: str):
+        self.lib = _load_library()
+        os.makedirs(path, exist_ok=True)
+        self.handle = self.lib.pio_log_open(path.encode())
+        if not self.handle:
+            raise RuntimeError(f"cannot open event log at {path}")
+        self.lock = threading.Lock()
+        # mirror of the persistent dictionary for decode / lookup
+        self.strings: list[str] = []
+        self.ids: dict[str, int] = {}
+        self._refresh_dict()
+
+    def _refresh_dict(self) -> None:
+        size = self.lib.pio_dict_size(self.handle)
+        while len(self.strings) < size:
+            i = len(self.strings)
+            n = self.lib.pio_dict_get(self.handle, i, None, 0)
+            buf = ctypes.create_string_buffer(n)
+            self.lib.pio_dict_get(self.handle, i, buf, n)
+            s = buf.raw[:n].decode()
+            self.ids[s] = i
+            self.strings.append(s)
+
+    def intern(self, s: str) -> int:
+        cached = self.ids.get(s)
+        if cached is not None:
+            return cached
+        raw = s.encode()
+        i = self.lib.pio_intern(self.handle, raw, len(raw))
+        if i == len(self.strings):
+            self.strings.append(s)
+            self.ids[s] = i
+        else:
+            self._refresh_dict()
+        return i
+
+    def lookup(self, s: str) -> int | None:
+        return self.ids.get(s)
+
+    def close(self) -> None:
+        if self.handle:
+            self.lib.pio_log_close(self.handle)
+            self.handle = None
+
+
+class _Scan:
+    """Columnar scan result as numpy views (copied before free)."""
+
+    def __init__(self, lib, ptr):
+        n = lib.pio_result_n(ptr)
+        self.n = n
+
+        def arr(fn, dtype):
+            p = fn(ptr)
+            if n == 0 or not p:
+                return np.zeros(0, dtype)
+            return np.ctypeslib.as_array(p, shape=(n,)).astype(dtype, copy=True)
+
+        self.event_time = arr(lib.pio_result_event_time, np.float64)
+        self.creation_time = arr(lib.pio_result_creation_time, np.float64)
+        self.event = arr(lib.pio_result_event, np.uint32)
+        self.entity_type = arr(lib.pio_result_entity_type, np.uint32)
+        self.entity_id = arr(lib.pio_result_entity_id, np.uint32)
+        self.target_entity_type = arr(
+            lib.pio_result_target_entity_type, np.int32
+        )
+        self.target_entity_id = arr(
+            lib.pio_result_target_entity_id, np.int32
+        )
+        vlen = lib.pio_result_varlen_len(ptr)
+        if vlen:
+            vp = lib.pio_result_varlen(ptr)
+            self.varlen = bytes(
+                np.ctypeslib.as_array(vp, shape=(vlen,))
+            )
+        else:
+            self.varlen = b""
+        lib.pio_result_free(ptr)
+
+    def iter_varlen(self):
+        """Yield (event_id, blob_dict) per record."""
+        buf, off = self.varlen, 0
+        for _ in range(self.n):
+            (id_len,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            event_id = buf[off:off + id_len].decode()
+            off += id_len
+            (blob_len,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            blob = json.loads(buf[off:off + blob_len]) if blob_len else {}
+            off += blob_len
+            yield event_id, blob
+
+
+class EventLogEvents(EventsBackend):
+    """EventsBackend over per-(app, channel) native logs."""
+
+    def __init__(self, config: dict | None = None):
+        config = config or {}
+        self._base = config.get("PATH") or os.path.join(
+            os.environ.get(
+                "PIO_FS_BASEDIR",
+                os.path.join(os.path.expanduser("~"), ".piotpu"),
+            ),
+            "eventlog",
+        )
+        self._logs: dict[tuple[int, int | None], _Log] = {}
+        self._lock = threading.Lock()
+
+    def _dir(self, app_id: int, channel_id: int | None) -> str:
+        name = f"app_{app_id}" + (
+            f"_ch{channel_id}" if channel_id is not None else ""
+        )
+        return os.path.join(self._base, name)
+
+    def _log(self, app_id: int, channel_id: int | None) -> _Log:
+        key = (app_id, channel_id)
+        with self._lock:
+            if key not in self._logs:
+                self._logs[key] = _Log(self._dir(app_id, channel_id))
+            return self._logs[key]
+
+    # -- lifecycle --------------------------------------------------------
+    def init(self, app_id: int, channel_id: int | None = None) -> bool:
+        self._log(app_id, channel_id)
+        return True
+
+    def remove(self, app_id: int, channel_id: int | None = None) -> bool:
+        import shutil
+
+        key = (app_id, channel_id)
+        with self._lock:
+            log = self._logs.pop(key, None)
+        if log is not None:
+            log.close()
+        path = self._dir(app_id, channel_id)
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+            return True
+        return False
+
+    def close(self) -> None:
+        with self._lock:
+            for log in self._logs.values():
+                log.close()
+            self._logs.clear()
+
+    # -- writes -----------------------------------------------------------
+    def insert(
+        self, event: Event, app_id: int, channel_id: int | None = None
+    ) -> str:
+        log = self._log(app_id, channel_id)
+        stamped = event.with_id(event.event_id)
+        blob = json.dumps(
+            {
+                "properties": stamped.properties.to_dict(),
+                "tags": list(stamped.tags),
+                "prId": stamped.pr_id,
+            }
+        ).encode()
+        with log.lock:
+            ev = log.intern(stamped.event)
+            ety = log.intern(stamped.entity_type)
+            eid = log.intern(stamped.entity_id)
+            tty = (
+                log.intern(stamped.target_entity_type)
+                if stamped.target_entity_type is not None
+                else -1
+            )
+            tid = (
+                log.intern(stamped.target_entity_id)
+                if stamped.target_entity_id is not None
+                else -1
+            )
+            rid = stamped.event_id.encode()
+            rc = log.lib.pio_append(
+                log.handle, 1,
+                stamped.event_time.timestamp(),
+                stamped.creation_time.timestamp(),
+                ev, ety, eid, tty, tid, rid, len(rid), blob, len(blob),
+            )
+        if rc != 0:
+            raise OSError("event log append failed")
+        return stamped.event_id
+
+    def delete(
+        self, event_id: str, app_id: int, channel_id: int | None = None
+    ) -> bool:
+        if self.get(event_id, app_id, channel_id) is None:
+            return False
+        log = self._log(app_id, channel_id)
+        rid = event_id.encode()
+        with log.lock:
+            log.lib.pio_append(
+                log.handle, 2, 0.0, 0.0, 0, 0, 0, -1, -1,
+                rid, len(rid), b"", 0,
+            )
+        return True
+
+    # -- reads ------------------------------------------------------------
+    def _scan(
+        self,
+        app_id: int,
+        channel_id: int | None,
+        start_time=None,
+        until_time=None,
+        entity_type=None,
+        entity_id=None,
+        event_names=None,
+        target_entity_type=...,
+        target_entity_id=...,
+        include_varlen: bool = True,
+    ) -> _Scan | None:
+        log = self._log(app_id, channel_id)
+
+        def t(x):
+            return x.timestamp() if x is not None else _NAN
+
+        def opt(s):
+            if s is None:
+                return -1  # "any" for ety/eid
+            i = log.lookup(s)
+            return i if i is not None else None
+
+        ety = opt(entity_type)
+        eid = opt(entity_id)
+        if ety is None or eid is None:
+            return None  # filter string never seen → no matches
+        if event_names is not None:
+            ev_ids = [log.lookup(n) for n in event_names]
+            ev_ids = [i for i in ev_ids if i is not None]
+            if not ev_ids:
+                return None
+            ev_arr = (ctypes.c_uint32 * len(ev_ids))(*ev_ids)
+            n_ev = len(ev_ids)
+        else:
+            ev_arr = None
+            n_ev = 0
+
+        def tri(v):
+            if v is ...:
+                return -2
+            if v is None:
+                return -1
+            i = log.lookup(v)
+            return i if i is not None else None
+
+        tty = tri(target_entity_type)
+        tid = tri(target_entity_id)
+        if tty is None or tid is None:
+            return None
+        ptr = log.lib.pio_scan(
+            log.handle, t(start_time), t(until_time), ev_arr, n_ev,
+            ety, eid, tty, tid, 1 if include_varlen else 0,
+        )
+        return _Scan(log.lib, ptr)
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        start_time: _dt.datetime | None = None,
+        until_time: _dt.datetime | None = None,
+        entity_type: str | None = None,
+        entity_id: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type=...,
+        target_entity_id=...,
+        limit: int | None = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        if start_time is not None and start_time.tzinfo is None:
+            start_time = start_time.replace(tzinfo=_dt.timezone.utc)
+        if until_time is not None and until_time.tzinfo is None:
+            until_time = until_time.replace(tzinfo=_dt.timezone.utc)
+        scan = self._scan(
+            app_id, channel_id, start_time, until_time, entity_type,
+            entity_id, event_names, target_entity_type, target_entity_id,
+        )
+        if scan is None or scan.n == 0:
+            return
+        if limit is not None and limit == 0:
+            return
+        log = self._log(app_id, channel_id)
+        order = np.argsort(scan.event_time, kind="stable")
+        if reversed:
+            order = order[::-1]
+        varlen = list(scan.iter_varlen())
+        n_out = 0
+        for i in order:
+            event_id, blob = varlen[int(i)]
+            tty = int(scan.target_entity_type[i])
+            tid = int(scan.target_entity_id[i])
+            yield Event(
+                event=log.strings[int(scan.event[i])],
+                entity_type=log.strings[int(scan.entity_type[i])],
+                entity_id=log.strings[int(scan.entity_id[i])],
+                target_entity_type=log.strings[tty] if tty >= 0 else None,
+                target_entity_id=log.strings[tid] if tid >= 0 else None,
+                properties=DataMap(blob.get("properties") or {}),
+                event_time=_dt.datetime.fromtimestamp(
+                    float(scan.event_time[i]), _dt.timezone.utc
+                ),
+                tags=tuple(blob.get("tags") or ()),
+                pr_id=blob.get("prId"),
+                event_id=event_id,
+                creation_time=_dt.datetime.fromtimestamp(
+                    float(scan.creation_time[i]), _dt.timezone.utc
+                ),
+            )
+            n_out += 1
+            if limit is not None and 0 < limit <= n_out:
+                return
+
+    def get(
+        self, event_id: str, app_id: int, channel_id: int | None = None
+    ) -> Event | None:
+        for e in self.find(app_id, channel_id):
+            if e.event_id == event_id:
+                return e
+        return None
+
+    # -- native columnar fast path ----------------------------------------
+    def interactions(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        event_names: Sequence[str] | None = None,
+        value_key: str | None = None,
+        default_value: float = 1.0,
+    ) -> Interactions:
+        """Dense COO interactions straight from the C++ scan.
+
+        Entity/target codes come from the log's interner (compacted to a
+        dense vocabulary); property blobs are only parsed when a
+        ``value_key`` is requested.
+        """
+        need_values = value_key is not None
+        scan = self._scan(
+            app_id, channel_id, event_names=event_names,
+            target_entity_id=...,  # any
+            include_varlen=need_values,
+        )
+        log = self._log(app_id, channel_id)
+        if scan is None or scan.n == 0:
+            empty = BiMap(np.asarray([], dtype=np.str_))
+            z = np.zeros(0, np.int32)
+            return Interactions(
+                entity_map=empty, target_map=empty, rows=z, cols=z,
+                values=np.zeros(0, np.float32),
+                times=np.zeros(0, np.float64),
+            )
+        mask = scan.target_entity_id >= 0
+        eid = scan.entity_id[mask]
+        tid = scan.target_entity_id[mask].astype(np.uint32)
+        times = scan.event_time[mask]
+        # compact interner ids → dense [0, n) vocabularies
+        uniq_e, rows = np.unique(eid, return_inverse=True)
+        uniq_t, cols = np.unique(tid, return_inverse=True)
+        decode = np.asarray(log.strings, dtype=np.str_)
+        entity_map = BiMap(decode[uniq_e])
+        target_map = BiMap(decode[uniq_t])
+        if need_values:
+            vals = np.fromiter(
+                (
+                    float((blob.get("properties") or {}).get(
+                        value_key, default_value
+                    ))
+                    for (_id, blob), keep in zip(
+                        scan.iter_varlen(), mask
+                    )
+                    if keep
+                ),
+                dtype=np.float32,
+                count=int(mask.sum()),
+            )
+        else:
+            vals = np.full(int(mask.sum()), default_value, np.float32)
+        return Interactions(
+            entity_map=entity_map,
+            target_map=target_map,
+            rows=rows.astype(np.int32),
+            cols=cols.astype(np.int32),
+            values=vals,
+            times=times,
+        )

@@ -85,6 +85,37 @@ class EventStore:
         """Bulk columnar read — the device-staging path."""
         return EventFrame.from_events(self.find(app_name, **kwargs))
 
+    def interactions(
+        self,
+        app_name: str,
+        channel_name: str | None = None,
+        event_names: Sequence[str] | None = None,
+        value_key: str | None = None,
+        default_value: float = 1.0,
+    ):
+        """Dense COO interactions for training reads.
+
+        Dispatches to the backend's native columnar path when available
+        (the C++ event log scans straight to dense-id arrays); otherwise
+        falls back to the EventFrame conversion.
+        """
+        app_id, channel_id = self._resolve(app_name, channel_name)
+        backend = self._storage.get_events()
+        if hasattr(backend, "interactions"):
+            return backend.interactions(
+                app_id,
+                channel_id,
+                event_names=event_names,
+                value_key=value_key,
+                default_value=default_value,
+            )
+        frame = self.frame(
+            app_name, channel_name=channel_name, event_names=event_names
+        )
+        return frame.to_interactions(
+            value_key=value_key, default_value=default_value
+        )
+
     def aggregate_properties(
         self,
         app_name: str,

@@ -63,10 +63,12 @@ class RecDataSource(DataSource[RecTrainingData, dict, dict, list]):
 
     def _interactions(self) -> Interactions:
         p = self.params
-        frame = EventStore().frame(
-            p.app_name, event_names=list(p.event_names)
+        # uses the backend's native columnar scan when available
+        return EventStore().interactions(
+            p.app_name,
+            event_names=list(p.event_names),
+            value_key=p.rating_key,
         )
-        return frame.to_interactions(value_key=p.rating_key)
 
     def read_training(self, ctx: ComputeContext) -> RecTrainingData:
         return RecTrainingData(interactions=self._interactions())
@@ -150,6 +152,10 @@ class ALSParams(Params):
     seed: int = 13
     block_len: int = 64
     row_chunk: int = 256
+    # mid-training checkpoint/resume (ops/als.py); dir empty = disabled
+    checkpoint_dir: str = ""
+    checkpoint_every: int = 0
+    resume: bool = False
 
 
 @dataclasses.dataclass
@@ -181,6 +187,10 @@ class ALSAlgorithm(Algorithm[RecTrainingData, ALSRecModel, dict, dict]):
             seed=p.seed,
             block_len=p.block_len,
             row_chunk=p.row_chunk,
+            timer=self.timer,
+            checkpoint_dir=p.checkpoint_dir or None,
+            checkpoint_every=p.checkpoint_every,
+            resume=p.resume,
         )
         return ALSRecModel(
             user_factors=factors.user_factors,

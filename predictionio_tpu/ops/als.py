@@ -25,7 +25,9 @@ ratings, weighted-λ regularization like MLlib) modes are provided.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import math
+import os
 from functools import partial
 
 import jax
@@ -34,6 +36,8 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from predictionio_tpu.parallel.mesh import DATA_AXIS, ComputeContext
+
+logger = logging.getLogger(__name__)
 
 
 # --------------------------------------------------------------------------
@@ -260,8 +264,20 @@ def train_als(
     block_len: int = 64,
     row_chunk: int = 1024,
     dtype=jnp.float32,
+    timer=None,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 0,
+    resume: bool = False,
 ) -> ALSFactors:
-    """Alternate user/item normal-equation solves on the mesh."""
+    """Alternate user/item normal-equation solves on the mesh.
+
+    Mid-training checkpoint/resume (SURVEY.md §5 — the reference only
+    persists final models): with ``checkpoint_dir`` + ``checkpoint_every``
+    the factor state is written every N iterations (atomic npz) and
+    ``resume=True`` continues from the latest checkpoint after a restart.
+    ``timer`` (a :class:`~predictionio_tpu.utils.profiling.StepTimer`)
+    records one entry per half-iteration.
+    """
     n_data = ctx.data_parallelism
 
     def _pack(rows, cols, n_rows):
@@ -294,6 +310,24 @@ def train_als(
     init = np.asarray(
         jax.random.normal(key, (n_items, rank), dtype)
     ) * (1.0 / math.sqrt(rank))
+    start_iteration = 0
+    ckpt_path = (
+        os.path.join(checkpoint_dir, "als_checkpoint.npz")
+        if checkpoint_dir
+        else None
+    )
+    if resume and ckpt_path and os.path.exists(ckpt_path):
+        with np.load(ckpt_path) as ckpt:
+            if (
+                ckpt["item_factors"].shape == (n_items, rank)
+                and int(ckpt["iteration"]) < iterations
+            ):
+                init = ckpt["item_factors"]
+                start_iteration = int(ckpt["iteration"])
+                logger.info(
+                    "resuming ALS from checkpoint at iteration %d",
+                    start_iteration,
+                )
     item_factors = np.zeros((item_csr.n_rows_padded, rank), init.dtype)
     item_factors[:n_items] = init
     item_factors = ctx.replicate(item_factors)
@@ -310,11 +344,44 @@ def train_als(
     )
 
     lam = jnp.asarray(reg, dtype)
-    for _ in range(iterations):
-        user_factors = solve_users(item_factors, *u_dev, lam)
-        item_factors = solve_items(user_factors, *i_dev, lam)
+    for it in range(start_iteration, iterations):
+        if timer is not None:
+            with timer.step("als/user_solve", sync_value=None):
+                user_factors = solve_users(item_factors, *u_dev, lam)
+                _sync_scalar(user_factors)
+            with timer.step("als/item_solve", sync_value=None):
+                item_factors = solve_items(user_factors, *i_dev, lam)
+                _sync_scalar(item_factors)
+        else:
+            user_factors = solve_users(item_factors, *u_dev, lam)
+            item_factors = solve_items(user_factors, *i_dev, lam)
+        if (
+            ckpt_path
+            and checkpoint_every > 0
+            and (it + 1) % checkpoint_every == 0
+            and (it + 1) < iterations
+        ):
+            _write_checkpoint(
+                ckpt_path,
+                iteration=it + 1,
+                item_factors=np.asarray(item_factors)[:n_items],
+                user_factors=np.asarray(user_factors)[:n_users],
+            )
 
+    if user_factors is None:  # resumed at the final iteration count
+        user_factors = solve_users(item_factors, *u_dev, lam)
     return ALSFactors(
         user_factors=np.asarray(user_factors)[:n_users],
         item_factors=np.asarray(item_factors)[:n_items],
     )
+
+
+def _sync_scalar(arr) -> None:
+    # device→host fetch: the only reliable barrier on every platform
+    jax.device_get(arr[0, 0])
+
+
+def _write_checkpoint(path: str, **arrays) -> None:
+    tmp = path + ".tmp.npz"  # .npz suffix keeps np.savez from renaming
+    np.savez(tmp, **arrays)
+    os.replace(tmp, path)

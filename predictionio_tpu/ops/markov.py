@@ -1,0 +1,77 @@
+"""Markov chain — row-normalized top-N transition model.
+
+Capability parity with the reference e2 library's ``MarkovChain``
+(e2/src/main/scala/.../engine/MarkovChain.scala:32-89): from a sparse
+transition-count matrix, build a row-normalized model keeping only the
+top-N transitions per state, and predict next-state distributions.
+
+TPU-first: counts aggregate with ``np.add.at`` host-side (data prep),
+normalization + top-N + prediction are dense jitted ops. States are
+dense ids (use BiMap upstream).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class MarkovChainModel:
+    """Top-N transitions per state: indices [S, N], probs [S, N]."""
+
+    indices: jax.Array
+    probs: jax.Array
+
+    def tree_flatten(self):
+        return (self.indices, self.probs), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def n_states(self) -> int:
+        return self.indices.shape[0]
+
+
+@partial(jax.jit, static_argnames=("top_n",))
+def _train_dense(counts: jax.Array, top_n: int) -> MarkovChainModel:
+    row_sum = counts.sum(axis=1, keepdims=True)
+    # guard only against /0 — fractional row totals must still normalize
+    safe = jnp.where(row_sum > 0, row_sum, 1.0)
+    probs = jnp.where(row_sum > 0, counts / safe, 0.0)
+    top_probs, top_idx = jax.lax.top_k(probs, top_n)
+    return MarkovChainModel(indices=top_idx, probs=top_probs)
+
+
+def train_markov_chain(
+    from_states: np.ndarray,
+    to_states: np.ndarray,
+    n_states: int,
+    top_n: int = 10,
+    weights: np.ndarray | None = None,
+) -> MarkovChainModel:
+    """Count transitions → row-normalized top-N model."""
+    counts = np.zeros((n_states, n_states), np.float32)
+    w = (
+        np.asarray(weights, np.float32)
+        if weights is not None
+        else np.ones(len(from_states), np.float32)
+    )
+    np.add.at(counts, (np.asarray(from_states), np.asarray(to_states)), w)
+    return _train_dense(jnp.asarray(counts), min(top_n, n_states))
+
+
+def predict_next(
+    model: MarkovChainModel, state: int
+) -> list[tuple[int, float]]:
+    """Next-state distribution for one state (sparse, prob-descending)."""
+    idx = np.asarray(model.indices[state])
+    probs = np.asarray(model.probs[state])
+    return [(int(i), float(p)) for i, p in zip(idx, probs) if p > 0]

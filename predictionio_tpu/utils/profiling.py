@@ -1,0 +1,99 @@
+"""Tracing / profiling subsystem.
+
+The reference has no profiler beyond per-request latency counters and
+the Spark UI (SURVEY.md §5 "Tracing / profiling"); the TPU build makes
+this first-class:
+
+* :class:`StepTimer` — per-step wall-clock records for training loops
+  (ALS logs one record per alternating solve), queryable and
+  JSON-serializable for run metadata.
+* :func:`trace` — context manager around ``jax.profiler`` producing a
+  Perfetto/TensorBoard trace when a directory is given (or the
+  ``PIO_TRACE_DIR`` env var is set); no-op otherwise.
+
+Timing always syncs through a device→host fetch — ``block_until_ready``
+alone is not a reliable barrier on every platform (see bench.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import time
+from collections import defaultdict
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+def sync(value) -> None:
+    """Reliable device barrier: fetch a scalar reduction to host."""
+    if isinstance(value, jax.Array):
+        jax.device_get(value.ravel()[0] if value.size else value)
+
+
+class StepTimer:
+    """Named per-step wall-clock records."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self.records: dict[str, list[float]] = defaultdict(list)
+
+    @contextlib.contextmanager
+    def step(self, name: str, sync_value=None):
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if sync_value is not None:
+                sync(sync_value)
+            self.records[name].append(time.perf_counter() - t0)
+
+    def record(self, name: str, seconds: float) -> None:
+        if self.enabled:
+            self.records[name].append(seconds)
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        out = {}
+        for name, xs in self.records.items():
+            out[name] = {
+                "count": len(xs),
+                "total_s": round(sum(xs), 6),
+                "mean_s": round(sum(xs) / len(xs), 6),
+                "max_s": round(max(xs), 6),
+            }
+        return out
+
+    def to_json(self) -> str:
+        return json.dumps(self.summary())
+
+    def log_summary(self, prefix: str = "") -> None:
+        for name, s in self.summary().items():
+            logger.info(
+                "%s%s: %d step(s), mean %.4fs, total %.2fs",
+                prefix,
+                name,
+                s["count"],
+                s["mean_s"],
+                s["total_s"],
+            )
+
+
+@contextlib.contextmanager
+def trace(trace_dir: str | None = None):
+    """JAX profiler trace (Perfetto/TensorBoard) when a dir is given or
+    PIO_TRACE_DIR is set; transparent otherwise."""
+    trace_dir = trace_dir or os.environ.get("PIO_TRACE_DIR")
+    if not trace_dir:
+        yield
+        return
+    os.makedirs(trace_dir, exist_ok=True)
+    logger.info("writing profiler trace to %s", trace_dir)
+    with jax.profiler.trace(trace_dir):
+        yield

@@ -48,6 +48,22 @@ def memory_storage():
 
 
 @pytest.fixture()
+def eventlog_storage(tmp_path):
+    """Native C++ event log for EVENTDATA + memory metadata/models."""
+    storage = Storage(
+        env={
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_SOURCES_ELOG_TYPE": "eventlog",
+            "PIO_STORAGE_SOURCES_ELOG_PATH": str(tmp_path / "eventlog"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "ELOG",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        }
+    )
+    yield storage
+
+
+@pytest.fixture()
 def sqlite_storage(tmp_path):
     """SQLite-backed storage in a temp dir."""
     storage = Storage(

@@ -1,0 +1,117 @@
+"""Client SDK tests against a live event server + engine server."""
+
+import pytest
+
+from predictionio_tpu.client import EngineClient, EventClient, PIOClientError
+from predictionio_tpu.data.storage import AccessKey, App
+from predictionio_tpu.serving.event_server import create_event_server
+
+
+@pytest.fixture()
+def event_server(memory_storage):
+    app_id = memory_storage.get_meta_data_apps().insert(
+        App(id=0, name="sdkapp")
+    )
+    memory_storage.get_events().init(app_id)
+    memory_storage.get_meta_data_access_keys().insert(
+        AccessKey(key="sdkkey", appid=app_id)
+    )
+    http = create_event_server(
+        host="127.0.0.1", port=0, storage=memory_storage
+    )
+    http.start()
+    yield f"http://127.0.0.1:{http.port}"
+    http.shutdown()
+
+
+class TestEventClient:
+    def test_create_get_delete(self, event_server):
+        c = EventClient("sdkkey", event_server)
+        eid = c.record_user_action_on_item(
+            "rate", "u1", "i1", properties={"rating": 4.0}
+        )
+        got = c.get_event(eid)
+        assert got["event"] == "rate"
+        assert got["properties"]["rating"] == 4.0
+        c.delete_event(eid)
+        with pytest.raises(PIOClientError) as e:
+            c.get_event(eid)
+        assert e.value.status == 404
+
+    def test_set_helpers_and_find(self, event_server):
+        c = EventClient("sdkkey", event_server)
+        c.set_user("u1", {"age": 33})
+        c.set_item("i1", {"categories": ["a"]})
+        events = c.find_events(event="$set")
+        assert len(events) == 2
+
+    def test_batch(self, event_server):
+        c = EventClient("sdkkey", event_server)
+        out = c.create_events(
+            [
+                {"event": "view", "entityType": "user", "entityId": "u1"},
+                {"event": "$bad", "entityType": "user", "entityId": "u2"},
+            ]
+        )
+        assert [r["status"] for r in out] == [201, 400]
+
+    def test_bad_key(self, event_server):
+        c = EventClient("wrong", event_server)
+        with pytest.raises(PIOClientError) as e:
+            c.set_user("u1")
+        assert e.value.status == 401
+
+
+class TestEngineClient:
+    def test_send_query(self, memory_storage):
+        from fake_engine import (
+            FakeDataSource,
+            FakeParams,
+            FakePreparator,
+        )
+        from test_engine_server import (
+            DictQueryAlgorithm,
+            DictServing,
+        )
+        from predictionio_tpu.core import Engine, EngineParams
+        from predictionio_tpu.core.workflow import run_train
+        from predictionio_tpu.parallel.mesh import ComputeContext
+        from predictionio_tpu.serving.engine_server import EngineServer
+
+        ctx = ComputeContext.create(batch="sdk")
+        engine = Engine(
+            FakeDataSource, FakePreparator, DictQueryAlgorithm, DictServing
+        )
+        params = EngineParams(
+            data_source=("", FakeParams(id=1)),
+            preparator=("", FakeParams(id=2)),
+            algorithms=[("", FakeParams(id=3))],
+            serving=("", FakeParams()),
+        )
+        run_train(
+            engine, params, engine_id="sdk", ctx=ctx, storage=memory_storage
+        )
+        es = EngineServer(
+            engine, params, engine_id="sdk", storage=memory_storage, ctx=ctx
+        )
+        http = es.serve(host="127.0.0.1", port=0)
+        http.start()
+        try:
+            client = EngineClient(f"http://127.0.0.1:{http.port}")
+            assert client.status()["engineId"] == "sdk"
+            assert client.send_query({"x": 5}) == {"result": 35}
+        finally:
+            http.shutdown()
+            es.close()
+
+
+class TestUrlEncoding:
+    def test_special_characters_roundtrip(self, event_server):
+        c = EventClient("sdkkey", event_server)
+        eid = c.create_event(
+            "view", "user", "john doe+#&"
+        )
+        got = c.get_event(eid)
+        assert got["entityId"] == "john doe+#&"
+        events = c.find_events(entityId="john doe+#&")
+        assert len(events) == 1

@@ -1,0 +1,111 @@
+"""e2 engine-building library tests (reference e2 module:
+MarkovChain, BinaryVectorizer, CrossValidation)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core.crossvalidation import split_data
+from predictionio_tpu.ops.markov import (
+    predict_next,
+    train_markov_chain,
+)
+from predictionio_tpu.ops.vectorizer import BinaryVectorizer
+
+
+class TestMarkovChain:
+    def test_row_normalized_topn(self):
+        # transitions: 0→1 (×3), 0→2 (×1), 1→0 (×2)
+        model = train_markov_chain(
+            np.asarray([0, 0, 0, 0, 1, 1]),
+            np.asarray([1, 1, 1, 2, 0, 0]),
+            n_states=3,
+            top_n=2,
+        )
+        nxt = predict_next(model, 0)
+        assert nxt[0] == (1, pytest.approx(0.75))
+        assert nxt[1] == (2, pytest.approx(0.25))
+        assert predict_next(model, 1) == [(0, pytest.approx(1.0))]
+        # state 2 never transitions anywhere
+        assert predict_next(model, 2) == []
+
+    def test_topn_truncates(self):
+        model = train_markov_chain(
+            np.zeros(6, np.int64),
+            np.asarray([1, 2, 3, 4, 5, 1]),
+            n_states=6,
+            top_n=2,
+        )
+        nxt = predict_next(model, 0)
+        assert len(nxt) == 2
+        assert nxt[0][0] == 1  # most frequent kept
+
+    def test_weighted(self):
+        model = train_markov_chain(
+            np.asarray([0, 0]),
+            np.asarray([1, 2]),
+            n_states=3,
+            top_n=3,
+            weights=np.asarray([3.0, 1.0]),
+        )
+        nxt = dict(predict_next(model, 0))
+        assert nxt[1] == pytest.approx(0.75)
+
+
+class TestBinaryVectorizer:
+    def test_from_property_maps_and_transform(self):
+        maps = [
+            {"color": "red", "size": "L"},
+            {"color": "blue"},
+        ]
+        v = BinaryVectorizer.from_property_maps(maps)
+        assert v.n_features == 3
+        x = v.transform({"color": "red", "size": "L"})
+        assert x.sum() == 2.0
+        y = v.transform({"color": "blue", "size": "XL"})  # XL unseen
+        assert y.sum() == 1.0
+        # no collision between (a, b) pairs sharing concatenation
+        v2 = BinaryVectorizer([("a", "bc"), ("ab", "c")])
+        assert v2.n_features == 2
+        assert v2.transform({"a": "bc"}).sum() == 1.0
+
+    def test_field_filter_and_batch(self):
+        maps = [{"color": "red", "noise": "x"}]
+        v = BinaryVectorizer.from_property_maps(maps, fields=["color"])
+        assert v.n_features == 1
+        batch = v.transform_batch(
+            [{"color": "red"}, {"color": "green"}]
+        )
+        assert batch.shape == (2, 1)
+        assert batch[0, 0] == 1.0 and batch[1, 0] == 0.0
+        assert v.transform_batch([]).shape == (0, 1)
+
+
+class TestSplitData:
+    def test_fold_shapes_and_coverage(self):
+        data = list(range(10))
+        folds = split_data(
+            3,
+            data,
+            training_creator=lambda xs: list(xs),
+            test_creator=lambda d: (d, d * 10),
+        )
+        assert len(folds) == 3
+        all_test = []
+        for td, info, qa in folds:
+            assert set(td).isdisjoint(q for q, _ in qa)
+            assert len(td) + len(qa) == 10
+            all_test.extend(q for q, _ in qa)
+        assert sorted(all_test) == data  # every example tested once
+
+    def test_k_validation(self):
+        with pytest.raises(ValueError):
+            split_data(1, [1, 2], list, lambda d: (d, d))
+
+
+class TestReviewRegressions:
+    def test_fractional_weights_normalize(self):
+        model = train_markov_chain(
+            np.asarray([0]), np.asarray([1]), n_states=2, top_n=2,
+            weights=np.asarray([0.5]),
+        )
+        assert predict_next(model, 0) == [(1, pytest.approx(1.0))]

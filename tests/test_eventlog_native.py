@@ -1,0 +1,165 @@
+"""Native C++ event-log specifics beyond the shared contract suite:
+columnar fast path, persistence across handles, tombstones, throughput
+sanity, and end-to-end ALS training over the native store."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import App
+from predictionio_tpu.data.storage.eventlog import EventLogEvents
+
+
+def _t(s):
+    return dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc) + dt.timedelta(
+        seconds=s
+    )
+
+
+def _rate(u, i, r, t):
+    return Event(
+        event="rate",
+        entity_type="user",
+        entity_id=u,
+        target_entity_type="item",
+        target_entity_id=i,
+        properties=DataMap({"rating": r}),
+        event_time=_t(t),
+    )
+
+
+class TestColumnarFastPath:
+    def test_interactions_match_eventframe_path(self, tmp_path):
+        be = EventLogEvents({"PATH": str(tmp_path)})
+        be.init(1)
+        events = [
+            _rate("u1", "i1", 4.0, 0),
+            _rate("u2", "i2", 2.0, 1),
+            _rate("u1", "i2", 5.0, 2),
+            Event(  # no target → excluded
+                event="$set",
+                entity_type="user",
+                entity_id="u1",
+                properties=DataMap({"a": 1}),
+                event_time=_t(3),
+            ),
+        ]
+        for e in events:
+            be.insert(e, 1)
+        inter = be.interactions(
+            1, event_names=["rate"], value_key="rating"
+        )
+        assert inter.n_rows == 2 and inter.n_cols == 2
+        assert inter.nnz == 3
+        dense = np.zeros((2, 2), np.float32)
+        dense[inter.rows, inter.cols] = inter.values
+        assert dense[inter.entity_map("u1"), inter.target_map("i1")] == 4.0
+        assert dense[inter.entity_map("u1"), inter.target_map("i2")] == 5.0
+        assert dense[inter.entity_map("u2"), inter.target_map("i2")] == 2.0
+
+    def test_implicit_counts_skip_blob_parse(self, tmp_path):
+        be = EventLogEvents({"PATH": str(tmp_path)})
+        be.init(1)
+        for i in range(5):
+            be.insert(_rate("u1", f"i{i}", float(i), i), 1)
+        inter = be.interactions(1, event_names=["rate"])  # no value_key
+        assert (inter.values == 1.0).all()
+
+    def test_persistence_across_handles(self, tmp_path):
+        be = EventLogEvents({"PATH": str(tmp_path)})
+        be.init(1)
+        eid = be.insert(_rate("u1", "i1", 4.0, 0), 1)
+        be.close()
+        be2 = EventLogEvents({"PATH": str(tmp_path)})
+        got = be2.get(eid, 1)
+        assert got is not None
+        assert got.properties.get_float("rating") == 4.0
+        # tombstone persists too
+        be2.delete(eid, 1)
+        be2.close()
+        be3 = EventLogEvents({"PATH": str(tmp_path)})
+        assert be3.get(eid, 1) is None
+
+    def test_write_read_throughput_sanity(self, tmp_path):
+        """Native path should ingest + columnar-scan 20k events fast."""
+        import time
+
+        be = EventLogEvents({"PATH": str(tmp_path)})
+        be.init(1)
+        n = 20_000
+        t0 = time.perf_counter()
+        for k in range(n):
+            be.insert(_rate(f"u{k % 500}", f"i{k % 200}", 1.0, k), 1)
+        write_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        inter = be.interactions(1, event_names=["rate"])
+        scan_s = time.perf_counter() - t0
+        assert inter.nnz == n
+        assert inter.n_rows == 500 and inter.n_cols == 200
+        # loose bounds — just catch pathological regressions
+        assert write_s < 20.0, f"write too slow: {write_s:.1f}s"
+        assert scan_s < 2.0, f"columnar scan too slow: {scan_s:.1f}s"
+
+
+class TestEndToEndOverNativeStore:
+    def test_recommendation_trains_from_eventlog(
+        self, eventlog_storage
+    ):
+        from predictionio_tpu.core.engine import EngineParams
+        from predictionio_tpu.core.workflow import load_deployment, run_train
+        from predictionio_tpu.data.storage import set_storage
+        from predictionio_tpu.models.recommendation import (
+            ALSParams,
+            RecDataSourceParams,
+            recommendation_engine,
+        )
+        from predictionio_tpu.parallel.mesh import ComputeContext
+
+        set_storage(eventlog_storage)
+        try:
+            app_id = eventlog_storage.get_meta_data_apps().insert(
+                App(id=0, name="nativerec")
+            )
+            events = eventlog_storage.get_events()
+            events.init(app_id)
+            rng = np.random.default_rng(0)
+            for u in range(24):
+                liked = [i for i in range(16) if i % 2 == u % 2]
+                for i in rng.choice(liked, 6, replace=False):
+                    events.insert(_rate(f"u{u}", f"i{i}", 4.0, int(u * 10 + i)), app_id)
+            ctx = ComputeContext.create(batch="native-rec")
+            params = EngineParams(
+                data_source=(
+                    "",
+                    RecDataSourceParams(app_name="nativerec"),
+                ),
+                algorithms=[
+                    (
+                        "als",
+                        ALSParams(
+                            rank=8,
+                            num_iterations=5,
+                            alpha=4.0,
+                            block_len=8,
+                            row_chunk=8,
+                        ),
+                    )
+                ],
+            )
+            engine = recommendation_engine()
+            run_train(
+                engine, params, engine_id="native-rec", ctx=ctx,
+                storage=eventlog_storage,
+            )
+            _, algos, models, _ = load_deployment(
+                engine, params, engine_id="native-rec", ctx=ctx,
+                storage=eventlog_storage,
+            )
+            r = algos[0].predict(models[0], {"user": "u0", "num": 5})
+            items = [s["item"] for s in r["itemScores"]]
+            even = sum(1 for it in items if int(it[1:]) % 2 == 0)
+            assert even >= 4
+        finally:
+            set_storage(None)

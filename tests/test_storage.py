@@ -25,11 +25,13 @@ def _t(seconds: int) -> dt.datetime:
     )
 
 
-@pytest.fixture(params=["memory", "sqlite"])
-def storage(request, memory_storage, sqlite_storage):
-    return {"memory": memory_storage, "sqlite": sqlite_storage}[
-        request.param
-    ]
+@pytest.fixture(params=["memory", "sqlite", "eventlog"])
+def storage(request, memory_storage, sqlite_storage, eventlog_storage):
+    return {
+        "memory": memory_storage,
+        "sqlite": sqlite_storage,
+        "eventlog": eventlog_storage,
+    }[request.param]
 
 
 class TestApps:

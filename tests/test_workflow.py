@@ -222,3 +222,17 @@ class TestReviewRegressions:
                 _engine(), two_algo_params, engine_id="fake", ctx=ctx,
                 storage=memory_storage,
             )
+
+
+class TestTimingMetadata:
+    def test_run_train_records_timing(self, ctx, memory_storage):
+        import json
+
+        iid = run_train(
+            _engine(), _params(), engine_id="fake", ctx=ctx,
+            storage=memory_storage,
+        )
+        inst = memory_storage.get_meta_data_engine_instances().get(iid)
+        timing = json.loads(inst.env["timing"])
+        assert timing["train/total"]["count"] == 1
+        assert timing["train/total"]["mean_s"] > 0
