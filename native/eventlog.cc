@@ -41,14 +41,19 @@ struct Log {
   FILE* dict_file = nullptr;  // append handle
   std::unordered_map<std::string, uint32_t> dict;
   std::vector<std::string> strings;
+  long dict_offset = 0;  // how far into dict.bin we've read
 
   std::string log_path() const { return dir + "/log.bin"; }
   std::string dict_path() const { return dir + "/dict.bin"; }
 };
 
+// Incrementally read dict.bin from the last seen offset — ids are
+// append-ordered, so entries written by other processes slot in at the
+// positions they claimed (see the flock discipline in the wrapper).
 bool load_dict(Log* log) {
   FILE* f = std::fopen(log->dict_path().c_str(), "rb");
   if (f == nullptr) return true;  // fresh log
+  std::fseek(f, log->dict_offset, SEEK_SET);
   for (;;) {
     uint32_t len;
     if (std::fread(&len, 4, 1, f) != 1) break;
@@ -56,6 +61,7 @@ bool load_dict(Log* log) {
     if (len > 0 && std::fread(&s[0], 1, len, f) != len) break;
     log->dict.emplace(s, static_cast<uint32_t>(log->strings.size()));
     log->strings.push_back(std::move(s));
+    log->dict_offset = std::ftell(f);
   }
   std::fclose(f);
   return true;
@@ -143,6 +149,12 @@ void pio_log_sync(void* handle) {
   std::fflush(log->dict_file);
 }
 
+// re-read dict entries appended by other processes (call under the
+// cross-process write lock, or before decoding a fresh scan)
+void pio_dict_reload(void* handle) {
+  load_dict(static_cast<Log*>(handle));
+}
+
 // string → dict id (appending to the persistent dictionary when new)
 uint32_t pio_intern(void* handle, const uint8_t* s, uint32_t len) {
   Log* log = static_cast<Log*>(handle);
@@ -155,6 +167,7 @@ uint32_t pio_intern(void* handle, const uint8_t* s, uint32_t len) {
   std::fflush(log->dict_file);
   log->dict.emplace(key, id);
   log->strings.push_back(std::move(key));
+  log->dict_offset += 4 + static_cast<long>(len);
   return id;
 }
 
@@ -207,10 +220,13 @@ int pio_append(void* handle, uint8_t kind, double etime, double ctime,
 // ety/eid: -1 = any; tty/tid: -2 = any, -1 = must-be-absent, else match.
 // Delete tombstones suppress matching event ids. include_varlen=0 skips
 // copying ids/blobs (the pure-columnar fast path for training reads).
+// id_filter (optional, len 0 = any): match one exact event id — the
+// O(matching) path for get()/delete() instead of a full decode.
 ScanResult* pio_scan(void* handle, double t0, double t1,
                      const uint32_t* ev_filter, uint32_t n_ev,
                      int64_t ety, int64_t eid, int64_t tty, int64_t tid,
-                     int include_varlen) {
+                     int include_varlen, const uint8_t* id_filter,
+                     uint32_t id_filter_len) {
   Log* log = static_cast<Log*>(handle);
   std::fflush(log->log_file);
   FILE* f = std::fopen(log->log_path().c_str(), "rb");
@@ -257,6 +273,11 @@ ScanResult* pio_scan(void* handle, double t0, double t1,
     if (tty >= 0 && r.tty != (int32_t)tty) continue;
     if (tid == -1 && r.tid != -1) continue;
     if (tid >= 0 && r.tid != (int32_t)tid) continue;
+    if (id_filter_len > 0 &&
+        (r.id_len != id_filter_len ||
+         std::memcmp(r.id, id_filter, id_filter_len) != 0)) {
+      continue;
+    }
     if (!deleted.empty() &&
         deleted.count(std::string(
             reinterpret_cast<const char*>(r.id), r.id_len)) > 0) {

@@ -15,14 +15,15 @@ g++ (see native/build.sh).
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import datetime as _dt
+import fcntl
 import json
 import os
 import struct
 import subprocess
 import threading
-import uuid
 from typing import Iterator, Sequence
 
 import numpy as np
@@ -50,14 +51,30 @@ def _load_library() -> ctypes.CDLL:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH):
-            subprocess.run(
-                ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                 "-o", _LIB_PATH,
-                 os.path.join(_NATIVE_DIR, "eventlog.cc")],
-                check=True,
-                capture_output=True,
+        src = os.path.join(_NATIVE_DIR, "eventlog.cc")
+        if not os.path.exists(src) and not os.path.exists(_LIB_PATH):
+            raise RuntimeError(
+                "native event-log sources not found at "
+                f"{src}; the 'eventlog' backend needs the repo's native/ "
+                "directory (or a prebuilt libpio_eventlog.so)"
             )
+        stale = os.path.exists(src) and (
+            not os.path.exists(_LIB_PATH)
+            or os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
+        )
+        if stale:
+            try:
+                subprocess.run(
+                    ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                     "-o", _LIB_PATH, src],
+                    check=True,
+                    capture_output=True,
+                    text=True,
+                )
+            except subprocess.CalledProcessError as e:
+                raise RuntimeError(
+                    f"building libpio_eventlog.so failed:\n{e.stderr}"
+                ) from e
         lib = ctypes.CDLL(_LIB_PATH)
         c = ctypes
         lib.pio_log_open.restype = c.c_void_p
@@ -66,6 +83,7 @@ def _load_library() -> ctypes.CDLL:
         lib.pio_log_sync.argtypes = [c.c_void_p]
         lib.pio_intern.restype = c.c_uint32
         lib.pio_intern.argtypes = [c.c_void_p, c.c_char_p, c.c_uint32]
+        lib.pio_dict_reload.argtypes = [c.c_void_p]
         lib.pio_dict_size.restype = c.c_uint64
         lib.pio_dict_size.argtypes = [c.c_void_p]
         lib.pio_dict_get.restype = c.c_uint32
@@ -83,6 +101,7 @@ def _load_library() -> ctypes.CDLL:
             c.c_void_p, c.c_double, c.c_double,
             c.POINTER(c.c_uint32), c.c_uint32,
             c.c_int64, c.c_int64, c.c_int64, c.c_int64, c.c_int,
+            c.c_char_p, c.c_uint32,
         ]
         for name, rtype in [
             ("pio_result_n", c.c_uint64),
@@ -108,7 +127,14 @@ _NAN = float("nan")
 
 
 class _Log:
-    """One (app, channel) log directory."""
+    """One (app, channel) log directory.
+
+    Cross-process write discipline: every intern+append pair runs under
+    an exclusive ``flock`` on ``write.lock`` with the dictionary
+    reloaded first, so concurrent writer processes (event server +
+    import job) agree on interner ids. Readers reload the dictionary
+    before decoding a scan.
+    """
 
     def __init__(self, path: str):
         self.lib = _load_library()
@@ -117,9 +143,28 @@ class _Log:
         if not self.handle:
             raise RuntimeError(f"cannot open event log at {path}")
         self.lock = threading.Lock()
+        self._flock_file = open(  # noqa: SIM115 - held for log lifetime
+            os.path.join(path, "write.lock"), "a"
+        )
         # mirror of the persistent dictionary for decode / lookup
         self.strings: list[str] = []
         self.ids: dict[str, int] = {}
+        self._refresh_dict()
+
+    @contextlib.contextmanager
+    def write_lock(self):
+        """Thread lock + cross-process flock, dict resynced inside."""
+        with self.lock:
+            fcntl.flock(self._flock_file, fcntl.LOCK_EX)
+            try:
+                self.reload_dict()
+                yield
+            finally:
+                fcntl.flock(self._flock_file, fcntl.LOCK_UN)
+
+    def reload_dict(self) -> None:
+        """Pick up dictionary entries appended by other processes."""
+        self.lib.pio_dict_reload(self.handle)
         self._refresh_dict()
 
     def _refresh_dict(self) -> None:
@@ -153,6 +198,7 @@ class _Log:
         if self.handle:
             self.lib.pio_log_close(self.handle)
             self.handle = None
+        self._flock_file.close()
 
 
 class _Scan:
@@ -189,19 +235,41 @@ class _Scan:
             self.varlen = b""
         lib.pio_result_free(ptr)
 
+        self._offsets: list[tuple[int, int, int, int]] | None = None
+
+    def _index_varlen(self) -> list[tuple[int, int, int, int]]:
+        """Byte offsets per record (no JSON parsing): (id_off, id_len,
+        blob_off, blob_len)."""
+        if self._offsets is None:
+            buf, off, out = self.varlen, 0, []
+            for _ in range(self.n):
+                (id_len,) = struct.unpack_from("<I", buf, off)
+                off += 4
+                id_off = off
+                off += id_len
+                (blob_len,) = struct.unpack_from("<I", buf, off)
+                off += 4
+                out.append((id_off, id_len, off, blob_len))
+                off += blob_len
+            self._offsets = out
+        return self._offsets
+
+    def varlen_at(self, i: int) -> tuple[str, dict]:
+        """Decode one record's (event_id, blob) on demand — JSON is
+        parsed only for records actually yielded (limit-friendly)."""
+        id_off, id_len, blob_off, blob_len = self._index_varlen()[i]
+        event_id = self.varlen[id_off:id_off + id_len].decode()
+        blob = (
+            json.loads(self.varlen[blob_off:blob_off + blob_len])
+            if blob_len
+            else {}
+        )
+        return event_id, blob
+
     def iter_varlen(self):
         """Yield (event_id, blob_dict) per record."""
-        buf, off = self.varlen, 0
-        for _ in range(self.n):
-            (id_len,) = struct.unpack_from("<I", buf, off)
-            off += 4
-            event_id = buf[off:off + id_len].decode()
-            off += id_len
-            (blob_len,) = struct.unpack_from("<I", buf, off)
-            off += 4
-            blob = json.loads(buf[off:off + blob_len]) if blob_len else {}
-            off += blob_len
-            yield event_id, blob
+        for i in range(self.n):
+            yield self.varlen_at(i)
 
 
 class EventLogEvents(EventsBackend):
@@ -270,7 +338,7 @@ class EventLogEvents(EventsBackend):
                 "prId": stamped.pr_id,
             }
         ).encode()
-        with log.lock:
+        with log.write_lock():
             ev = log.intern(stamped.event)
             ety = log.intern(stamped.entity_type)
             eid = log.intern(stamped.entity_id)
@@ -302,7 +370,7 @@ class EventLogEvents(EventsBackend):
             return False
         log = self._log(app_id, channel_id)
         rid = event_id.encode()
-        with log.lock:
+        with log.write_lock():
             log.lib.pio_append(
                 log.handle, 2, 0.0, 0.0, 0, 0, 0, -1, -1,
                 rid, len(rid), b"", 0,
@@ -322,8 +390,12 @@ class EventLogEvents(EventsBackend):
         target_entity_type=...,
         target_entity_id=...,
         include_varlen: bool = True,
+        id_filter: str | None = None,
     ) -> _Scan | None:
         log = self._log(app_id, channel_id)
+        # pick up strings interned by other processes before filter
+        # lookups and result decoding
+        log.reload_dict()
 
         def t(x):
             return x.timestamp() if x is not None else _NAN
@@ -361,9 +433,11 @@ class EventLogEvents(EventsBackend):
         tid = tri(target_entity_id)
         if tty is None or tid is None:
             return None
+        rid = id_filter.encode() if id_filter is not None else b""
         ptr = log.lib.pio_scan(
             log.handle, t(start_time), t(until_time), ev_arr, n_ev,
             ety, eid, tty, tid, 1 if include_varlen else 0,
+            rid, len(rid),
         )
         return _Scan(log.lib, ptr)
 
@@ -397,10 +471,10 @@ class EventLogEvents(EventsBackend):
         order = np.argsort(scan.event_time, kind="stable")
         if reversed:
             order = order[::-1]
-        varlen = list(scan.iter_varlen())
         n_out = 0
         for i in order:
-            event_id, blob = varlen[int(i)]
+            # lazy: JSON blobs parse only for yielded records
+            event_id, blob = scan.varlen_at(int(i))
             tty = int(scan.target_entity_type[i])
             tid = int(scan.target_entity_id[i])
             yield Event(
@@ -427,10 +501,32 @@ class EventLogEvents(EventsBackend):
     def get(
         self, event_id: str, app_id: int, channel_id: int | None = None
     ) -> Event | None:
-        for e in self.find(app_id, channel_id):
-            if e.event_id == event_id:
-                return e
-        return None
+        # id-filtered scan: matching happens in C++, O(1) decode here
+        scan = self._scan(app_id, channel_id, id_filter=event_id)
+        if scan is None or scan.n == 0:
+            return None
+        log = self._log(app_id, channel_id)
+        i = 0
+        eid_str, blob = scan.varlen_at(i)
+        tty = int(scan.target_entity_type[i])
+        tid = int(scan.target_entity_id[i])
+        return Event(
+            event=log.strings[int(scan.event[i])],
+            entity_type=log.strings[int(scan.entity_type[i])],
+            entity_id=log.strings[int(scan.entity_id[i])],
+            target_entity_type=log.strings[tty] if tty >= 0 else None,
+            target_entity_id=log.strings[tid] if tid >= 0 else None,
+            properties=DataMap(blob.get("properties") or {}),
+            event_time=_dt.datetime.fromtimestamp(
+                float(scan.event_time[i]), _dt.timezone.utc
+            ),
+            tags=tuple(blob.get("tags") or ()),
+            pr_id=blob.get("prId"),
+            event_id=eid_str,
+            creation_time=_dt.datetime.fromtimestamp(
+                float(scan.creation_time[i]), _dt.timezone.utc
+            ),
+        )
 
     # -- native columnar fast path ----------------------------------------
     def interactions(

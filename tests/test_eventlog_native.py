@@ -163,3 +163,68 @@ class TestEndToEndOverNativeStore:
             assert even >= 4
         finally:
             set_storage(None)
+
+
+class TestCrossProcess:
+    def test_two_writer_processes_agree_on_dictionary(self, tmp_path):
+        """Two processes interleave writes; interner ids must not
+        collide (flock + dict-reload discipline)."""
+        import subprocess
+        import sys
+        import textwrap
+
+        script = textwrap.dedent(
+            """
+            import sys
+            sys.path.insert(0, {repo!r})
+            import datetime as dt
+            from predictionio_tpu.data import Event, DataMap
+            from predictionio_tpu.data.storage.eventlog import EventLogEvents
+
+            tag = sys.argv[1]
+            be = EventLogEvents({{"PATH": {path!r}}})
+            be.init(1)
+            for k in range(30):
+                be.insert(Event(
+                    event=f"ev-{{tag}}-{{k % 5}}",
+                    entity_type="user",
+                    entity_id=f"{{tag}}-u{{k}}",
+                    event_time=dt.datetime(2020, 1, 1, second=k % 60,
+                                           tzinfo=dt.timezone.utc),
+                ), 1)
+            print("done", tag)
+            """
+        ).format(repo="/root/repo", path=str(tmp_path))
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", script, tag],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+            )
+            for tag in ("A", "B")
+        ]
+        for p in procs:
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, err.decode()
+        be = EventLogEvents({"PATH": str(tmp_path)})
+        events = list(be.find(1))
+        assert len(events) == 60
+        # every record decodes to its writer's strings (no id collisions)
+        for e in events:
+            tag = e.entity_id.split("-")[0]
+            assert e.event.startswith(f"ev-{tag}-"), (
+                f"dictionary corruption: {e.event} vs {e.entity_id}"
+            )
+
+    def test_reader_sees_strings_interned_after_open(self, tmp_path):
+        """A long-lived reader must decode events whose strings were
+        interned by a writer after the reader opened the log."""
+        be_reader = EventLogEvents({"PATH": str(tmp_path)})
+        be_reader.init(1)
+        be_writer = EventLogEvents({"PATH": str(tmp_path)})
+        be_writer.insert(_rate("newuser", "newitem", 3.0, 1), 1)
+        got = list(be_reader.find(1))
+        assert len(got) == 1
+        assert got[0].entity_id == "newuser"
+        assert got[0].target_entity_id == "newitem"
