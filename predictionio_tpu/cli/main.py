@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from predictionio_tpu.version import __version__
@@ -464,8 +465,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: list[str] | None = None) -> int:
+    import logging
+
     from predictionio_tpu.cli.commands import CommandError
 
+    logging.basicConfig(
+        level=os.environ.get("PIO_LOG_LEVEL", "INFO"),
+        format="[%(levelname)s] [%(name)s] %(message)s",
+    )
     args = build_parser().parse_args(argv)
     try:
         return args.func(args)

@@ -11,6 +11,7 @@ start one such process per TPU host via
 
 from __future__ import annotations
 
+import dataclasses
 import datetime as _dt
 import logging
 from typing import Any, Sequence
@@ -88,8 +89,8 @@ def run_train(
                 ctx, params, workflow, algorithms=algorithms
             )
         timer.log_summary(prefix=f"[{engine_id}] ")
-        instance = EngineInstance(
-            **{**instance.__dict__, "env": {"timing": timer.to_json()}}
+        instance = dataclasses.replace(
+            instance, env={"timing": timer.to_json()}
         )
         if workflow.save_model:
             blob = serialize_models(instance_id, algorithms, models)
@@ -103,34 +104,22 @@ def run_train(
                 len(blob),
             )
         instances.update(
-            EngineInstance(
-                **{
-                    **instance.__dict__,
-                    "status": "COMPLETED",
-                    "end_time": _now(),
-                }
+            dataclasses.replace(
+                instance, status="COMPLETED", end_time=_now()
             )
         )
         return instance_id
     except (StopAfterReadInterruption, StopAfterPrepareInterruption):
         instances.update(
-            EngineInstance(
-                **{
-                    **instance.__dict__,
-                    "status": "INTERRUPTED",
-                    "end_time": _now(),
-                }
+            dataclasses.replace(
+                instance, status="INTERRUPTED", end_time=_now()
             )
         )
         raise
     except Exception:
         instances.update(
-            EngineInstance(
-                **{
-                    **instance.__dict__,
-                    "status": "FAILED",
-                    "end_time": _now(),
-                }
+            dataclasses.replace(
+                instance, status="FAILED", end_time=_now()
             )
         )
         raise
@@ -177,25 +166,19 @@ def run_evaluation(
         )
     except Exception:
         instances.update(
-            EvaluationInstance(
-                **{
-                    **instance.__dict__,
-                    "status": "FAILED",
-                    "end_time": _now(),
-                }
+            dataclasses.replace(
+                instance, status="FAILED", end_time=_now()
             )
         )
         raise
     instances.update(
-        EvaluationInstance(
-            **{
-                **instance.__dict__,
-                "status": "EVALCOMPLETED",
-                "end_time": _now(),
-                "evaluator_results": result.to_one_liner(),
-                "evaluator_results_html": result.to_html(),
-                "evaluator_results_json": result.to_json(),
-            }
+        dataclasses.replace(
+            instance,
+            status="EVALCOMPLETED",
+            end_time=_now(),
+            evaluator_results=result.to_one_liner(),
+            evaluator_results_html=result.to_html(),
+            evaluator_results_json=result.to_json(),
         )
     )
     return instance_id, result

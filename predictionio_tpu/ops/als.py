@@ -316,14 +316,16 @@ def train_als(
         if checkpoint_dir
         else None
     )
+    resumed_user_factors = None
     if resume and ckpt_path and os.path.exists(ckpt_path):
         with np.load(ckpt_path) as ckpt:
             if (
                 ckpt["item_factors"].shape == (n_items, rank)
-                and int(ckpt["iteration"]) < iterations
+                and int(ckpt["iteration"]) <= iterations
             ):
                 init = ckpt["item_factors"]
                 start_iteration = int(ckpt["iteration"])
+                resumed_user_factors = ckpt["user_factors"]
                 logger.info(
                     "resuming ALS from checkpoint at iteration %d",
                     start_iteration,
@@ -344,6 +346,7 @@ def train_als(
     )
 
     lam = jnp.asarray(reg, dtype)
+    user_factors = None
     for it in range(start_iteration, iterations):
         if timer is not None:
             with timer.step("als/user_solve", sync_value=None):
@@ -368,7 +371,14 @@ def train_als(
                 user_factors=np.asarray(user_factors)[:n_users],
             )
 
-    if user_factors is None:  # resumed at the final iteration count
+    if user_factors is None:
+        # loop never ran (iterations == 0, or resume at full count):
+        # use the checkpointed user factors if any, else solve once
+        if resumed_user_factors is not None:
+            return ALSFactors(
+                user_factors=resumed_user_factors[:n_users],
+                item_factors=np.asarray(item_factors)[:n_items],
+            )
         user_factors = solve_users(item_factors, *u_dev, lam)
     return ALSFactors(
         user_factors=np.asarray(user_factors)[:n_users],
@@ -382,6 +392,7 @@ def _sync_scalar(arr) -> None:
 
 
 def _write_checkpoint(path: str, **arrays) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
     tmp = path + ".tmp.npz"  # .npz suffix keeps np.savez from renaming
     np.savez(tmp, **arrays)
     os.replace(tmp, path)

@@ -306,3 +306,41 @@ class TestCheckpointResume:
         import json
 
         json.loads(timer.to_json())  # serializable
+
+
+class TestResumeEdgeCases:
+    def test_zero_iterations(self, ctx8):
+        rows = np.asarray([0, 1], np.int32)
+        cols = np.asarray([0, 1], np.int32)
+        vals = np.ones(2, np.float32)
+        f = train_als(
+            ctx8, rows, cols, vals, n_users=2, n_items=2, rank=2,
+            iterations=0, block_len=2, row_chunk=1,
+        )
+        assert f.user_factors.shape == (2, 2)
+        assert np.isfinite(f.user_factors).all()
+
+    def test_resume_at_full_iteration_count_uses_checkpoint(
+        self, ctx8, tmp_path
+    ):
+        rows = np.asarray([0, 1, 0], np.int32)
+        cols = np.asarray([0, 1, 1], np.int32)
+        vals = np.ones(3, np.float32)
+        kwargs = dict(
+            n_users=2, n_items=2, rank=2, block_len=2, row_chunk=1,
+            checkpoint_dir=str(tmp_path),
+        )
+        # externally-produced checkpoint at the requested count
+        from predictionio_tpu.ops.als import _write_checkpoint
+
+        _write_checkpoint(
+            str(tmp_path / "als_checkpoint.npz"),
+            iteration=4,
+            user_factors=np.full((2, 2), 7.0, np.float32),
+            item_factors=np.full((2, 2), 8.0, np.float32),
+        )
+        f = train_als(
+            ctx8, rows, cols, vals, iterations=4, resume=True, **kwargs
+        )
+        np.testing.assert_allclose(f.user_factors, 7.0)
+        np.testing.assert_allclose(f.item_factors, 8.0)
