@@ -469,8 +469,11 @@ def main(argv: list[str] | None = None) -> int:
 
     from predictionio_tpu.cli.commands import CommandError
 
+    level = os.environ.get("PIO_LOG_LEVEL", "INFO").upper()
+    if level not in logging.getLevelNamesMapping():
+        level = "INFO"
     logging.basicConfig(
-        level=os.environ.get("PIO_LOG_LEVEL", "INFO"),
+        level=level,
         format="[%(levelname)s] [%(name)s] %(message)s",
     )
     args = build_parser().parse_args(argv)

@@ -321,6 +321,7 @@ def train_als(
         with np.load(ckpt_path) as ckpt:
             if (
                 ckpt["item_factors"].shape == (n_items, rank)
+                and ckpt["user_factors"].shape == (n_users, rank)
                 and int(ckpt["iteration"]) <= iterations
             ):
                 init = ckpt["item_factors"]
@@ -346,7 +347,6 @@ def train_als(
     )
 
     lam = jnp.asarray(reg, dtype)
-    user_factors = None
     for it in range(start_iteration, iterations):
         if timer is not None:
             with timer.step("als/user_solve", sync_value=None):
