@@ -470,7 +470,7 @@ def main(argv: list[str] | None = None) -> int:
     from predictionio_tpu.cli.commands import CommandError
 
     level = os.environ.get("PIO_LOG_LEVEL", "INFO").upper()
-    if level not in logging.getLevelNamesMapping():
+    if not isinstance(logging.getLevelName(level), int):
         level = "INFO"
     logging.basicConfig(
         level=level,

@@ -34,6 +34,10 @@ from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage import Storage, get_storage
 from predictionio_tpu.parallel.mesh import ComputeContext
 from predictionio_tpu.serving.batching import MicroBatcher
+from predictionio_tpu.serving.plugins import (
+    OUTPUT_SNIFFER,
+    PluginContext,
+)
 from predictionio_tpu.serving.http import (
     HTTPError,
     HTTPServer,
@@ -59,6 +63,7 @@ class EngineServer:
         feedback_app_id: int | None = None,
         max_batch: int = 64,
         max_wait_ms: float = 2.0,
+        plugins: PluginContext | None = None,
     ):
         self._engine = engine
         self._params = params
@@ -73,6 +78,7 @@ class EngineServer:
         self._feedback_app_id = feedback_app_id
         self._max_batch = max_batch
         self._max_wait_ms = max_wait_ms
+        self._plugins = plugins or PluginContext()
 
         self._lock = threading.Lock()
         self._request_count = 0
@@ -87,6 +93,11 @@ class EngineServer:
         self.router.route("POST", "/queries.json", self._queries)
         self.router.route("POST", "/reload", self._reload)
         self.router.route("POST", "/stop", self._stop)
+        self.router.route("GET", "/plugins.json", self._plugins_route)
+        self.router.route(
+            "GET", "/plugins/<ptype>/<pname>/<rest:path>",
+            self._plugin_rest,
+        )
         self._http: HTTPServer | None = None
 
     # -- model loading / hot swap ----------------------------------------
@@ -166,6 +177,17 @@ class EngineServer:
         if self._feedback:
             prediction = self._record_feedback(query, prediction)
 
+        # plugin output blockers fold (CreateServer.scala:603-606)
+        engine_info = {
+            "engineId": self._engine_id,
+            "engineVersion": self._engine_version,
+            "engineVariant": self._engine_variant,
+        }
+        prediction = self._plugins.block_output(
+            engine_info, query, prediction
+        )
+        self._plugins.sniff_output(engine_info, query, prediction)
+
         elapsed = time.perf_counter() - t0
         with self._lock:
             self._request_count += 1
@@ -205,6 +227,21 @@ class EngineServer:
             prediction = {**prediction, "prId": pr_id}
         return prediction
 
+    def _plugins_route(self, request: Request) -> Response:
+        return Response(200, self._plugins.describe())
+
+    def _plugin_rest(self, request: Request) -> Response:
+        p = request.path_params
+        if p["ptype"] != OUTPUT_SNIFFER:
+            raise HTTPError(404, "unknown plugin type")
+        try:
+            body = self._plugins.handle_rest(
+                p["ptype"], p["pname"], p["rest"], dict(request.query)
+            )
+        except KeyError as e:
+            raise HTTPError(404, "plugin not found") from e
+        return Response(200, body)
+
     def _reload(self, request: Request) -> Response:
         self._load()
         return Response(200, {"message": "reloaded", "engineInstanceId": self._instance.id})
@@ -224,6 +261,7 @@ class EngineServer:
     def close(self) -> None:
         for b in self._batchers:
             b.close()
+        self._plugins.close()
 
 
 def create_engine_server(

@@ -15,7 +15,9 @@ Capability parity with the reference Event Server
 * ``POST /webhooks/<name>.json`` / ``.form`` → connector-mapped events
 
 Differences: thread-per-request stdlib HTTP instead of spray/akka;
-input plugins are a simple callable list instead of ServiceLoader.
+plugins come from an explicit :class:`PluginContext` (+ ``PIO_PLUGINS``
+env) instead of ServiceLoader; ``/plugins.json`` and
+``/plugins/<type>/<name>/<path>`` mirror the reference's plugin routes.
 """
 
 from __future__ import annotations
@@ -32,6 +34,11 @@ from predictionio_tpu.serving.http import (
     Request,
     Response,
     Router,
+)
+from predictionio_tpu.serving.plugins import (
+    INPUT_SNIFFER,
+    PluginContext,
+    PluginRejection,
 )
 from predictionio_tpu.serving.stats import Stats
 from predictionio_tpu.serving.webhooks import (
@@ -54,10 +61,12 @@ class EventServer:
         storage: Storage | None = None,
         stats: bool = False,
         input_blockers: list[InputBlocker] | None = None,
+        plugins: PluginContext | None = None,
     ):
         self._storage = storage or get_storage()
         self._stats = Stats() if stats else None
         self._input_blockers = list(input_blockers or [])
+        self._plugins = plugins or PluginContext()
         self.router = Router()
         r = self.router
         r.route("GET", "/", self._status)
@@ -69,6 +78,11 @@ class EventServer:
         r.route("GET", "/stats.json", self._stats_route)
         r.route("POST", "/webhooks/<name>.json", self._webhook_json)
         r.route("POST", "/webhooks/<name>.form", self._webhook_form)
+        r.route("GET", "/plugins.json", self._plugins_route)
+        r.route(
+            "GET", "/plugins/<ptype>/<pname>/<rest:path>",
+            self._plugin_rest,
+        )
 
     # -- auth (reference EventServer.scala:90-140) ------------------------
     def _auth(self, request: Request) -> tuple[int, int | None, tuple]:
@@ -113,7 +127,23 @@ class EventServer:
             )
         for blocker in self._input_blockers:
             blocker(event, app_id, channel_id)
-        return self._storage.get_events().insert(event, app_id, channel_id)
+        # only pay the JSON build when plugins are registered
+        event_json = (
+            event.to_json_dict() if self._plugins.plugins else None
+        )
+        if event_json is not None:
+            try:
+                self._plugins.block_input(
+                    event_json, app_id, channel_id
+                )
+            except PluginRejection as e:
+                raise HTTPError(e.status, str(e)) from e
+        event_id = self._storage.get_events().insert(
+            event, app_id, channel_id
+        )
+        if event_json is not None:
+            self._plugins.sniff_input(event_json, app_id, channel_id)
+        return event_id
 
     def _create_event(self, request: Request) -> Response:
         app_id, channel_id, whitelist = self._auth(request)
@@ -221,6 +251,21 @@ class EventServer:
             )
         return Response(200, self._stats.snapshot(app_id))
 
+    def _plugins_route(self, request: Request) -> Response:
+        return Response(200, self._plugins.describe())
+
+    def _plugin_rest(self, request: Request) -> Response:
+        p = request.path_params
+        if p["ptype"] != INPUT_SNIFFER:
+            raise HTTPError(404, "unknown plugin type")
+        try:
+            body = self._plugins.handle_rest(
+                p["ptype"], p["pname"], p["rest"], dict(request.query)
+            )
+        except KeyError as e:
+            raise HTTPError(404, "plugin not found") from e
+        return Response(200, body)
+
     def _webhook_json(self, request: Request) -> Response:
         app_id, channel_id, whitelist = self._auth(request)
         connector = JSON_CONNECTORS.get(request.path_params["name"])
@@ -259,7 +304,8 @@ def create_event_server(
     port: int = 7070,
     storage: Storage | None = None,
     stats: bool = False,
+    plugins: PluginContext | None = None,
 ) -> HTTPServer:
     """Reference EventServer.createEventServer (default port 7070)."""
-    server = EventServer(storage=storage, stats=stats)
+    server = EventServer(storage=storage, stats=stats, plugins=plugins)
     return HTTPServer(server.router, host=host, port=port)

@@ -81,16 +81,23 @@ class HTTPError(Exception):
 
 
 class Router:
-    """Method + regex path routing; ``<name>`` captures a segment."""
+    """Method + regex path routing; ``<name>`` captures a segment and
+    ``<name:path>`` captures the rest of the path (slashes included)."""
 
     def __init__(self):
         self._routes: list[tuple[str, re.Pattern, Handler]] = []
 
     def route(self, method: str, pattern: str, handler: Handler) -> None:
         # escape literal segments so '.' in '.json' doesn't match anything
-        parts = re.split(r"<([a-zA-Z_]+)>", pattern)
+        parts = re.split(r"<([a-zA-Z_]+(?::path)?)>", pattern)
         built = "".join(
-            f"(?P<{part}>[^/]+)" if i % 2 else re.escape(part)
+            (
+                f"(?P<{part.removesuffix(':path')}>.+)"
+                if part.endswith(":path")
+                else f"(?P<{part}>[^/]+)"
+            )
+            if i % 2
+            else re.escape(part)
             for i, part in enumerate(parts)
         )
         self._routes.append(

@@ -1,0 +1,282 @@
+"""Server plugin framework — input/output blockers and sniffers.
+
+Capability parity with the reference's two plugin SPIs:
+
+* Event Server plugins (data/.../api/EventServerPlugin.scala,
+  EventServerPluginContext.scala): ``inputblocker`` plugins run
+  synchronously before storage and may reject an event;
+  ``inputsniffer`` plugins observe accepted events asynchronously (the
+  reference routes them through ``PluginsActor``) and may expose REST
+  under ``/plugins/...``.
+* Engine Server plugins (core/.../workflow/EngineServerPlugin.scala,
+  EngineServerPluginContext.scala:35-88): ``outputblocker`` plugins are
+  folded over the prediction on the query hot path
+  (CreateServer.scala:603-606); ``outputsniffer`` plugins observe
+  (query, prediction) pairs asynchronously and serve REST
+  (EngineServerPluginsActor).
+
+TPU-first difference: the reference discovers plugins with
+``java.util.ServiceLoader`` from jars on the classpath. Class-name
+reflection is not idiomatic Python; plugins are passed explicitly to the
+:class:`PluginContext` constructor, or loaded from the ``PIO_PLUGINS``
+env var (comma-separated ``module:attr`` specs) — the entry-point
+registry called for by SURVEY.md §7(e).
+"""
+
+from __future__ import annotations
+
+import importlib
+import logging
+import os
+import queue
+import threading
+from typing import Any, Callable
+
+logger = logging.getLogger(__name__)
+
+# plugin_type values (reference EventServerPlugin.scala:25-26,
+# EngineServerPlugin.scala:28-29)
+INPUT_BLOCKER = "inputblocker"
+INPUT_SNIFFER = "inputsniffer"
+OUTPUT_BLOCKER = "outputblocker"
+OUTPUT_SNIFFER = "outputsniffer"
+
+
+class Plugin:
+    """Base for all server plugins.
+
+    Subclasses set ``plugin_name``, ``plugin_description`` and
+    ``plugin_type`` (one of the four type constants), mirroring the
+    reference's trait vals.
+    """
+
+    plugin_name: str = "plugin"
+    plugin_description: str = ""
+    plugin_type: str = INPUT_SNIFFER
+
+    def start(self, context: dict[str, Any]) -> None:
+        """Called once when the owning server starts."""
+
+    def handle_rest(
+        self, path: str, query: dict[str, str]
+    ) -> Any:
+        """Serve ``GET /plugins/<type>/<name>/<path>`` (sniffers)."""
+        raise NotImplementedError(
+            f"plugin {self.plugin_name} exposes no REST interface"
+        )
+
+
+class EventServerPlugin(Plugin):
+    """Event-side plugin (reference EventServerPlugin.scala:21-40)."""
+
+    def process(self, event_json: dict, app_id: int,
+                channel_id: int | None) -> None:
+        """Input blockers: raise :class:`PluginRejection` to reject the
+        event before it reaches storage. Input sniffers: observe
+        (called asynchronously off the request thread)."""
+
+
+class EngineServerPlugin(Plugin):
+    """Engine-side plugin (reference EngineServerPlugin.scala:21-40)."""
+
+    def process(
+        self, engine_info: dict, query: dict, prediction: Any
+    ) -> Any:
+        """Output blockers: return the (possibly modified) prediction —
+        returns are folded in registration order
+        (CreateServer.scala:603-606). Output sniffers: observe; the
+        return value is ignored."""
+        return prediction
+
+
+class PluginRejection(Exception):
+    """Raised by an input blocker to reject an event (HTTP 403)."""
+
+    def __init__(self, message: str, status: int = 403):
+        super().__init__(message)
+        self.status = status
+
+
+def load_plugin_spec(spec: str) -> Plugin:
+    """Instantiate a plugin from a ``module:attr`` spec."""
+    module_name, _, attr = spec.partition(":")
+    if not attr:
+        raise ValueError(
+            f"plugin spec {spec!r} must look like 'module:attr'"
+        )
+    obj = getattr(importlib.import_module(module_name), attr)
+    return obj() if isinstance(obj, type) else obj
+
+
+def plugins_from_env(env_var: str = "PIO_PLUGINS") -> list[Plugin]:
+    """Load plugins named in ``PIO_PLUGINS`` (comma-separated specs)."""
+    raw = os.environ.get(env_var, "").strip()
+    if not raw:
+        return []
+    plugins = []
+    for spec in raw.split(","):
+        spec = spec.strip()
+        if not spec:
+            continue
+        try:
+            plugins.append(load_plugin_spec(spec))
+        except Exception:  # noqa: BLE001 - a bad plugin must not kill boot
+            logger.exception("failed to load plugin %r", spec)
+    return plugins
+
+
+class _SnifferDispatcher:
+    """Async fan-out to sniffer plugins — the PluginsActor analogue.
+
+    Sniffer callbacks run on a single daemon thread so a slow or broken
+    sniffer can never block the request hot path.
+    """
+
+    def __init__(self) -> None:
+        self._queue: queue.Queue = queue.Queue(maxsize=10_000)
+        self._thread: threading.Thread | None = None
+        self._thread_lock = threading.Lock()
+        self._closed = False
+
+    def _ensure_thread(self) -> None:
+        with self._thread_lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True,
+                    name="plugin-sniffers",
+                )
+                self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            fn, args = item
+            try:
+                fn(*args)
+            except Exception:  # noqa: BLE001
+                logger.exception("sniffer plugin failed")
+
+    def submit(self, fn: Callable, *args) -> None:
+        if self._closed:
+            return
+        self._ensure_thread()
+        try:
+            self._queue.put_nowait((fn, args))
+        except queue.Full:
+            logger.warning("sniffer queue full; dropping notification")
+
+    def close(self) -> None:
+        self._closed = True
+        if self._thread is not None and self._thread.is_alive():
+            self._queue.put(None)
+
+
+class PluginContext:
+    """Holds a server's plugins, split by type.
+
+    Reference: EventServerPluginContext.scala:30-60 /
+    EngineServerPluginContext.scala:35-88 (there built from
+    ServiceLoader; here from explicit lists + ``PIO_PLUGINS``).
+    """
+
+    def __init__(
+        self,
+        plugins: list[Plugin] | None = None,
+        load_env: bool = True,
+    ):
+        self.plugins: list[Plugin] = list(plugins or [])
+        if load_env:
+            self.plugins.extend(plugins_from_env())
+        self._dispatcher = _SnifferDispatcher()
+        for p in self.plugins:
+            try:
+                p.start({})
+            except Exception:  # noqa: BLE001
+                logger.exception(
+                    "plugin %s failed to start", p.plugin_name
+                )
+
+    def of_type(self, plugin_type: str) -> list[Plugin]:
+        return [
+            p for p in self.plugins if p.plugin_type == plugin_type
+        ]
+
+    @property
+    def input_blockers(self) -> list[Plugin]:
+        return self.of_type(INPUT_BLOCKER)
+
+    @property
+    def input_sniffers(self) -> list[Plugin]:
+        return self.of_type(INPUT_SNIFFER)
+
+    @property
+    def output_blockers(self) -> list[Plugin]:
+        return self.of_type(OUTPUT_BLOCKER)
+
+    @property
+    def output_sniffers(self) -> list[Plugin]:
+        return self.of_type(OUTPUT_SNIFFER)
+
+    # -- hot-path helpers -------------------------------------------------
+    def block_input(
+        self, event_json: dict, app_id: int, channel_id: int | None
+    ) -> None:
+        """Run input blockers synchronously; raises PluginRejection."""
+        for p in self.input_blockers:
+            p.process(event_json, app_id, channel_id)
+
+    def sniff_input(
+        self, event_json: dict, app_id: int, channel_id: int | None
+    ) -> None:
+        """Notify input sniffers asynchronously."""
+        for p in self.input_sniffers:
+            self._dispatcher.submit(
+                p.process, event_json, app_id, channel_id
+            )
+
+    def block_output(
+        self, engine_info: dict, query: dict, prediction: Any
+    ) -> Any:
+        """Fold output blockers over the prediction."""
+        for p in self.output_blockers:
+            prediction = p.process(engine_info, query, prediction)
+        return prediction
+
+    def sniff_output(
+        self, engine_info: dict, query: dict, prediction: Any
+    ) -> None:
+        """Notify output sniffers asynchronously."""
+        for p in self.output_sniffers:
+            self._dispatcher.submit(
+                p.process, engine_info, query, prediction
+            )
+
+    # -- REST surface -----------------------------------------------------
+    def describe(self) -> dict:
+        """``GET /plugins.json`` body (reference ServerActor:658-678)."""
+        return {
+            "plugins": {
+                p.plugin_name: {
+                    "name": p.plugin_name,
+                    "description": p.plugin_description,
+                    "class": type(p).__name__,
+                    "type": p.plugin_type,
+                }
+                for p in self.plugins
+            }
+        }
+
+    def handle_rest(
+        self, plugin_type: str, name: str, path: str,
+        query: dict[str, str],
+    ) -> Any:
+        """Dispatch ``GET /plugins/<type>/<name>/<path>``."""
+        for p in self.of_type(plugin_type):
+            if p.plugin_name == name:
+                return p.handle_rest(path, query)
+        raise KeyError(name)
+
+    def close(self) -> None:
+        self._dispatcher.close()
