@@ -3,7 +3,7 @@
 Verbs: version, status, app (new/list/show/delete/data-delete/
 channel-new/channel-delete), accesskey (new/list/delete), build, train,
 eval, deploy, undeploy, eventserver, dashboard, adminserver, export,
-import, template (list).
+import, template (list/get), run.
 
 Where the reference shells out to spark-submit (Runner.scala:92-210),
 this console runs workflows in-process: multi-host TPU runs launch this
@@ -331,12 +331,100 @@ def cmd_import(args) -> int:
     return 0
 
 
+def _templates_dir() -> str:
+    """Bundled template gallery (the offline stand-in for the
+    reference's GitHub gallery, console/Template.scala:130-429)."""
+    env = os.environ.get("PIO_TEMPLATES_DIR")
+    if env:
+        return env
+    import predictionio_tpu
+
+    return os.path.join(
+        os.path.dirname(os.path.dirname(predictionio_tpu.__file__)),
+        "examples",
+    )
+
+
 def cmd_template(args) -> int:
     from predictionio_tpu.core.registry import engine_registry
     import predictionio_tpu.models  # noqa: F401  (registers built-ins)
 
+    if args.template_command == "get":
+        import shutil
+
+        src = args.template
+        if not os.path.isdir(src):
+            src = os.path.join(_templates_dir(), args.template)
+        if not os.path.isdir(src):
+            print(
+                f"error: template {args.template!r} not found "
+                f"(looked in {_templates_dir()}); `pio-tpu template "
+                f"list` shows bundled engines",
+                file=sys.stderr,
+            )
+            return 1
+        dst = args.directory
+        if os.path.exists(dst) and (
+            not os.path.isdir(dst) or os.listdir(dst)
+        ):
+            print(
+                f"error: destination {dst!r} exists and is not an "
+                f"empty directory",
+                file=sys.stderr,
+            )
+            return 1
+        shutil.copytree(
+            src, dst, dirs_exist_ok=True,
+            ignore=shutil.ignore_patterns("__pycache__"),
+        )
+        # personalize engine.json (the reference's scaffolding prompts,
+        # Template.scala:226-369, taken from flags instead)
+        variant_path = os.path.join(dst, "engine.json")
+        if args.engine_id and os.path.exists(variant_path):
+            with open(variant_path) as f:
+                variant = json.load(f)
+            variant["id"] = args.engine_id
+            with open(variant_path, "w") as f:
+                json.dump(variant, f, indent=2)
+                f.write("\n")
+        print(f"created engine project at {dst}")
+        return 0
+
+    # template list: bundled gallery + registered engine factories
+    gallery = _templates_dir()
+    if os.path.isdir(gallery):
+        for name in sorted(os.listdir(gallery)):
+            if os.path.isdir(os.path.join(gallery, name)):
+                print(name)
     for name in sorted(engine_registry()):
         print(name)
+    return 0
+
+
+def cmd_run(args) -> int:
+    """Run an arbitrary ``module:fn`` under the full PIO environment —
+    storage configured, multi-host initialized, ComputeContext built
+    (the FakeWorkflow/FakeRun analogue, workflow/FakeWorkflow.scala:29-106).
+    The callable receives the ComputeContext."""
+    import importlib
+
+    module_name, _, attr = args.target.partition(":")
+    if not attr:
+        print(
+            "error: run target must look like 'module:function'",
+            file=sys.stderr,
+        )
+        return 1
+    sys.path.insert(0, os.getcwd())
+    try:
+        fn = getattr(importlib.import_module(module_name), attr)
+    except (ImportError, AttributeError) as e:
+        print(f"error: cannot load {args.target!r}: {e}", file=sys.stderr)
+        return 1
+    ctx = _mesh_ctx(args)
+    result = fn(ctx)
+    if result is not None:
+        print(json.dumps(result, default=str))
     return 0
 
 
@@ -459,7 +547,17 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("template")
     tp = p.add_subparsers(dest="template_command", required=True)
     tp.add_parser("list")
+    tg = tp.add_parser("get")
+    tg.add_argument("template", help="bundled template name or path")
+    tg.add_argument("directory", help="destination project directory")
+    tg.add_argument("--engine-id", dest="engine_id")
     p.set_defaults(func=cmd_template)
+
+    p = sub.add_parser("run")
+    p.add_argument("target", help="module:function receiving a ComputeContext")
+    p.add_argument("--batch", default="run")
+    p.add_argument("--mesh-shape", dest="mesh_shape")
+    p.set_defaults(func=cmd_run)
 
     return parser
 

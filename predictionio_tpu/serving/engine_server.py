@@ -37,6 +37,7 @@ from predictionio_tpu.serving.batching import MicroBatcher
 from predictionio_tpu.serving.plugins import (
     OUTPUT_SNIFFER,
     PluginContext,
+    install_plugin_routes,
 )
 from predictionio_tpu.serving.http import (
     HTTPError,
@@ -93,11 +94,7 @@ class EngineServer:
         self.router.route("POST", "/queries.json", self._queries)
         self.router.route("POST", "/reload", self._reload)
         self.router.route("POST", "/stop", self._stop)
-        self.router.route("GET", "/plugins.json", self._plugins_route)
-        self.router.route(
-            "GET", "/plugins/<ptype>/<pname>/<rest:path>",
-            self._plugin_rest,
-        )
+        install_plugin_routes(self.router, self._plugins, OUTPUT_SNIFFER)
         self._http: HTTPServer | None = None
 
     # -- model loading / hot swap ----------------------------------------
@@ -226,21 +223,6 @@ class EngineServer:
         if isinstance(prediction, dict):
             prediction = {**prediction, "prId": pr_id}
         return prediction
-
-    def _plugins_route(self, request: Request) -> Response:
-        return Response(200, self._plugins.describe())
-
-    def _plugin_rest(self, request: Request) -> Response:
-        p = request.path_params
-        if p["ptype"] != OUTPUT_SNIFFER:
-            raise HTTPError(404, "unknown plugin type")
-        try:
-            body = self._plugins.handle_rest(
-                p["ptype"], p["pname"], p["rest"], dict(request.query)
-            )
-        except KeyError as e:
-            raise HTTPError(404, "plugin not found") from e
-        return Response(200, body)
 
     def _reload(self, request: Request) -> Response:
         self._load()

@@ -39,6 +39,7 @@ from predictionio_tpu.serving.plugins import (
     INPUT_SNIFFER,
     PluginContext,
     PluginRejection,
+    install_plugin_routes,
 )
 from predictionio_tpu.serving.stats import Stats
 from predictionio_tpu.serving.webhooks import (
@@ -78,11 +79,7 @@ class EventServer:
         r.route("GET", "/stats.json", self._stats_route)
         r.route("POST", "/webhooks/<name>.json", self._webhook_json)
         r.route("POST", "/webhooks/<name>.form", self._webhook_form)
-        r.route("GET", "/plugins.json", self._plugins_route)
-        r.route(
-            "GET", "/plugins/<ptype>/<pname>/<rest:path>",
-            self._plugin_rest,
-        )
+        install_plugin_routes(r, self._plugins, INPUT_SNIFFER)
 
     # -- auth (reference EventServer.scala:90-140) ------------------------
     def _auth(self, request: Request) -> tuple[int, int | None, tuple]:
@@ -251,21 +248,6 @@ class EventServer:
             )
         return Response(200, self._stats.snapshot(app_id))
 
-    def _plugins_route(self, request: Request) -> Response:
-        return Response(200, self._plugins.describe())
-
-    def _plugin_rest(self, request: Request) -> Response:
-        p = request.path_params
-        if p["ptype"] != INPUT_SNIFFER:
-            raise HTTPError(404, "unknown plugin type")
-        try:
-            body = self._plugins.handle_rest(
-                p["ptype"], p["pname"], p["rest"], dict(request.query)
-            )
-        except KeyError as e:
-            raise HTTPError(404, "plugin not found") from e
-        return Response(200, body)
-
     def _webhook_json(self, request: Request) -> Response:
         app_id, channel_id, whitelist = self._auth(request)
         connector = JSON_CONNECTORS.get(request.path_params["name"])
@@ -297,6 +279,10 @@ class EventServer:
         if self._stats:
             self._stats.update(app_id, 201, event)
         return Response(201, {"eventId": event_id})
+
+    def close(self) -> None:
+        """Release the plugin sniffer dispatcher thread."""
+        self._plugins.close()
 
 
 def create_event_server(

@@ -97,6 +97,10 @@ class PluginRejection(Exception):
         self.status = status
 
 
+class PluginNotFound(LookupError):
+    """No plugin with the requested type+name is registered."""
+
+
 def load_plugin_spec(spec: str) -> Plugin:
     """Instantiate a plugin from a ``module:attr`` spec."""
     module_name, _, attr = spec.partition(":")
@@ -272,11 +276,50 @@ class PluginContext:
         self, plugin_type: str, name: str, path: str,
         query: dict[str, str],
     ) -> Any:
-        """Dispatch ``GET /plugins/<type>/<name>/<path>``."""
+        """Dispatch ``GET /plugins/<type>/<name>/<path>``.
+
+        Raises :class:`PluginNotFound` for an unknown plugin; plugin
+        exceptions (including KeyError) propagate unchanged so they
+        surface as plugin errors, not 404s.
+        """
         for p in self.of_type(plugin_type):
             if p.plugin_name == name:
-                return p.handle_rest(path, query)
-        raise KeyError(name)
+                break
+        else:
+            raise PluginNotFound(f"{plugin_type}/{name}")
+        return p.handle_rest(path, query)
 
     def close(self) -> None:
         self._dispatcher.close()
+
+
+def install_plugin_routes(
+    router, plugins: PluginContext, sniffer_type: str
+) -> None:
+    """Register ``GET /plugins.json`` + ``GET /plugins/<type>/<name>/…``
+    on a server router (shared by the event and engine servers;
+    reference ServerActor:658-678 / EventServer plugin routes).
+    ``sniffer_type`` is the plugin type whose REST surface this server
+    exposes (inputsniffer vs outputsniffer).
+    """
+    from predictionio_tpu.serving.http import HTTPError, Response
+
+    def plugins_json(request):
+        return Response(200, plugins.describe())
+
+    def plugin_rest(request):
+        p = request.path_params
+        if p["ptype"] != sniffer_type:
+            raise HTTPError(404, "unknown plugin type")
+        try:
+            body = plugins.handle_rest(
+                p["ptype"], p["pname"], p["rest"], dict(request.query)
+            )
+        except PluginNotFound as e:
+            raise HTTPError(404, "plugin not found") from e
+        return Response(200, body)
+
+    router.route("GET", "/plugins.json", plugins_json)
+    router.route(
+        "GET", "/plugins/<ptype>/<pname>/<rest:path>", plugin_rest
+    )

@@ -274,3 +274,48 @@ class TestDashboard:
             assert status == 404
         finally:
             http.shutdown()
+
+
+class TestTemplateAndRun:
+    def test_template_list(self, cli):
+        code, out, _ = cli("template", "list")
+        assert code == 0
+        assert "classification" in out and "recommendation" in out
+
+    def test_template_get(self, cli, tmp_path):
+        dst = str(tmp_path / "myengine")
+        code, out, _ = cli(
+            "template", "get", "classification", dst,
+            "--engine-id", "my-classifier",
+        )
+        assert code == 0
+        variant = json.loads((tmp_path / "myengine" / "engine.json").read_text())
+        assert variant["id"] == "my-classifier"
+
+    def test_template_get_missing(self, cli, tmp_path):
+        code, _, err = cli(
+            "template", "get", "no-such-template", str(tmp_path / "x")
+        )
+        assert code == 1 and "not found" in err
+
+    def test_template_get_nonempty_dest(self, cli, tmp_path):
+        (tmp_path / "occupied").mkdir()
+        (tmp_path / "occupied" / "f").write_text("x")
+        code, _, err = cli(
+            "template", "get", "classification", str(tmp_path / "occupied")
+        )
+        assert code == 1 and "empty directory" in err
+
+    def test_run(self, cli, tmp_path, monkeypatch):
+        (tmp_path / "fakejob.py").write_text(
+            "def job(ctx):\n"
+            "    return {'devices': ctx.mesh.devices.size}\n"
+        )
+        monkeypatch.chdir(tmp_path)
+        code, out, _ = cli("run", "fakejob:job")
+        assert code == 0
+        assert json.loads(out)["devices"] >= 1
+
+    def test_run_bad_target(self, cli):
+        code, _, err = cli("run", "nocolon")
+        assert code == 1 and "module:function" in err

@@ -216,3 +216,28 @@ def test_plugins_from_env(monkeypatch):
 def test_bad_spec_raises():
     with pytest.raises(ValueError):
         load_plugin_spec("no_colon_here")
+
+
+def test_plugin_internal_keyerror_not_masked(server):
+    """A KeyError raised inside a plugin's handle_rest must surface as a
+    500 plugin error, not a 404 'plugin not found'."""
+
+    class Broken(EventServerPlugin):
+        plugin_name = "broken"
+        plugin_type = INPUT_SNIFFER
+
+        def handle_rest(self, path, query):
+            return query["missing-param"]
+
+    http, _, _ = server
+    # register on a fresh server sharing nothing with the fixture
+    ctx = PluginContext([Broken()], load_env=False)
+    try:
+        with pytest.raises(KeyError):
+            ctx.handle_rest("inputsniffer", "broken", "x", {})
+        from predictionio_tpu.serving.plugins import PluginNotFound
+
+        with pytest.raises(PluginNotFound):
+            ctx.handle_rest("inputsniffer", "nope", "x", {})
+    finally:
+        ctx.close()

@@ -1,0 +1,128 @@
+"""DataView cache tests (reference data/view/DataView.scala:34-100)."""
+
+import datetime as dt
+import os
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.store import EventStore
+from predictionio_tpu.data.storage import App
+from predictionio_tpu.data.view import (
+    DataView,
+    frame_from_npz,
+    frame_to_npz,
+)
+
+
+def _seed(storage, n=20):
+    app_id = storage.get_meta_data_apps().insert(
+        App(id=0, name="viewapp")
+    )
+    events = storage.get_events()
+    events.init(app_id)
+    t0 = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+    for i in range(n):
+        events.insert(
+            Event(
+                event="rate" if i % 2 else "view",
+                entity_type="user",
+                entity_id=f"u{i % 5}",
+                target_entity_type="item",
+                target_entity_id=f"i{i % 7}",
+                properties=DataMap({"rating": float(i % 5 + 1)}),
+                event_time=t0 + dt.timedelta(minutes=i),
+            ),
+            app_id,
+        )
+    return app_id
+
+
+@pytest.fixture
+def view(memory_storage, tmp_path):
+    _seed(memory_storage)
+    store = EventStore(memory_storage)
+    return DataView(store=store, base_dir=str(tmp_path))
+
+
+def test_roundtrip_npz(memory_storage, tmp_path):
+    _seed(memory_storage)
+    frame = EventStore(memory_storage).frame("viewapp")
+    path = str(tmp_path / "f.npz")
+    frame_to_npz(frame, path)
+    back = frame_from_npz(path)
+    assert len(back) == len(frame)
+    assert list(back.event) == list(frame.event)
+    assert back.properties == frame.properties
+    np.testing.assert_allclose(back.event_time, frame.event_time)
+
+
+def test_create_materializes_and_hits_cache(view, tmp_path):
+    frame = view.create("viewapp")
+    assert len(frame) == 20
+    cached = [
+        f
+        for f in os.listdir(tmp_path / "view")
+        if f.endswith(".npz")
+    ]
+    assert len(cached) == 1
+    # cache hit: returns same data without touching the store
+    frame2 = view.create("viewapp")
+    assert list(frame2.entity_id) == list(frame.entity_id)
+
+
+def test_key_varies_with_query(view):
+    p1 = view.path_for(app_name="viewapp")
+    p2 = view.path_for(app_name="viewapp", event_names=["rate"])
+    p3 = view.path_for(app_name="viewapp", version="v2")
+    assert len({p1, p2, p3}) == 3
+
+
+def test_filtered_view(view):
+    frame = view.create("viewapp", event_names=["rate"])
+    assert set(frame.event) == {"rate"}
+    assert len(frame) == 10
+
+
+def test_cache_is_stale_until_refresh(view, memory_storage):
+    view.create("viewapp")
+    # add one more event after materialization
+    memory_storage.get_events().insert(
+        Event(
+            event="view",
+            entity_type="user",
+            entity_id="u-new",
+            target_entity_type="item",
+            target_entity_id="i-new",
+        ),
+        memory_storage.get_meta_data_apps().get_by_name("viewapp").id,
+    )
+    assert len(view.create("viewapp")) == 20  # stale by design
+    assert len(view.create("viewapp", refresh=True)) == 21
+
+
+def test_corrupt_cache_rebuilds(view, tmp_path):
+    view.create("viewapp")
+    (cache,) = (tmp_path / "view").glob("*.npz")
+    cache.write_bytes(b"not an npz")
+    frame = view.create("viewapp")
+    assert len(frame) == 20
+
+
+def test_clear(view, tmp_path):
+    view.create("viewapp")
+    view.create("viewapp", version="v2")
+    assert view.clear() == 2
+    assert view.clear() == 0
+
+
+def test_time_range_view(view):
+    t0 = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+    frame = view.create(
+        "viewapp",
+        start_time=t0,
+        until_time=t0 + dt.timedelta(minutes=10),
+    )
+    assert len(frame) == 10
