@@ -391,12 +391,15 @@ def cmd_template(args) -> int:
         return 0
 
     # template list: bundled gallery + registered engine factories
+    names = set(engine_registry())
     gallery = _templates_dir()
     if os.path.isdir(gallery):
-        for name in sorted(os.listdir(gallery)):
-            if os.path.isdir(os.path.join(gallery, name)):
-                print(name)
-    for name in sorted(engine_registry()):
+        names.update(
+            name
+            for name in os.listdir(gallery)
+            if os.path.isdir(os.path.join(gallery, name))
+        )
+    for name in sorted(names):
         print(name)
     return 0
 

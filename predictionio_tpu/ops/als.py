@@ -3,23 +3,35 @@
 Replaces MLlib ``ALS.trainImplicit`` / ``ALS.train`` (the reference
 recommendation + similar-product templates, examples/scala-parallel-
 recommendation/custom-query/src/main/scala/ALSAlgorithm.scala:24-77)
-with a TPU-native formulation (Hu-Koren-Volinsky implicit feedback):
+with a TPU-native formulation (Hu-Koren-Volinsky implicit feedback).
 
-* Host side, interactions are packed into a **padded block-CSR**: each
-  entity's interaction list is split into fixed-length blocks of ``L``
-  (heavy rows span several blocks), giving dense ``[R, L]`` index/weight
-  arrays — the fixed-shape boundary that replaces MLlib's by-key RDD
-  blocking.
-* Device side, one solve is: gather factors ``[B, L, k]`` → batched
-  einsum partial Gramians (MXU) → segment-sum by owner →
-  ``psum_scatter`` over the mesh data axis (each device keeps its slice
-  of the normal equations) → **batched Cholesky solves** → ``all_gather``
-  the updated factors. Communication is exactly one reduce-scatter and
-  one all-gather per half-iteration, riding ICI — the collectives
-  replacing Spark's shuffle (SURVEY.md §2.9).
+Design — built around what the TPU is good at (dense batched matmul on
+the MXU) and bad at (scatter with colliding indices, which XLA
+serializes):
+
+* Host side, interactions are packed into **degree-bucketed slabs**
+  (:func:`build_bucketed`): rows are grouped by ``ceil(degree /
+  block_len)`` rounded up to a power of two, so every row in a bucket
+  owns one dense ``[s * L]`` slot row. A row's whole interaction list
+  lives in one slab row — the fixed-shape boundary that replaces
+  MLlib's by-key RDD blocking.
+* Device side, one half-iteration is, per bucket: gather factors
+  ``[R, W, k]`` → batched einsum Gramians (MXU) → **dense** per-row
+  normal equations — no scatter, no segment-sum. Only rows heavier
+  than ``s_max`` blocks (the handful at the head of the power law) are
+  split into sub-rows whose partial stats are combined with one small
+  scatter-add. Batched Cholesky solves finish the update.
+* On the mesh, every slab is sharded over the ``data`` axis **by row**,
+  so each device owns its rows' normal equations end-to-end: the only
+  collective per half-iteration is the all-gather that rebuilds the
+  replicated factor matrix for the next gather pass (SURVEY.md §2.9 —
+  the collectives replacing Spark's shuffle).
+* Whole epochs run inside a single jitted ``lax.fori_loop``
+  (:func:`train_als` dispatches ``checkpoint_every``-sized chunks), so
+  host↔device round-trips are amortized across iterations.
 
 Both implicit (confidence c=1+αr, preferences) and explicit (observed
-ratings, weighted-λ regularization like MLlib) modes are provided.
+ratings, MLlib-style weighted-λ regularization) modes are provided.
 """
 
 from __future__ import annotations
@@ -33,9 +45,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import PartitionSpec as P
 
-from predictionio_tpu.parallel.mesh import DATA_AXIS, ComputeContext
+from predictionio_tpu.parallel.mesh import ComputeContext
 
 logger = logging.getLogger(__name__)
 
@@ -47,7 +58,11 @@ logger = logging.getLogger(__name__)
 
 @dataclasses.dataclass
 class PaddedCSR:
-    """Fixed-shape blocked interaction lists for one solve direction."""
+    """Fixed-shape blocked interaction lists for one solve direction.
+
+    Retained as the simple packing primitive (tests / external callers);
+    :func:`train_als` itself uses the bucketed layout below.
+    """
 
     idx: np.ndarray      # [R, L] int32 — column ids (0 where padded)
     weights: np.ndarray  # [R, L] float32 — interaction value
@@ -113,57 +128,228 @@ def build_padded_csr(
     )
 
 
+@dataclasses.dataclass
+class Slab:
+    """One degree bucket: every row owns one dense slot row."""
+
+    idx: np.ndarray      # [R, W] int32 — column ids (0 where padded)
+    weights: np.ndarray  # [R, W] float32
+    valid: np.ndarray    # [R, W] float32
+
+
+@dataclasses.dataclass
+class Bucketed:
+    """Degree-bucketed interaction layout for one solve direction.
+
+    ``slabs`` hold rows with ≤ ``s_max`` blocks (one slot row each,
+    phantom rows appended so each slab splits evenly over the mesh).
+    ``heavy`` holds the sub-row slabs of rows heavier than ``s_max``
+    blocks; ``heavy_owner_pos`` maps each sub-row to its owner's
+    position in the concatenated stats layout. ``inv_perm[row]`` is the
+    row's position in that layout (heavy rows own one zero-initialized
+    slot each, after all regular slab rows).
+    """
+
+    slabs: list[Slab]
+    heavy: Slab | None
+    heavy_owner_pos: np.ndarray | None  # [R_sub] int32
+    inv_perm: np.ndarray                # [n_rows_padded] int32
+    n_stat_rows: int                    # rows in the concatenated layout
+    n_rows: int
+    n_rows_padded: int
+
+    @property
+    def padded_nnz(self) -> int:
+        total = sum(s.idx.size for s in self.slabs)
+        if self.heavy is not None:
+            total += self.heavy.idx.size
+        return total
+
+
+def build_bucketed(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    n_rows: int,
+    block_len: int = 64,
+    row_multiple: int = 1,
+    s_max: int = 16,
+) -> Bucketed:
+    """Pack COO → degree-bucketed slabs (vectorized host preprocessing).
+
+    Rows are assigned to buckets of ``s`` blocks (``s`` a power of two,
+    ``s ≤ s_max``); a bucket's slab is a dense ``[R_b, s·block_len]``
+    array where row ``j`` holds that entity's entire interaction list
+    (zero-padded). Rows needing more than ``s_max`` blocks are split
+    into sub-rows of width ``s_max·block_len`` in the ``heavy`` slab.
+    """
+    if block_len < 1 or s_max < 1:
+        raise ValueError("block_len and s_max must be ≥ 1")
+    n_rows_padded = max(
+        row_multiple, -(-n_rows // row_multiple) * row_multiple
+    )
+    rows = np.asarray(rows, np.int64)
+    order = np.argsort(rows, kind="stable")
+    r = rows[order]
+    c = np.asarray(cols, np.int64)[order]
+    v = np.asarray(vals, np.float32)[order]
+    deg = np.bincount(r, minlength=n_rows_padded).astype(np.int64)
+    row_start = np.concatenate([[0], np.cumsum(deg)[:-1]])
+    idx_in_row = (np.arange(len(r)) - row_start[r]).astype(np.int64)
+
+    nseg = np.maximum(-(-deg // block_len), 1)
+    # bucket size: next power of two ≥ nseg, capped at s_max
+    s_of_row = np.minimum(
+        2 ** np.ceil(np.log2(nseg)).astype(np.int64), s_max
+    )
+    is_heavy = nseg > s_max
+
+    bucket_sizes = sorted(int(s) for s in np.unique(s_of_row[~is_heavy]))
+    if not bucket_sizes:
+        bucket_sizes = [1]
+
+    slabs: list[Slab] = []
+    inv_perm = np.zeros(n_rows_padded, np.int64)
+    offset = 0
+    row_ids = np.arange(n_rows_padded)
+    for s in bucket_sizes:
+        members = row_ids[(s_of_row == s) & ~is_heavy]
+        rb = max(
+            row_multiple,
+            -(-len(members) // row_multiple) * row_multiple,
+        )
+        width = s * block_len
+        slab = Slab(
+            idx=np.zeros((rb, width), np.int32),
+            weights=np.zeros((rb, width), np.float32),
+            valid=np.zeros((rb, width), np.float32),
+        )
+        # nnz of member rows land at (local row, idx_in_row)
+        local_of_row = np.full(n_rows_padded, -1, np.int64)
+        local_of_row[members] = np.arange(len(members))
+        sel = local_of_row[r] >= 0
+        sel &= s_of_row[r] == s
+        lr = local_of_row[r[sel]]
+        pos = idx_in_row[sel]
+        slab.idx[lr, pos] = c[sel]
+        slab.weights[lr, pos] = v[sel]
+        slab.valid[lr, pos] = 1.0
+        slabs.append(slab)
+        inv_perm[members] = offset + np.arange(len(members))
+        offset += rb
+
+    heavy_rows = row_ids[is_heavy]
+    heavy = None
+    heavy_owner_pos = None
+    if len(heavy_rows):
+        # one stats slot per heavy row, after all regular slab rows
+        inv_perm[heavy_rows] = offset + np.arange(len(heavy_rows))
+        width = s_max * block_len
+        nsub_of = -(-deg[heavy_rows] // width)
+        n_sub = int(nsub_of.sum())
+        rb = max(
+            row_multiple, -(-n_sub // row_multiple) * row_multiple
+        )
+        heavy = Slab(
+            idx=np.zeros((rb, width), np.int32),
+            weights=np.zeros((rb, width), np.float32),
+            valid=np.zeros((rb, width), np.float32),
+        )
+        sub_base = np.zeros(n_rows_padded, np.int64)
+        sub_base[heavy_rows] = np.concatenate(
+            [[0], np.cumsum(nsub_of)[:-1]]
+        )
+        sel = is_heavy[r]
+        sub = sub_base[r[sel]] + idx_in_row[sel] // width
+        pos = idx_in_row[sel] % width
+        heavy.idx[sub, pos] = c[sel]
+        heavy.weights[sub, pos] = v[sel]
+        heavy.valid[sub, pos] = 1.0
+        heavy_owner_pos = np.zeros(rb, np.int32)
+        heavy_owner_pos[:n_sub] = np.repeat(
+            inv_perm[heavy_rows], nsub_of
+        ).astype(np.int32)
+        # phantom sub-rows have zero valid/weights: owner 0 is harmless
+        offset += len(heavy_rows)
+
+    return Bucketed(
+        slabs=slabs,
+        heavy=heavy,
+        heavy_owner_pos=heavy_owner_pos,
+        inv_perm=inv_perm.astype(np.int32),
+        n_stat_rows=offset,
+        n_rows=n_rows,
+        n_rows_padded=n_rows_padded,
+    )
+
+
 # --------------------------------------------------------------------------
 # Device-side solve
 # --------------------------------------------------------------------------
 
 
-def _local_stats(
-    y, idx, weights, valid, owner, n_rows, row_chunk, implicit, alpha,
-    axis_name=None,
-):
-    """Scan this shard's blocks, accumulating normal-equation stats."""
-    k = y.shape[1]
-    n_chunks = idx.shape[0] // row_chunk
-    dtype = y.dtype
-
-    def body(carry, chunk):
-        a_acc, b_acc, cnt_acc = carry
-        ii, ww, vv, oo = chunk
-        yg = y[ii]  # [B, L, k] gather
-        mask = vv  # explicit validity: a real 0-valued rating still counts
-        if implicit:
-            aw = alpha * ww * mask      # C - I  (zero on padding)
-            bw = mask + alpha * ww * mask  # c * p on observed
-        else:
-            aw = mask
-            bw = ww * mask
-        a_part = jnp.einsum(
-            "blk,bl,blm->bkm", yg, aw, yg, preferred_element_type=dtype
-        )
-        b_part = jnp.einsum("blk,bl->bk", yg, bw)
-        cnt_part = mask.sum(axis=1)
-        a_acc = a_acc.at[oo].add(a_part)
-        b_acc = b_acc.at[oo].add(b_part)
-        cnt_acc = cnt_acc.at[oo].add(cnt_part)
-        return (a_acc, b_acc, cnt_acc), None
-
-    init = (
-        jnp.zeros((n_rows, k, k), dtype),
-        jnp.zeros((n_rows, k), dtype),
-        jnp.zeros((n_rows,), dtype),
+def _slab_stats(y, idx, weights, valid, implicit, alpha, dtype):
+    """Per-row normal-equation pieces for one dense slab — pure MXU."""
+    yg = y[idx]  # [R, W, k] gather (unique rows per device slice)
+    mask = valid  # a real 0-valued explicit rating still counts
+    if implicit:
+        aw = alpha * weights * mask          # C − I (zero on padding)
+        bw = mask + alpha * weights * mask   # c·p on observed
+    else:
+        aw = mask
+        bw = weights * mask
+    a = jnp.einsum(
+        "rlk,rl,rlm->rkm", yg, aw, yg, preferred_element_type=dtype
     )
-    if axis_name is not None:
-        # under shard_map the carry accumulates device-varying data
-        init = jax.lax.pcast(init, (axis_name,), to="varying")
-    chunks = (
-        idx.reshape(n_chunks, row_chunk, -1),
-        weights.reshape(n_chunks, row_chunk, -1),
-        valid.reshape(n_chunks, row_chunk, -1),
-        owner.reshape(n_chunks, row_chunk),
-    )
-    (a, b, cnt), _ = jax.lax.scan(body, init, chunks)
+    b = jnp.einsum("rlk,rl->rk", yg, bw, preferred_element_type=dtype)
+    cnt = mask.sum(axis=1)
     return a, b, cnt
+
+
+def _chol_solve_batched(a, b):
+    """Solve ``a @ x = b`` for huge batches of small SPD systems.
+
+    XLA's TPU Cholesky serializes poorly for [N, k, k] with tiny k and
+    huge N (≈7× slower than this). Same math, reordered: unrolled
+    Cholesky–Crout + forward/back substitution where every step is a
+    ``[N, ·]`` batch-vectorized op (k is the static factor rank, so the
+    unroll is small).
+    """
+    n, k, _ = a.shape
+    dtype = a.dtype
+    cols = []   # columns of L, each [N, k]
+    diag = []   # [N] diagonal entries
+    for j in range(k):
+        if j:
+            l_mat = jnp.stack(cols, axis=-1)              # [N, k, j]
+            l_row = jnp.stack([c[:, j] for c in cols], axis=-1)
+            s = jnp.einsum("nip,np->ni", l_mat, l_row)
+        else:
+            s = jnp.zeros((), dtype)
+        col = a[:, :, j] - s
+        d = jnp.sqrt(col[:, j])
+        mask = (jnp.arange(k) >= j).astype(dtype)
+        cols.append(col / d[:, None] * mask)
+        diag.append(d)
+    low = jnp.stack(cols, axis=-1)                        # [N, k, k]
+    ys = []
+    for j in range(k):  # forward: L y = b
+        s = b[:, j]
+        if j:
+            s = s - jnp.einsum(
+                "np,np->n", low[:, j, :j], jnp.stack(ys, axis=-1)
+            )
+        ys.append(s / diag[j])
+    xs: list = [None] * k
+    for j in reversed(range(k)):  # back: Lᵀ x = y
+        s = ys[j]
+        if j < k - 1:
+            s = s - jnp.einsum(
+                "np,np->n", low[:, j + 1:, j],
+                jnp.stack(xs[j + 1:], axis=-1),
+            )
+        xs[j] = s / diag[j]
+    return jnp.stack(xs, axis=-1)
 
 
 def _solve(a, b, cnt, yty, lam, implicit, k, dtype):
@@ -173,68 +359,135 @@ def _solve(a, b, cnt, yty, lam, implicit, k, dtype):
         # MLlib-style weighted-λ regularization: λ · n_u · I
         reg = lam * jnp.maximum(cnt, 1.0)
         a = a + reg[:, None, None] * jnp.eye(k, dtype=dtype)[None]
-    chol = jnp.linalg.cholesky(a)
-    x = jax.scipy.linalg.cho_solve((chol, True), b[..., None])[..., 0]
+    if jax.default_backend() == "cpu":
+        # LAPACK's batched Cholesky is the fast path on CPU; the
+        # unrolled variant exists for TPU (keeps the CPU-vs-TPU
+        # benchmark honest: each backend runs its best formulation)
+        chol = jnp.linalg.cholesky(a)
+        x = jax.scipy.linalg.cho_solve((chol, True), b[..., None])[..., 0]
+    else:
+        x = _chol_solve_batched(a, b)
     return jnp.where(jnp.isfinite(x), x, 0.0)
+
+
+def make_bucketed_solver(
+    ctx: ComputeContext,
+    packed: Bucketed,
+    implicit: bool,
+    alpha: float,
+):
+    """Build the one-direction solver body for a fixed geometry.
+
+    Returned fn (NOT jitted — compose under an outer jit):
+    ``(y [I,k] replicated, slab_arrays, lam) → x [n_rows_padded, k]``.
+    Slabs arrive row-sharded over the data axis, so each device computes
+    its rows' stats and solves locally; the trailing ``inv_perm`` gather
+    (replicated output constraint) is the one all-gather per call.
+    """
+    inv_perm = packed.inv_perm
+    n_heavy_slots = (
+        packed.n_stat_rows
+        - sum(s.idx.shape[0] for s in packed.slabs)
+    )
+    heavy_owner = packed.heavy_owner_pos
+    replicated = ctx.replicated
+
+    def solve(y, slab_arrays, heavy_arrays, lam):
+        k = y.shape[1]
+        dtype = y.dtype
+        parts_a, parts_b, parts_cnt = [], [], []
+        for (idx, weights, valid) in slab_arrays:
+            a, b, cnt = _slab_stats(
+                y, idx, weights, valid, implicit, alpha, dtype
+            )
+            parts_a.append(a)
+            parts_b.append(b)
+            parts_cnt.append(cnt)
+        if n_heavy_slots:
+            parts_a.append(jnp.zeros((n_heavy_slots, k, k), dtype))
+            parts_b.append(jnp.zeros((n_heavy_slots, k), dtype))
+            parts_cnt.append(jnp.zeros((n_heavy_slots,), dtype))
+        a = jnp.concatenate(parts_a, axis=0)
+        b = jnp.concatenate(parts_b, axis=0)
+        cnt = jnp.concatenate(parts_cnt, axis=0)
+        if heavy_arrays is not None:
+            idx, weights, valid = heavy_arrays
+            ha, hb, hcnt = _slab_stats(
+                y, idx, weights, valid, implicit, alpha, dtype
+            )
+            owner = jnp.asarray(heavy_owner)
+            # few sub-rows (head of the power law): small scatter-add
+            a = a.at[owner].add(ha)
+            b = b.at[owner].add(hb)
+            cnt = cnt.at[owner].add(hcnt)
+        yty = (
+            jnp.einsum("ik,im->km", y, y, preferred_element_type=dtype)
+            if implicit
+            else None
+        )
+        x_stats = _solve(a, b, cnt, yty, lam, implicit, k, dtype)
+        x = jnp.take(x_stats, jnp.asarray(inv_perm), axis=0)
+        return jax.lax.with_sharding_constraint(x, replicated)
+
+    return solve
+
+
+def _device_slabs(ctx: ComputeContext, packed: Bucketed):
+    put = lambda a: jax.device_put(a, ctx.data_sharded)  # noqa: E731
+    slabs = tuple(
+        (put(s.idx), put(s.weights), put(s.valid)) for s in packed.slabs
+    )
+    heavy = None
+    if packed.heavy is not None:
+        h = packed.heavy
+        heavy = (put(h.idx), put(h.weights), put(h.valid))
+    return slabs, heavy
 
 
 def make_solve_side(
     ctx: ComputeContext,
-    n_rows_padded: int,
-    row_chunk: int,
+    packed: Bucketed,
     implicit: bool,
     alpha: float,
 ):
-    """Build the jitted one-direction solver for a fixed geometry.
+    """Jitted single-direction solver over a pre-staged geometry.
 
-    Returned fn: (y [I,k] replicated, idx [R,L], weights [R,L],
-    valid [R,L], owner [R], lam) → x [n_rows_padded, k] replicated.
-    Blocks are sharded over the data axis; each device reduces its
-    partial normal equations, a reduce-scatter splits them by entity,
-    every device Cholesky-solves its slice, and an all-gather rebuilds
-    the factor matrix.
+    ``(y, slab_arrays, heavy_arrays, lam) → x`` — used by the profiling
+    path and the benchmark; :func:`make_train_step` fuses both
+    directions and whole epochs for the production path.
     """
-    mesh = ctx.mesh
-    n_data = ctx.data_parallelism
-    if n_rows_padded % n_data:
-        raise ValueError("n_rows_padded must divide over the data axis")
+    body = make_bucketed_solver(ctx, packed, implicit, alpha)
+    return jax.jit(body)
 
-    def solve(y, idx, weights, valid, owner, lam):
-        k = y.shape[1]
-        dtype = y.dtype
 
-        def shard_fn(y_, idx_, weights_, valid_, owner_, lam_):
-            a, b, cnt = _local_stats(
-                y_, idx_, weights_, valid_, owner_, n_rows_padded,
-                row_chunk, implicit, alpha, axis_name=DATA_AXIS,
-            )
-            # one reduce-scatter: each device keeps its slice of rows
-            a = jax.lax.psum_scatter(a, DATA_AXIS, scatter_dimension=0, tiled=True)
-            b = jax.lax.psum_scatter(b, DATA_AXIS, scatter_dimension=0, tiled=True)
-            cnt = jax.lax.psum_scatter(
-                cnt, DATA_AXIS, scatter_dimension=0, tiled=True
-            )
-            yty = y_.T @ y_ if implicit else None
-            # each device solves its slice; the caller-side P(data) out_spec
-            # reassembles the factor matrix (the all-gather happens at the
-            # next solve's replicated-input boundary)
-            return _solve(a, b, cnt, yty, lam_, implicit, k, dtype)
+def make_train_step(
+    ctx: ComputeContext,
+    user_packed: Bucketed,
+    item_packed: Bucketed,
+    implicit: bool,
+    alpha: float,
+):
+    """Fused multi-epoch trainer: one dispatch runs ``n_iters`` epochs.
 
-        x = jax.shard_map(
-            shard_fn,
-            mesh=mesh,
-            in_specs=(
-                P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
-                P(DATA_AXIS), P(),
-            ),
-            out_specs=P(DATA_AXIS),
-        )(y, idx, weights, valid, owner, lam)
-        # replicate for the next gather pass
-        return jax.lax.with_sharding_constraint(
-            x, jax.NamedSharding(mesh, P())
-        )
+    Returned fn: ``(x, y, u_slabs, u_heavy, i_slabs, i_heavy, lam,
+    n_iters) → (x, y)`` with ``n_iters`` static. Epochs chain on-device
+    through a ``fori_loop``, amortizing host↔device dispatch latency
+    (material on tunneled TPU platforms) across the whole run.
+    """
+    solve_u = make_bucketed_solver(ctx, user_packed, implicit, alpha)
+    solve_i = make_bucketed_solver(ctx, item_packed, implicit, alpha)
 
-    return jax.jit(solve)
+    @partial(jax.jit, static_argnames=("n_iters",))
+    def run(x, y, u_slabs, u_heavy, i_slabs, i_heavy, lam, n_iters):
+        def body(_, carry):
+            _x, _y = carry
+            _x = solve_u(_y, u_slabs, u_heavy, lam)
+            _y = solve_i(_x, i_slabs, i_heavy, lam)
+            return (_x, _y)
+
+        return jax.lax.fori_loop(0, n_iters, body, (x, y))
+
+    return run
 
 
 # --------------------------------------------------------------------------
@@ -263,6 +516,7 @@ def train_als(
     seed: int = 13,
     block_len: int = 64,
     row_chunk: int = 1024,
+    s_max: int = 16,
     dtype=jnp.float32,
     timer=None,
     checkpoint_dir: str | None = None,
@@ -271,37 +525,27 @@ def train_als(
 ) -> ALSFactors:
     """Alternate user/item normal-equation solves on the mesh.
 
-    Mid-training checkpoint/resume (SURVEY.md §5 — the reference only
-    persists final models): with ``checkpoint_dir`` + ``checkpoint_every``
-    the factor state is written every N iterations (atomic npz) and
-    ``resume=True`` continues from the latest checkpoint after a restart.
-    ``timer`` (a :class:`~predictionio_tpu.utils.profiling.StepTimer`)
-    records one entry per half-iteration.
+    Epochs run fused on-device (``checkpoint_every``-sized dispatch
+    chunks when checkpointing, the whole run otherwise); passing a
+    ``timer`` (:class:`~predictionio_tpu.utils.profiling.StepTimer`)
+    switches to per-half-iteration dispatch so each solve direction is
+    timed separately. Mid-training checkpoint/resume (SURVEY.md §5 —
+    the reference only persists final models): with ``checkpoint_dir``
+    + ``checkpoint_every`` the factor state is written every N
+    iterations (atomic npz) and ``resume=True`` continues from the
+    latest checkpoint after a restart. ``row_chunk`` is retained for
+    call compatibility (the bucketed layout needs no chunked scan).
     """
+    del row_chunk
     n_data = ctx.data_parallelism
 
-    def _pack(rows, cols, n_rows):
-        csr = build_padded_csr(
-            rows, cols, values, n_rows,
-            block_len=block_len,
-            row_multiple=n_data,
-            block_multiple=n_data * row_chunk,
-        )
-        return csr
-
-    user_csr = _pack(user_ids, item_ids, n_users)
-    item_csr = _pack(item_ids, user_ids, n_items)
-
-    # effective per-shard chunking: local blocks = n_blocks / n_data
-    def _chunk(csr: PaddedCSR) -> int:
-        local = csr.n_blocks // n_data
-        return int(math.gcd(local, row_chunk)) or 1
-
-    solve_users = make_solve_side(
-        ctx, user_csr.n_rows_padded, _chunk(user_csr), implicit, alpha
+    user_packed = build_bucketed(
+        user_ids, item_ids, values, n_users,
+        block_len=block_len, row_multiple=n_data, s_max=s_max,
     )
-    solve_items = make_solve_side(
-        ctx, item_csr.n_rows_padded, _chunk(item_csr), implicit, alpha
+    item_packed = build_bucketed(
+        item_ids, user_ids, values, n_items,
+        block_len=block_len, row_multiple=n_data, s_max=s_max,
     )
 
     # init at the logical item count (mesh-size independent), zero padding
@@ -331,47 +575,67 @@ def train_als(
                     "resuming ALS from checkpoint at iteration %d",
                     start_iteration,
                 )
-    item_factors = np.zeros((item_csr.n_rows_padded, rank), init.dtype)
+    item_factors = np.zeros(
+        (item_packed.n_rows_padded, rank), np.asarray(init).dtype
+    )
     item_factors[:n_items] = init
     item_factors = ctx.replicate(item_factors)
-    user_factors = None
-
-    put = lambda arr: jax.device_put(arr, ctx.data_sharded)  # noqa: E731
-    u_dev = (
-        put(user_csr.idx), put(user_csr.weights), put(user_csr.valid),
-        put(user_csr.owner),
-    )
-    i_dev = (
-        put(item_csr.idx), put(item_csr.weights), put(item_csr.valid),
-        put(item_csr.owner),
+    user_factors = ctx.replicate(
+        np.zeros((user_packed.n_rows_padded, rank), np.asarray(init).dtype)
     )
 
+    u_slabs, u_heavy = _device_slabs(ctx, user_packed)
+    i_slabs, i_heavy = _device_slabs(ctx, item_packed)
     lam = jnp.asarray(reg, dtype)
-    for it in range(start_iteration, iterations):
-        if timer is not None:
+
+    ran_any = False
+    if timer is not None:
+        # profiling mode: dispatch each half-iteration separately
+        solve_users = make_solve_side(ctx, user_packed, implicit, alpha)
+        solve_items = make_solve_side(ctx, item_packed, implicit, alpha)
+        for it in range(start_iteration, iterations):
             with timer.step("als/user_solve", sync_value=None):
-                user_factors = solve_users(item_factors, *u_dev, lam)
+                user_factors = solve_users(
+                    item_factors, u_slabs, u_heavy, lam
+                )
                 _sync_scalar(user_factors)
             with timer.step("als/item_solve", sync_value=None):
-                item_factors = solve_items(user_factors, *i_dev, lam)
+                item_factors = solve_items(
+                    user_factors, i_slabs, i_heavy, lam
+                )
                 _sync_scalar(item_factors)
-        else:
-            user_factors = solve_users(item_factors, *u_dev, lam)
-            item_factors = solve_items(user_factors, *i_dev, lam)
-        if (
-            ckpt_path
-            and checkpoint_every > 0
-            and (it + 1) % checkpoint_every == 0
-            and (it + 1) < iterations
-        ):
-            _write_checkpoint(
-                ckpt_path,
-                iteration=it + 1,
-                item_factors=np.asarray(item_factors)[:n_items],
-                user_factors=np.asarray(user_factors)[:n_users],
+            ran_any = True
+            _maybe_checkpoint(
+                ckpt_path, checkpoint_every, it + 1, iterations,
+                user_factors, item_factors, n_users, n_items,
+            )
+    else:
+        run = make_train_step(
+            ctx, user_packed, item_packed, implicit, alpha
+        )
+        chunk = (
+            checkpoint_every
+            if (ckpt_path and checkpoint_every > 0)
+            else max(iterations - start_iteration, 1)
+        )
+        it = start_iteration
+        while it < iterations:
+            # align chunk boundaries to absolute multiples of
+            # checkpoint_every so resuming from a foreign iteration
+            # count still checkpoints on schedule
+            n = min(chunk - it % chunk, iterations - it)
+            user_factors, item_factors = run(
+                user_factors, item_factors,
+                u_slabs, u_heavy, i_slabs, i_heavy, lam, n_iters=n,
+            )
+            it += n
+            ran_any = True
+            _maybe_checkpoint(
+                ckpt_path, checkpoint_every, it, iterations,
+                user_factors, item_factors, n_users, n_items,
             )
 
-    if user_factors is None:
+    if not ran_any:
         # loop never ran (iterations == 0, or resume at full count):
         # use the checkpointed user factors if any, else solve once
         if resumed_user_factors is not None:
@@ -379,11 +643,30 @@ def train_als(
                 user_factors=resumed_user_factors[:n_users],
                 item_factors=np.asarray(item_factors)[:n_items],
             )
-        user_factors = solve_users(item_factors, *u_dev, lam)
+        solve_users = make_solve_side(ctx, user_packed, implicit, alpha)
+        user_factors = solve_users(item_factors, u_slabs, u_heavy, lam)
     return ALSFactors(
         user_factors=np.asarray(user_factors)[:n_users],
         item_factors=np.asarray(item_factors)[:n_items],
     )
+
+
+def _maybe_checkpoint(
+    ckpt_path, checkpoint_every, iteration, total,
+    user_factors, item_factors, n_users, n_items,
+) -> None:
+    if (
+        ckpt_path
+        and checkpoint_every > 0
+        and iteration % checkpoint_every == 0
+        and iteration < total
+    ):
+        _write_checkpoint(
+            ckpt_path,
+            iteration=iteration,
+            item_factors=np.asarray(item_factors)[:n_items],
+            user_factors=np.asarray(user_factors)[:n_users],
+        )
 
 
 def _sync_scalar(arr) -> None:

@@ -320,6 +320,32 @@ class TestResumeEdgeCases:
         assert f.user_factors.shape == (2, 2)
         assert np.isfinite(f.user_factors).all()
 
+    def test_resume_from_unaligned_iteration_still_checkpoints(
+        self, ctx8, tmp_path
+    ):
+        """Chunk boundaries align to absolute multiples of
+        checkpoint_every even when resuming from a checkpoint written
+        on a different schedule (e.g. iteration 3 with every=2)."""
+        rows = np.asarray([0, 1, 0], np.int32)
+        cols = np.asarray([0, 1, 1], np.int32)
+        vals = np.ones(3, np.float32)
+        from predictionio_tpu.ops.als import _write_checkpoint
+
+        _write_checkpoint(
+            str(tmp_path / "als_checkpoint.npz"),
+            iteration=3,
+            user_factors=np.zeros((2, 2), np.float32),
+            item_factors=np.zeros((2, 2), np.float32),
+        )
+        train_als(
+            ctx8, rows, cols, vals, n_users=2, n_items=2, rank=2,
+            iterations=6, block_len=2, row_chunk=1,
+            checkpoint_dir=str(tmp_path), checkpoint_every=2,
+            resume=True,
+        )
+        ck = dict(np.load(tmp_path / "als_checkpoint.npz"))
+        assert int(ck["iteration"]) == 4  # wrote at the next multiple
+
     def test_resume_at_full_iteration_count_uses_checkpoint(
         self, ctx8, tmp_path
     ):

@@ -218,7 +218,7 @@ def test_bad_spec_raises():
         load_plugin_spec("no_colon_here")
 
 
-def test_plugin_internal_keyerror_not_masked(server):
+def test_plugin_internal_keyerror_not_masked():
     """A KeyError raised inside a plugin's handle_rest must surface as a
     500 plugin error, not a 404 'plugin not found'."""
 
@@ -229,8 +229,6 @@ def test_plugin_internal_keyerror_not_masked(server):
         def handle_rest(self, path, query):
             return query["missing-param"]
 
-    http, _, _ = server
-    # register on a fresh server sharing nothing with the fixture
     ctx = PluginContext([Broken()], load_env=False)
     try:
         with pytest.raises(KeyError):
