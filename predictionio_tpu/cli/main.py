@@ -29,7 +29,8 @@ def _load_variant(path: str | None) -> dict:
 
 
 def _resolve(args) -> tuple:
-    """(engine, engine_params, engine_id, variant_name) from CLI args."""
+    """(engine, engine_params, engine_id, variant_name, variant_dict)
+    from CLI args."""
     from predictionio_tpu.core.registry import resolve_engine_factory
 
     variant = _load_variant(getattr(args, "variant", None))
@@ -44,7 +45,22 @@ def _resolve(args) -> tuple:
     engine_id = getattr(args, "engine_id", None) or variant.get(
         "id", factory_name
     )
-    return engine, params, engine_id, variant.get("variant", "default")
+    return engine, params, engine_id, variant.get("variant", "default"), variant
+
+
+def _batched_insert(events_iter, backend, app_id, channel_id) -> int:
+    """Insert an event stream in 500-event batches; returns the count."""
+    batch, n = [], 0
+    for event in events_iter:
+        batch.append(event)
+        if len(batch) >= 500:
+            backend.insert_batch(batch, app_id, channel_id)
+            n += len(batch)
+            batch = []
+    if batch:
+        backend.insert_batch(batch, app_id, channel_id)
+        n += len(batch)
+    return n
 
 
 def _mesh_ctx(args):
@@ -152,14 +168,111 @@ def cmd_accesskey(args) -> int:
 
 
 def cmd_build(args) -> int:
-    """Python needs no compile; validate the engine + variant instead
-    (the useful part of ``pio build``)."""
-    engine, params, engine_id, _ = _resolve(args)
+    """Python needs no compile; validate the engine + variant, then
+    register an EngineManifest (reference Console.build:812-833 →
+    RegisterEngine.scala:33-58)."""
+    from predictionio_tpu.data.storage import EngineManifest, get_storage
+    from predictionio_tpu.version import __version__
+
+    engine, params, engine_id, _, variant = _resolve(args)
     print(
         f"Engine {engine_id} OK: "
         f"{len(engine.algorithm_classes)} algorithm class(es), "
         f"{len(params.algorithms)} configured"
     )
+    manifest = EngineManifest(
+        id=engine_id,
+        version=variant.get("engineVersion", __version__),
+        name=engine_id,
+        description=variant.get("description"),
+        files=(os.path.abspath(args.variant),) if args.variant else (),
+        engine_factory=args.engine or variant.get("engineFactory", ""),
+    )
+    get_storage().get_meta_data_engine_manifests().update(
+        manifest, upsert=True
+    )
+    print(f"Registered engine {manifest.id} {manifest.version}.")
+    return 0
+
+
+def cmd_unregister(args) -> int:
+    """Delete a registered EngineManifest (reference Console.unregister →
+    RegisterEngine.unregisterEngine, RegisterEngine.scala:60-84)."""
+    from predictionio_tpu.data.storage import get_storage
+    from predictionio_tpu.version import __version__
+
+    manifests = get_storage().get_meta_data_engine_manifests()
+    version = args.engine_version or __version__
+    if manifests.delete(args.engine_id, version):
+        print(f"Unregistered engine {args.engine_id} {version}.")
+        return 0
+    print(
+        f"Engine {args.engine_id} {version} is not registered.",
+        file=sys.stderr,
+    )
+    return 1
+
+
+def cmd_upgrade(args) -> int:
+    """Migrate an app's events between two declared storage sources
+    (the TPU-native analogue of the reference's 0.8.x→0.9 HBase
+    migration, console/Console.scala upgrade verb + tools/migration)."""
+    from predictionio_tpu.data.storage import get_storage
+
+    if args.from_source == args.to_source:
+        print(
+            "error: --from and --to must be different storage sources",
+            file=sys.stderr,
+        )
+        return 1
+    storage = get_storage()
+    src = storage.backend_for_source(args.from_source)
+    dst = storage.backend_for_source(args.to_source)
+    app = storage.get_meta_data_apps().get_by_name(args.app_name)
+    if app is None:
+        print(f"error: app {args.app_name!r} not found", file=sys.stderr)
+        return 1
+    channel_ids = [None] + [
+        c.id
+        for c in storage.get_meta_data_channels().get_by_app_id(app.id)
+    ]
+    total = 0
+    for cid in channel_ids:
+        dst.init(app.id, cid)
+        # drain the source scan first: both sources may share an
+        # underlying store, and inserting into a table mid-scan over a
+        # live cursor can revisit rows
+        events = list(src.find(app.id, cid))
+        total += _batched_insert(events, dst, app.id, cid)
+    print(
+        f"Migrated {total} events of app {args.app_name} from "
+        f"{args.from_source} to {args.to_source}."
+    )
+    return 0
+
+
+def cmd_shell(args) -> int:
+    """Interactive REPL with the full PIO environment preloaded —
+    the ``bin/pio-shell`` analogue (bin/pio-shell:17-33): storage wired,
+    ComputeContext built, stores importable."""
+    import code
+
+    from predictionio_tpu.data.store import EventStore
+    from predictionio_tpu.data.storage import get_storage
+
+    ctx = _mesh_ctx(args)
+    ns = {
+        "storage": get_storage(),
+        "ctx": ctx,
+        "event_store": EventStore(),
+    }
+    banner = (
+        f"PredictionIO-TPU {__version__} shell\n"
+        f"preloaded: storage, ctx (mesh "
+        f"{dict(zip(ctx.mesh.axis_names, ctx.mesh.devices.shape))}), "
+        "event_store (find / find_by_entity / aggregate_properties)"
+    )
+    code.interact(banner=banner, local=ns)
     return 0
 
 
@@ -167,7 +280,7 @@ def cmd_train(args) -> int:
     from predictionio_tpu.core.engine import WorkflowParams
     from predictionio_tpu.core.workflow import run_train
 
-    engine, params, engine_id, variant = _resolve(args)
+    engine, params, engine_id, variant, _ = _resolve(args)
     workflow = WorkflowParams(
         batch=args.batch or "",
         save_model=not args.no_save_model,
@@ -205,7 +318,7 @@ def cmd_eval(args) -> int:
 def cmd_deploy(args) -> int:
     from predictionio_tpu.serving.engine_server import EngineServer
 
-    engine, params, engine_id, variant = _resolve(args)
+    engine, params, engine_id, variant, _ = _resolve(args)
     feedback_app_id = None
     if args.feedback:
         from predictionio_tpu.data.storage import get_storage
@@ -313,20 +426,15 @@ def cmd_import(args) -> int:
     app_id, channel_id = store._resolve(args.app_name, args.channel)
     events_backend = get_storage().get_events()
     events_backend.init(app_id, channel_id)
-    batch, n = [], 0
-    with open(args.input) as f:
+
+    def parse(f):
         for line in f:
             line = line.strip()
-            if not line:
-                continue
-            batch.append(Event.from_json_dict(json.loads(line)))
-            if len(batch) >= 500:
-                events_backend.insert_batch(batch, app_id, channel_id)
-                n += len(batch)
-                batch = []
-    if batch:
-        events_backend.insert_batch(batch, app_id, channel_id)
-        n += len(batch)
+            if line:
+                yield Event.from_json_dict(json.loads(line))
+
+    with open(args.input) as f:
+        n = _batched_insert(parse(f), events_backend, app_id, channel_id)
     print(f"Imported {n} events.")
     return 0
 
@@ -485,6 +593,22 @@ def build_parser() -> argparse.ArgumentParser:
                 dest="mesh_shape",
                 help="data,model mesh shape, e.g. 4,2",
             )
+
+    p = sub.add_parser("unregister")
+    p.add_argument("--engine-id", required=True)
+    p.add_argument("--engine-version", default=None)
+    p.set_defaults(func=cmd_unregister)
+
+    p = sub.add_parser("upgrade")
+    p.add_argument("--from", dest="from_source", required=True)
+    p.add_argument("--to", dest="to_source", required=True)
+    p.add_argument("--app", dest="app_name", required=True)
+    p.set_defaults(func=cmd_upgrade)
+
+    p = sub.add_parser("shell")
+    p.add_argument("--mesh-shape", default=None)
+    p.add_argument("--batch", default="shell")
+    p.set_defaults(func=cmd_shell)
 
     p = sub.add_parser("build")
     _engine_args(p, mesh=False)

@@ -30,6 +30,8 @@ from predictionio_tpu.data.storage.base import (
     ChannelsBackend,
     EngineInstance,
     EngineInstancesBackend,
+    EngineManifest,
+    EngineManifestsBackend,
     EvaluationInstance,
     EvaluationInstancesBackend,
     EventsBackend,
@@ -38,11 +40,11 @@ from predictionio_tpu.data.storage.base import (
 )
 
 __all__ = [
-    "App", "AccessKey", "Channel", "EngineInstance", "EvaluationInstance",
-    "Model",
+    "App", "AccessKey", "Channel", "EngineInstance", "EngineManifest",
+    "EvaluationInstance", "Model",
     "AppsBackend", "AccessKeysBackend", "ChannelsBackend",
-    "EngineInstancesBackend", "EvaluationInstancesBackend", "EventsBackend",
-    "ModelsBackend",
+    "EngineInstancesBackend", "EngineManifestsBackend",
+    "EvaluationInstancesBackend", "EventsBackend", "ModelsBackend",
     "Storage", "StorageError", "register_backend", "get_storage",
     "set_storage",
 ]
@@ -63,6 +65,7 @@ class BackendSpec:
     access_keys: Callable[[object], AccessKeysBackend] | None = None
     channels: Callable[[object], ChannelsBackend] | None = None
     engine_instances: Callable[[object], EngineInstancesBackend] | None = None
+    engine_manifests: Callable[[object], EngineManifestsBackend] | None = None
     evaluation_instances: (
         Callable[[object], EvaluationInstancesBackend] | None
     ) = None
@@ -86,6 +89,7 @@ def _register_builtins() -> None:
             self.access_keys = memory.MemoryAccessKeys()
             self.channels = memory.MemoryChannels()
             self.engine_instances = memory.MemoryEngineInstances()
+            self.engine_manifests = memory.MemoryEngineManifests()
             self.evaluation_instances = memory.MemoryEvaluationInstances()
             self.models = memory.MemoryModels()
             self.events = memory.MemoryEvents()
@@ -98,6 +102,7 @@ def _register_builtins() -> None:
             access_keys=lambda c: c.access_keys,
             channels=lambda c: c.channels,
             engine_instances=lambda c: c.engine_instances,
+            engine_manifests=lambda c: c.engine_manifests,
             evaluation_instances=lambda c: c.evaluation_instances,
             models=lambda c: c.models,
             events=lambda c: c.events,
@@ -111,6 +116,7 @@ def _register_builtins() -> None:
             access_keys=sqlite.SQLiteAccessKeys,
             channels=sqlite.SQLiteChannels,
             engine_instances=sqlite.SQLiteEngineInstances,
+            engine_manifests=sqlite.SQLiteEngineManifests,
             evaluation_instances=sqlite.SQLiteEvaluationInstances,
             models=sqlite.SQLiteModels,
             events=sqlite.SQLiteEvents,
@@ -255,6 +261,9 @@ class Storage:
     def get_meta_data_engine_instances(self) -> EngineInstancesBackend:
         return self._dao("METADATA", "engine_instances")
 
+    def get_meta_data_engine_manifests(self) -> EngineManifestsBackend:
+        return self._dao("METADATA", "engine_manifests")
+
     def get_meta_data_evaluation_instances(
         self,
     ) -> EvaluationInstancesBackend:
@@ -266,6 +275,21 @@ class Storage:
     def get_events(self) -> EventsBackend:
         return self._dao("EVENTDATA", "events")
 
+    def backend_for_source(self, source: str) -> EventsBackend:
+        """Events backend of a *specific* declared source, regardless of
+        repository bindings — used by ``pio-tpu upgrade`` migration."""
+        if source not in self._specs:
+            raise StorageError(
+                f"unknown storage source {source}; declared: "
+                f"{sorted(self._specs)}"
+            )
+        spec, _conf = self._specs[source]
+        if spec.events is None:
+            raise StorageError(
+                f"storage source {source} does not support events"
+            )
+        return spec.events(self._client(source))
+
     # -- health (reference Storage.verifyAllDataObjects:335-358) ----------
     def verify_all_data_objects(self) -> list[str]:
         """Instantiate every DAO + event-store write/remove roundtrip on
@@ -276,6 +300,7 @@ class Storage:
             "get_meta_data_access_keys",
             "get_meta_data_channels",
             "get_meta_data_engine_instances",
+            "get_meta_data_engine_manifests",
             "get_meta_data_evaluation_instances",
             "get_model_data_models",
         ):

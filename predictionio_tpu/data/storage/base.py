@@ -68,6 +68,22 @@ class Channel:
 
 
 @dataclasses.dataclass(frozen=True)
+class EngineManifest:
+    """A registered engine build (reference EngineManifests.scala:34-50).
+
+    ``files`` holds the engine's source paths (the reference stores
+    assembly-jar paths; here it is the template directory / module files).
+    """
+
+    id: str
+    version: str
+    name: str
+    description: str | None = None
+    files: tuple[str, ...] = ()
+    engine_factory: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
 class EngineInstance:
     """A train/deploy run record (reference EngineInstances.scala:43-69)."""
 
@@ -183,6 +199,25 @@ class ChannelsBackend(abc.ABC):
 
     @abc.abstractmethod
     def delete(self, channel_id: int) -> bool: ...
+
+
+class EngineManifestsBackend(abc.ABC):
+    """Reference EngineManifests.scala:52-70 (keyed by (id, version))."""
+
+    @abc.abstractmethod
+    def insert(self, manifest: EngineManifest) -> None: ...
+
+    @abc.abstractmethod
+    def get(self, manifest_id: str, version: str) -> EngineManifest | None: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[EngineManifest]: ...
+
+    @abc.abstractmethod
+    def update(self, manifest: EngineManifest, upsert: bool = False) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, manifest_id: str, version: str) -> bool: ...
 
 
 class EngineInstancesBackend(abc.ABC):

@@ -24,6 +24,8 @@ from predictionio_tpu.data.storage.base import (
     ChannelsBackend,
     EngineInstance,
     EngineInstancesBackend,
+    EngineManifest,
+    EngineManifestsBackend,
     EvaluationInstance,
     EvaluationInstancesBackend,
     EventsBackend,
@@ -188,6 +190,35 @@ class MemoryEngineInstances(EngineInstancesBackend):
     def delete(self, instance_id: str) -> bool:
         with self._lock:
             return self._instances.pop(instance_id, None) is not None
+
+
+class MemoryEngineManifests(EngineManifestsBackend):
+    def __init__(self, config=None):
+        self._lock = threading.Lock()
+        self._manifests: dict[tuple[str, str], EngineManifest] = {}
+
+    def insert(self, manifest: EngineManifest) -> None:
+        with self._lock:
+            self._manifests[(manifest.id, manifest.version)] = manifest
+
+    def get(self, manifest_id: str, version: str) -> EngineManifest | None:
+        return self._manifests.get((manifest_id, version))
+
+    def get_all(self) -> list[EngineManifest]:
+        return list(self._manifests.values())
+
+    def update(self, manifest: EngineManifest, upsert: bool = False) -> None:
+        with self._lock:
+            key = (manifest.id, manifest.version)
+            if key not in self._manifests and not upsert:
+                raise KeyError(f"engine manifest {key} not found")
+            self._manifests[key] = manifest
+
+    def delete(self, manifest_id: str, version: str) -> bool:
+        with self._lock:
+            return (
+                self._manifests.pop((manifest_id, version), None) is not None
+            )
 
 
 class MemoryEvaluationInstances(EvaluationInstancesBackend):

@@ -34,6 +34,8 @@ from predictionio_tpu.data.storage.base import (
     ChannelsBackend,
     EngineInstance,
     EngineInstancesBackend,
+    EngineManifest,
+    EngineManifestsBackend,
     EvaluationInstance,
     EvaluationInstancesBackend,
     EventsBackend,
@@ -108,6 +110,14 @@ class SQLiteClient:
                   evaluation_class TEXT, engine_params_generator_class TEXT,
                   batch TEXT, env TEXT, evaluator_results TEXT,
                   evaluator_results_html TEXT, evaluator_results_json TEXT);
+                CREATE TABLE IF NOT EXISTS engine_manifests (
+                  id TEXT NOT NULL,
+                  version TEXT NOT NULL,
+                  name TEXT NOT NULL,
+                  description TEXT,
+                  files TEXT NOT NULL,
+                  engine_factory TEXT NOT NULL,
+                  PRIMARY KEY (id, version));
                 CREATE TABLE IF NOT EXISTS models (
                   id TEXT PRIMARY KEY,
                   models BLOB NOT NULL);
@@ -373,6 +383,56 @@ class SQLiteEngineInstances(EngineInstancesBackend):
         with self._c.conn as c:
             return c.execute(
                 "DELETE FROM engine_instances WHERE id=?", (instance_id,)
+            ).rowcount > 0
+
+
+class SQLiteEngineManifests(EngineManifestsBackend):
+    def __init__(self, client: SQLiteClient):
+        self._c = client
+
+    def _from_row(self, r) -> EngineManifest:
+        return EngineManifest(
+            id=r[0], version=r[1], name=r[2], description=r[3],
+            files=tuple(json.loads(r[4])), engine_factory=r[5],
+        )
+
+    def insert(self, manifest: EngineManifest) -> None:
+        with self._c.conn as c:
+            c.execute(
+                "INSERT OR REPLACE INTO engine_manifests VALUES (?,?,?,?,?,?)",
+                (
+                    manifest.id, manifest.version, manifest.name,
+                    manifest.description, json.dumps(list(manifest.files)),
+                    manifest.engine_factory,
+                ),
+            )
+
+    def get(self, manifest_id: str, version: str) -> EngineManifest | None:
+        row = self._c.conn.execute(
+            "SELECT * FROM engine_manifests WHERE id=? AND version=?",
+            (manifest_id, version),
+        ).fetchone()
+        return self._from_row(row) if row else None
+
+    def get_all(self) -> list[EngineManifest]:
+        rows = self._c.conn.execute(
+            "SELECT * FROM engine_manifests"
+        ).fetchall()
+        return [self._from_row(r) for r in rows]
+
+    def update(self, manifest: EngineManifest, upsert: bool = False) -> None:
+        if not upsert and self.get(manifest.id, manifest.version) is None:
+            raise KeyError(
+                f"engine manifest ({manifest.id}, {manifest.version}) "
+                "not found"
+            )
+        self.insert(manifest)
+
+    def delete(self, manifest_id: str, version: str) -> bool:
+        with self._c.conn as c:
+            return c.execute(
+                "DELETE FROM engine_manifests WHERE id=? AND version=?",
+                (manifest_id, version),
             ).rowcount > 0
 
 

@@ -136,6 +136,31 @@ class EventStore:
             required=required,
         )
 
+    def extract_entity_map(
+        self,
+        app_name: str,
+        entity_type: str,
+        channel_name: str | None = None,
+        start_time: _dt.datetime | None = None,
+        until_time: _dt.datetime | None = None,
+        required: Sequence[str] | None = None,
+    ):
+        """Aggregated entity properties as an
+        :class:`~predictionio_tpu.utils.bimap.EntityMap` — string id ↔
+        dense index ↔ PropertyMap (reference PEvents.extractEntityMap,
+        storage/PEvents.scala:96-130)."""
+        from predictionio_tpu.utils.bimap import EntityMap
+
+        props = self.aggregate_properties(
+            app_name,
+            entity_type,
+            channel_name=channel_name,
+            start_time=start_time,
+            until_time=until_time,
+            required=required,
+        )
+        return EntityMap(props)
+
     # -- serve-time (reference LEventStore) -------------------------------
     def find_by_entity(
         self,

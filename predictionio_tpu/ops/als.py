@@ -613,17 +613,22 @@ def train_als(
         run = make_train_step(
             ctx, user_packed, item_packed, implicit, alpha
         )
+        checkpointing = bool(ckpt_path) and checkpoint_every > 0
         chunk = (
             checkpoint_every
-            if (ckpt_path and checkpoint_every > 0)
+            if checkpointing
             else max(iterations - start_iteration, 1)
         )
         it = start_iteration
         while it < iterations:
             # align chunk boundaries to absolute multiples of
             # checkpoint_every so resuming from a foreign iteration
-            # count still checkpoints on schedule
-            n = min(chunk - it % chunk, iterations - it)
+            # count still checkpoints on schedule; without
+            # checkpointing a resume runs as one fused dispatch
+            if checkpointing:
+                n = min(chunk - it % chunk, iterations - it)
+            else:
+                n = min(chunk, iterations - it)
             user_factors, item_factors = run(
                 user_factors, item_factors,
                 u_slabs, u_heavy, i_slabs, i_heavy, lam, n_iters=n,

@@ -84,3 +84,52 @@ class BiMap:
 
     def to_dict(self) -> dict[str, int]:
         return dict(self._index)
+
+
+class EntityMap:
+    """String entity id ↔ dense index ↔ payload.
+
+    Capability parity with the reference's experimental
+    ``data/.../storage/EntityMap.scala`` (``EntityIdIxMap`` +
+    ``EntityMap[A]``): a :class:`BiMap` over the entity ids plus a data
+    payload per entity, so engines can move between the string-id world
+    (events, queries) and the dense-index world (device arrays) without
+    bookkeeping.
+    """
+
+    def __init__(self, id_to_data: dict[str, object]):
+        self._data = dict(id_to_data)
+        self.id_to_ix = BiMap(np.asarray(sorted(self._data)))
+
+    # -- EntityIdIxMap surface --------------------------------------------
+    def index(self, entity_id: str) -> int:
+        return self.id_to_ix(entity_id)
+
+    def entity_id(self, ix: int) -> str:
+        return self.id_to_ix.inverse(ix)
+
+    def get(self, entity_id: str, default: int | None = None) -> int | None:
+        return self.id_to_ix.get(entity_id, default)
+
+    def __contains__(self, entity_id: object) -> bool:
+        return entity_id in self.id_to_ix
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    # -- EntityMap[A] surface ---------------------------------------------
+    def data(self, key: str | int) -> object:
+        """Payload by entity id (str) or dense index (int)."""
+        if isinstance(key, (int, np.integer)):
+            key = self.entity_id(int(key))
+        return self._data[str(key)]
+
+    def get_data(self, entity_id: str) -> object | None:
+        return self._data.get(str(entity_id))
+
+    def take(self, n: int) -> "EntityMap":
+        keep = [self.entity_id(i) for i in range(min(n, len(self)))]
+        return EntityMap({k: self._data[k] for k in keep})
+
+    def to_dict(self) -> dict[str, object]:
+        return dict(self._data)

@@ -141,6 +141,85 @@ class TestBuildTrainExportImport:
         code, out, _ = cli("build", "--variant", str(variant))
         assert code == 0 and "OK" in out
 
+    def test_build_registers_manifest_and_unregister(
+        self, cli, memory_storage, tmp_path
+    ):
+        variant = tmp_path / "engine.json"
+        variant.write_text(
+            json.dumps(
+                {
+                    "id": "clf-test",
+                    "engineFactory": "classification",
+                    "algorithms": [
+                        {"name": "naive", "params": {"lambda_": 0.5}}
+                    ],
+                }
+            )
+        )
+        code, out, _ = cli("build", "--variant", str(variant))
+        assert code == 0 and "Registered engine clf-test" in out
+        manifests = memory_storage.get_meta_data_engine_manifests()
+        all_m = manifests.get_all()
+        assert len(all_m) == 1
+        m = all_m[0]
+        assert m.id == "clf-test"
+        assert m.engine_factory == "classification"
+        code, out, _ = cli(
+            "unregister", "--engine-id", "clf-test",
+            "--engine-version", m.version,
+        )
+        assert code == 0 and manifests.get_all() == []
+        code, _, err = cli(
+            "unregister", "--engine-id", "clf-test",
+            "--engine-version", m.version,
+        )
+        assert code == 1 and "not registered" in err
+
+    def test_upgrade_migrates_events_between_sources(
+        self, cli, tmp_path, monkeypatch
+    ):
+        from predictionio_tpu.data.storage import Storage, set_storage
+
+        storage = Storage(
+            env={
+                "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+                "PIO_STORAGE_SOURCES_SQL_TYPE": "sqlite",
+                "PIO_STORAGE_SOURCES_SQL_PATH": str(tmp_path / "m.sqlite"),
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+            }
+        )
+        set_storage(storage)
+        try:
+            code, out, _ = cli("app", "new", "migapp")
+            assert code == 0
+            app = storage.get_meta_data_apps().get_by_name("migapp")
+            events = storage.get_events()
+            for i in range(7):
+                events.insert(
+                    Event(
+                        event="view",
+                        entity_type="user",
+                        entity_id=f"u{i}",
+                        target_entity_type="item",
+                        target_entity_id="i1",
+                    ),
+                    app.id,
+                )
+            code, out, _ = cli(
+                "upgrade", "--from", "MEM", "--to", "SQL",
+                "--app", "migapp",
+            )
+            assert code == 0 and "Migrated 7 events" in out
+            migrated = list(storage.backend_for_source("SQL").find(app.id))
+            assert len(migrated) == 7
+            assert {e.entity_id for e in migrated} == {
+                f"u{i}" for i in range(7)
+            }
+        finally:
+            set_storage(None)
+
     def test_build_rejects_bad_params(self, cli, tmp_path):
         variant = tmp_path / "engine.json"
         variant.write_text(

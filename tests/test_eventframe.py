@@ -54,6 +54,54 @@ class TestBiMap:
             BiMap(["a", "a"])
 
 
+class TestEntityMap:
+    def test_id_index_data_roundtrip(self):
+        from predictionio_tpu.utils.bimap import EntityMap
+
+        em = EntityMap({"u3": {"a": 1}, "u1": {"a": 2}, "u2": {"a": 3}})
+        assert len(em) == 3
+        # dense indices are a bijection over sorted ids
+        assert sorted(em.index(f"u{i}") for i in (1, 2, 3)) == [0, 1, 2]
+        ix = em.index("u2")
+        assert em.entity_id(ix) == "u2"
+        assert em.data("u2") == {"a": 3}
+        assert em.data(ix) == {"a": 3}  # index-addressed payload
+        assert em.get_data("nope") is None
+        assert "u1" in em and "u9" not in em
+        assert em.get("u9") is None
+        taken = em.take(2)
+        assert len(taken) == 2
+
+    def test_from_event_store(self, memory_storage):
+        import datetime as dt
+
+        from predictionio_tpu.data import DataMap, Event
+        from predictionio_tpu.data.storage import App
+        from predictionio_tpu.data.store import EventStore
+
+        app_id = memory_storage.get_meta_data_apps().insert(
+            App(id=0, name="emapp")
+        )
+        events = memory_storage.get_events()
+        events.init(app_id)
+        t0 = dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc)
+        for i, rating in enumerate([4.0, 5.0]):
+            events.insert(
+                Event(
+                    event="$set",
+                    entity_type="item",
+                    entity_id=f"i{i}",
+                    properties=DataMap({"rating": rating}),
+                    event_time=t0,
+                ),
+                app_id,
+            )
+        em = EventStore(memory_storage).extract_entity_map("emapp", "item")
+        assert len(em) == 2
+        assert em.data("i1")["rating"] == 5.0
+        assert em.data(em.index("i0"))["rating"] == 4.0
+
+
 class TestEventFrame:
     def test_from_events_columns(self):
         fr = EventFrame.from_events(

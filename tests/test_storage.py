@@ -12,6 +12,7 @@ from predictionio_tpu.data.storage import (
     App,
     Channel,
     EngineInstance,
+    EngineManifest,
     EvaluationInstance,
     Model,
     Storage,
@@ -119,6 +120,36 @@ class TestEngineInstances:
         assert eis.get(a).status == "FAILED"
         assert eis.get_latest_completed("e", "1", "other") is None
         assert eis.delete(a)
+
+
+class TestEngineManifests:
+    def test_crud(self, storage):
+        manifests = storage.get_meta_data_engine_manifests()
+        m = EngineManifest(
+            id="rec",
+            version="1.0",
+            name="recommendation",
+            description="ALS engine",
+            files=("/tmp/engine.json",),
+            engine_factory="predictionio_tpu.models.recommendation:factory",
+        )
+        manifests.insert(m)
+        got = manifests.get("rec", "1.0")
+        assert got == m
+        assert manifests.get("rec", "2.0") is None
+        assert manifests.get_all() == [m]
+        # update requires existence unless upsert
+        with pytest.raises(KeyError):
+            manifests.update(
+                EngineManifest(id="other", version="1.0", name="x")
+            )
+        manifests.update(
+            EngineManifest(id="other", version="1.0", name="x"), upsert=True
+        )
+        assert len(manifests.get_all()) == 2
+        assert manifests.delete("rec", "1.0") is True
+        assert manifests.delete("rec", "1.0") is False
+        assert manifests.get("rec", "1.0") is None
 
 
 class TestEvaluationInstances:
