@@ -386,6 +386,9 @@ class SQLiteEngineInstances(EngineInstancesBackend):
             ).rowcount > 0
 
 
+_EM_COLS = "id version name description files engine_factory".split()
+
+
 class SQLiteEngineManifests(EngineManifestsBackend):
     def __init__(self, client: SQLiteClient):
         self._c = client
@@ -399,7 +402,8 @@ class SQLiteEngineManifests(EngineManifestsBackend):
     def insert(self, manifest: EngineManifest) -> None:
         with self._c.conn as c:
             c.execute(
-                "INSERT OR REPLACE INTO engine_manifests VALUES (?,?,?,?,?,?)",
+                f"INSERT OR REPLACE INTO engine_manifests "
+                f"({','.join(_EM_COLS)}) VALUES (?,?,?,?,?,?)",
                 (
                     manifest.id, manifest.version, manifest.name,
                     manifest.description, json.dumps(list(manifest.files)),
@@ -409,14 +413,15 @@ class SQLiteEngineManifests(EngineManifestsBackend):
 
     def get(self, manifest_id: str, version: str) -> EngineManifest | None:
         row = self._c.conn.execute(
-            "SELECT * FROM engine_manifests WHERE id=? AND version=?",
+            f"SELECT {','.join(_EM_COLS)} FROM engine_manifests "
+            "WHERE id=? AND version=?",
             (manifest_id, version),
         ).fetchone()
         return self._from_row(row) if row else None
 
     def get_all(self) -> list[EngineManifest]:
         rows = self._c.conn.execute(
-            "SELECT * FROM engine_manifests"
+            f"SELECT {','.join(_EM_COLS)} FROM engine_manifests"
         ).fetchall()
         return [self._from_row(r) for r in rows]
 

@@ -90,6 +90,17 @@ class AdminServer:
 
 
 def create_admin_server(
-    host: str = "0.0.0.0", port: int = 7071, storage: Storage | None = None
+    host: str = "0.0.0.0",
+    port: int = 7071,
+    storage: Storage | None = None,
+    server_config=None,
 ) -> HTTPServer:
-    return HTTPServer(AdminServer(storage).router, host=host, port=port)
+    """``server_config`` enables TLS/key auth; the reference AdminAPI has
+    neither, so unlike the dashboard nothing is read from the env by
+    default."""
+    return HTTPServer(
+        AdminServer(storage).router,
+        host=host,
+        port=port,
+        server_config=server_config,
+    )

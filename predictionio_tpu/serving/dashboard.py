@@ -60,6 +60,21 @@ class Dashboard:
 
 
 def create_dashboard(
-    host: str = "0.0.0.0", port: int = 9000, storage: Storage | None = None
+    host: str = "0.0.0.0",
+    port: int = 9000,
+    storage: Storage | None = None,
+    server_config=None,
 ) -> HTTPServer:
-    return HTTPServer(Dashboard(storage).router, host=host, port=port)
+    """When ``server_config`` is None the environment's security config
+    applies (key auth + TLS — the reference dashboard mixes in
+    KeyAuthentication and SSLConfiguration, Dashboard.scala:44-60)."""
+    from predictionio_tpu.serving.config import ServerConfig
+
+    if server_config is None:
+        server_config = ServerConfig.from_env()
+    return HTTPServer(
+        Dashboard(storage).router,
+        host=host,
+        port=port,
+        server_config=server_config,
+    )

@@ -65,6 +65,7 @@ class EngineServer:
         max_batch: int = 64,
         max_wait_ms: float = 2.0,
         plugins: PluginContext | None = None,
+        server_config=None,
     ):
         self._engine = engine
         self._params = params
@@ -80,6 +81,11 @@ class EngineServer:
         self._max_batch = max_batch
         self._max_wait_ms = max_wait_ms
         self._plugins = plugins or PluginContext()
+        if server_config is None:
+            from predictionio_tpu.serving.config import ServerConfig
+
+            server_config = ServerConfig.from_env()
+        self._server_config = server_config
 
         self._lock = threading.Lock()
         self._request_count = 0
@@ -225,10 +231,15 @@ class EngineServer:
         return prediction
 
     def _reload(self, request: Request) -> Response:
+        # admin routes require the server key when auth is enforced
+        # (reference ServerActor mixes in KeyAuthentication for /stop;
+        # queries.json stays open)
+        self._server_config.check_key(request)
         self._load()
         return Response(200, {"message": "reloaded", "engineInstanceId": self._instance.id})
 
     def _stop(self, request: Request) -> Response:
+        self._server_config.check_key(request)
         if self._http is not None:
             threading.Thread(
                 target=self._http.shutdown, daemon=True
@@ -237,7 +248,15 @@ class EngineServer:
 
     # -- lifecycle --------------------------------------------------------
     def serve(self, host: str = "0.0.0.0", port: int = 8000) -> HTTPServer:
-        self._http = HTTPServer(self.router, host=host, port=port)
+        # enforce_key=False: TLS still applies, but key auth is
+        # per-route (/stop, /reload) — queries.json stays open
+        self._http = HTTPServer(
+            self.router,
+            host=host,
+            port=port,
+            server_config=self._server_config,
+            enforce_key=False,
+        )
         return self._http
 
     def close(self) -> None:

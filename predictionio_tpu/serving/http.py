@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import logging
 import re
+import ssl
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
@@ -126,11 +127,39 @@ class HTTPServer:
     """Threaded server wrapping a Router; start()/shutdown() lifecycle
     (the EventServerActor / MasterActor bind-unbind equivalent)."""
 
-    def __init__(self, router: Router, host: str = "0.0.0.0", port: int = 0):
+    def __init__(
+        self,
+        router: Router,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        server_config=None,
+        enforce_key: bool = True,
+    ):
+        """``server_config`` (a
+        :class:`~predictionio_tpu.serving.config.ServerConfig`) adds the
+        reference common-module behaviors: when its key auth is enforced
+        every route requires the server ``accessKey`` query param
+        (KeyAuthentication.scala:30-58), and when TLS is enabled
+        connections are TLS-wrapped with its SSL context
+        (SSLConfiguration.scala). ``enforce_key=False`` keeps TLS but
+        leaves auth to per-route handlers (the engine server key-auths
+        only its admin routes)."""
         router_ref = router
+        config_ref = server_config if enforce_key else None
 
         class _Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+
+            def setup(self):
+                # TLS handshake runs here, in the per-connection thread —
+                # never in the accept loop, where a stalled client would
+                # freeze the whole server
+                sock = self.request  # connection not yet bound pre-setup
+                if isinstance(sock, ssl.SSLSocket):
+                    sock.settimeout(10.0)
+                    sock.do_handshake()
+                    sock.settimeout(None)
+                super().setup()
 
             def log_message(self, fmt, *args):  # route through logging
                 logger.debug("%s %s", self.address_string(), fmt % args)
@@ -151,6 +180,8 @@ class HTTPServer:
                     path_params={},
                 )
                 try:
+                    if config_ref is not None:
+                        config_ref.check_key(request)
                     response = router_ref.dispatch(request)
                 except HTTPError as e:
                     response = Response(
@@ -172,11 +203,34 @@ class HTTPServer:
 
             do_GET = do_POST = do_DELETE = do_PUT = _handle
 
+        ssl_context = (
+            server_config.ssl_context() if server_config is not None else None
+        )
+
         class _Server(ThreadingHTTPServer):
             # socketserver's default backlog of 5 drops connections under
             # concurrent bursts — the exact load the batcher exists for
             request_queue_size = 128
             daemon_threads = True
+
+            def handle_error(self, request, client_address):
+                # connection-level failures (e.g. aborted TLS handshakes)
+                # are expected noise — log, don't spray tracebacks
+                logger.debug(
+                    "connection error from %s", client_address,
+                    exc_info=True,
+                )
+
+            def get_request(self):
+                sock, addr = super().get_request()
+                if ssl_context is not None:
+                    # defer the handshake to the handler thread (setup())
+                    sock = ssl_context.wrap_socket(
+                        sock,
+                        server_side=True,
+                        do_handshake_on_connect=False,
+                    )
+                return sock, addr
 
         self._httpd = _Server((host, port), _Handler)
         self._thread: threading.Thread | None = None

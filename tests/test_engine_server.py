@@ -155,6 +155,54 @@ class TestEngineServer:
         assert status == 400
 
 
+class TestKeyAuthedAdminRoutes:
+    """Key auth guards /stop and /reload but never /queries.json
+    (reference: ServerActor mixes KeyAuthentication into the admin
+    routes only)."""
+
+    @pytest.fixture()
+    def authed_server(self, ctx, memory_storage):
+        from predictionio_tpu.serving.config import ServerConfig
+
+        run_train(
+            _engine(), _params(), engine_id="srv-auth", ctx=ctx,
+            storage=memory_storage,
+        )
+        es = EngineServer(
+            _engine(),
+            _params(),
+            engine_id="srv-auth",
+            storage=memory_storage,
+            ctx=ctx,
+            server_config=ServerConfig(
+                key_auth_enforced=True, access_key="topsecret"
+            ),
+        )
+        http = es.serve(host="127.0.0.1", port=0)
+        http.start()
+        yield f"http://127.0.0.1:{http.port}"
+        http.shutdown()
+        es.close()
+
+    def test_queries_stay_open(self, authed_server):
+        status, body = _call(
+            f"{authed_server}/queries.json", "POST", {"x": 5}
+        )
+        assert status == 200 and body["result"] == 35
+
+    def test_reload_requires_key(self, authed_server):
+        status, _ = _call(f"{authed_server}/reload", "POST")
+        assert status == 401
+        status, _ = _call(
+            f"{authed_server}/reload?accessKey=topsecret", "POST"
+        )
+        assert status == 200
+
+    def test_stop_requires_key(self, authed_server):
+        status, _ = _call(f"{authed_server}/stop", "POST")
+        assert status == 401
+
+
 class TestMicroBatcher:
     def test_batches_and_results_in_order(self):
         seen_batches = []

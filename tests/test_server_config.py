@@ -1,0 +1,169 @@
+"""Server security config: key auth + TLS
+(reference common/.../KeyAuthentication.scala:30-58 and
+SSLConfiguration.scala; applied by the dashboard and engine server)."""
+
+import datetime as dt
+import json
+import ssl
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.data.storage import EvaluationInstance
+from predictionio_tpu.serving.config import ServerConfig
+from predictionio_tpu.serving.dashboard import create_dashboard
+from predictionio_tpu.serving.http import HTTPServer, Response, Router
+
+
+def _call(url, method="GET", context=None):
+    req = urllib.request.Request(url, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=10, context=context) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+class TestServerConfig:
+    def test_defaults_off(self):
+        cfg = ServerConfig.from_env(env={})
+        assert not cfg.key_auth_enforced and not cfg.ssl_enabled
+        assert cfg.ssl_context() is None
+
+    def test_env_overrides_file(self, tmp_path):
+        (tmp_path / "server.json").write_text(
+            json.dumps(
+                {"key_auth_enforced": True, "access_key": "filekey"}
+            )
+        )
+        cfg = ServerConfig.from_env(
+            env={"PIO_CONF_DIR": str(tmp_path)}
+        )
+        assert cfg.key_auth_enforced and cfg.access_key == "filekey"
+        cfg = ServerConfig.from_env(
+            env={
+                "PIO_CONF_DIR": str(tmp_path),
+                "PIO_SERVER_ACCESS_KEY": "envkey",
+                "PIO_SERVER_KEY_AUTH_ENFORCED": "false",
+            }
+        )
+        assert cfg.access_key == "envkey" and not cfg.key_auth_enforced
+
+    def test_ssl_requires_cert_paths(self):
+        cfg = ServerConfig(ssl_enabled=True)
+        with pytest.raises(ValueError, match="ssl_certfile"):
+            cfg.ssl_context()
+
+
+class TestDashboardKeyAuth:
+    @pytest.fixture()
+    def dashboard(self, memory_storage):
+        memory_storage.get_meta_data_evaluation_instances().insert(
+            EvaluationInstance(
+                id="ev1",
+                status="EVALCOMPLETED",
+                start_time=dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc),
+                end_time=dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc),
+                evaluation_class="MyEval",
+                evaluator_results="mse=0.5",
+            )
+        )
+        http = create_dashboard(
+            host="127.0.0.1",
+            port=0,
+            storage=memory_storage,
+            server_config=ServerConfig(
+                key_auth_enforced=True, access_key="sekrit"
+            ),
+        )
+        http.start()
+        yield f"http://127.0.0.1:{http.port}"
+        http.shutdown()
+
+    def test_rejects_without_key(self, dashboard):
+        status, _ = _call(f"{dashboard}/")
+        assert status == 401
+        status, _ = _call(f"{dashboard}/?accessKey=wrong")
+        assert status == 401
+
+    def test_accepts_with_key(self, dashboard):
+        status, body = _call(f"{dashboard}/?accessKey=sekrit")
+        assert status == 200 and b"MyEval" in body
+        status, body = _call(
+            f"{dashboard}/engine_instances/ev1?accessKey=sekrit"
+        )
+        assert status == 200 and b"mse=0.5" in body
+
+
+def _self_signed_cert(tmp_path):
+    """PEM cert+key via the in-image cryptography lib."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "localhost")]
+    )
+    now = dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now)
+        .not_valid_after(now + dt.timedelta(days=36500))
+        .add_extension(
+            x509.SubjectAlternativeName([x509.DNSName("localhost")]),
+            critical=False,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    certfile = tmp_path / "cert.pem"
+    keyfile = tmp_path / "key.pem"
+    certfile.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    keyfile.write_bytes(
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        )
+    )
+    return str(certfile), str(keyfile)
+
+
+class TestTLS:
+    def test_https_roundtrip(self, tmp_path):
+        certfile, keyfile = _self_signed_cert(tmp_path)
+        router = Router()
+        router.route(
+            "GET", "/ping", lambda req: Response(200, {"pong": True})
+        )
+        http = HTTPServer(
+            router,
+            host="127.0.0.1",
+            port=0,
+            server_config=ServerConfig(
+                ssl_enabled=True,
+                ssl_certfile=certfile,
+                ssl_keyfile=keyfile,
+            ),
+        )
+        http.start()
+        try:
+            client_ctx = ssl.create_default_context(cafile=certfile)
+            client_ctx.check_hostname = False
+            status, body = _call(
+                f"https://127.0.0.1:{http.port}/ping", context=client_ctx
+            )
+            assert status == 200 and json.loads(body) == {"pong": True}
+            # plain HTTP against the TLS socket must not succeed
+            with pytest.raises(Exception):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{http.port}/ping", timeout=3
+                )
+        finally:
+            http.shutdown()
