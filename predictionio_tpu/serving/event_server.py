@@ -291,7 +291,22 @@ def create_event_server(
     storage: Storage | None = None,
     stats: bool = False,
     plugins: PluginContext | None = None,
+    server_config=None,
 ) -> HTTPServer:
-    """Reference EventServer.createEventServer (default port 7070)."""
+    """Reference EventServer.createEventServer (default port 7070).
+
+    TLS comes from ``server_config`` (default: the environment's
+    ServerConfig). The global server key is never enforced here — the
+    event API has its own per-app access keys."""
+    from predictionio_tpu.serving.config import ServerConfig
+
+    if server_config is None:
+        server_config = ServerConfig.from_env()
     server = EventServer(storage=storage, stats=stats, plugins=plugins)
-    return HTTPServer(server.router, host=host, port=port)
+    return HTTPServer(
+        server.router,
+        host=host,
+        port=port,
+        server_config=server_config,
+        enforce_key=False,
+    )

@@ -135,6 +135,38 @@ def _self_signed_cert(tmp_path):
     return str(certfile), str(keyfile)
 
 
+class TestEventServerTLS:
+    def test_event_server_serves_https(self, tmp_path, memory_storage):
+        from predictionio_tpu.serving.event_server import (
+            create_event_server,
+        )
+
+        certfile, keyfile = _self_signed_cert(tmp_path)
+        http = create_event_server(
+            host="127.0.0.1",
+            port=0,
+            storage=memory_storage,
+            server_config=ServerConfig(
+                ssl_enabled=True,
+                ssl_certfile=certfile,
+                ssl_keyfile=keyfile,
+                # global server key must NOT apply to the event API
+                key_auth_enforced=True,
+                access_key="serverkey",
+            ),
+        )
+        http.start()
+        try:
+            ctx = ssl.create_default_context(cafile=certfile)
+            ctx.check_hostname = False
+            status, body = _call(
+                f"https://127.0.0.1:{http.port}/", context=ctx
+            )
+            assert status == 200
+        finally:
+            http.shutdown()
+
+
 class TestTLS:
     def test_https_roundtrip(self, tmp_path):
         certfile, keyfile = _self_signed_cert(tmp_path)
