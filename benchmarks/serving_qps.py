@@ -1,0 +1,294 @@
+"""End-to-end serving throughput benchmark.
+
+Measures predict QPS through the full stack — HTTP → micro-batcher →
+jitted top-k scoring on device → HTTP response — against BASELINE.md's
+``>= 1,000 QPS`` target (the reference's serving path is a Spark job
+per query for RDD-backed models, SURVEY.md §3.2).
+
+Trains the real recommendation template (implicit ALS) on a synthetic
+two-cluster dataset, deploys an :class:`EngineServer` on localhost, and
+drives it with keep-alive client threads.
+
+Run: ``python benchmarks/serving_qps.py [--seconds 10] [--clients 64]``
+Prints one JSON line: {"metric": "serving_qps", "value": ..., ...}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+
+def seed_storage(n_users: int, n_items: int, events_per_user: int = 12):
+    from predictionio_tpu.data import DataMap, Event
+    from predictionio_tpu.data.storage import App, Storage, set_storage
+
+    storage = Storage(
+        env={
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        }
+    )
+    set_storage(storage)
+    app_id = storage.get_meta_data_apps().insert(App(id=0, name="qpsapp"))
+    events = storage.get_events()
+    events.init(app_id)
+    rng = np.random.default_rng(7)
+    batch = []
+    for u in range(n_users):
+        for i in rng.integers(0, n_items, events_per_user):
+            batch.append(
+                Event(
+                    event="rate",
+                    entity_type="user",
+                    entity_id=f"u{u}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{i}",
+                    properties=DataMap({"rating": float(rng.integers(1, 6))}),
+                )
+            )
+    events.insert_batch(batch, app_id)
+    return storage
+
+
+def build_server(storage, rank: int, host: str):
+    from predictionio_tpu.core.engine import EngineParams
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.models.recommendation import (
+        ALSParams,
+        RecDataSourceParams,
+        RecPreparatorParams,
+        recommendation_engine,
+    )
+    from predictionio_tpu.parallel.mesh import ComputeContext
+    from predictionio_tpu.serving.engine_server import EngineServer
+
+    engine = recommendation_engine()
+    params = EngineParams(
+        data_source=(
+            "", RecDataSourceParams(app_name="qpsapp", event_names=("rate",))
+        ),
+        preparator=("", RecPreparatorParams()),
+        algorithms=[
+            ("als", ALSParams(rank=rank, num_iterations=5, lambda_=0.1))
+        ],
+    )
+    ctx = ComputeContext.create(batch="qps-bench")
+    run_train(engine, params, engine_id="qps", ctx=ctx, storage=storage)
+    server = EngineServer(
+        engine,
+        params,
+        engine_id="qps",
+        storage=storage,
+        ctx=ctx,
+        max_batch=256,
+        max_wait_ms=2.0,
+    )
+    http_srv = server.serve(host=host, port=0)
+    http_srv.start()
+    return server, http_srv
+
+
+def _client_proc(host, port, n_users, seconds, conns_per_proc, seed, out_q):
+    """One client process running several keep-alive connection threads.
+
+    Clients live in separate processes so their Python work does not
+    share the GIL with the server under test."""
+    counts = [0] * conns_per_proc
+    errors = [0] * conns_per_proc
+    lat: list[list[float]] = [[] for _ in range(conns_per_proc)]
+    stop_at = time.perf_counter() + seconds
+
+    def worker(w: int):
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        rng = np.random.default_rng(seed * 1000 + w)
+        while time.perf_counter() < stop_at:
+            body = json.dumps(
+                {"user": f"u{rng.integers(0, n_users)}", "num": 10}
+            )
+            t0 = time.perf_counter()
+            try:
+                conn.request(
+                    "POST", "/queries.json", body,
+                    {"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                data = resp.read()
+                if resp.status == 200 and b"itemScores" in data:
+                    counts[w] += 1
+                    lat[w].append(time.perf_counter() - t0)
+                else:
+                    errors[w] += 1
+            except Exception:
+                errors[w] += 1
+                conn.close()
+                conn = http.client.HTTPConnection(host, port, timeout=30)
+        conn.close()
+
+    threads = [
+        threading.Thread(target=worker, args=(w,))
+        for w in range(conns_per_proc)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    out_q.put((sum(counts), sum(errors), sum(lat, [])))
+
+
+def drive(
+    host: str,
+    port: int,
+    n_users: int,
+    seconds: float,
+    clients: int,
+    procs: int = 16,
+):
+    """Multi-process client swarm; returns (ok, errors, latencies, s)."""
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    procs = min(procs, clients)
+    per = max(1, clients // procs)
+    out_q = ctx.Queue()
+    ps = [
+        ctx.Process(
+            target=_client_proc,
+            args=(host, port, n_users, seconds, per, i, out_q),
+        )
+        for i in range(procs)
+    ]
+    t_start = time.perf_counter()
+    for p in ps:
+        p.start()
+    results = [out_q.get() for _ in ps]
+    for p in ps:
+        p.join()
+    elapsed = time.perf_counter() - t_start
+    ok = sum(r[0] for r in results)
+    errs = sum(r[1] for r in results)
+    lats = sorted(sum((r[2] for r in results), []))
+    return ok, errs, lats, elapsed
+
+
+def device_capacity(storage, rank: int, n_users: int, seconds: float):
+    """Predict throughput through the batched device path, no HTTP.
+
+    On a 1-core host (this rig) the HTTP stack and the client swarm
+    contend for the same core, so end-to-end QPS measures the host, not
+    the framework; this mode isolates what the TPU serving path
+    sustains: batch_predict on full buckets, back to back."""
+    from predictionio_tpu.core.engine import EngineParams
+    from predictionio_tpu.core.workflow import load_deployment, run_train
+    from predictionio_tpu.models.recommendation import (
+        ALSParams,
+        RecDataSourceParams,
+        RecPreparatorParams,
+        recommendation_engine,
+    )
+    from predictionio_tpu.parallel.mesh import ComputeContext
+
+    engine = recommendation_engine()
+    params = EngineParams(
+        data_source=(
+            "", RecDataSourceParams(app_name="qpsapp", event_names=("rate",))
+        ),
+        preparator=("", RecPreparatorParams()),
+        algorithms=[
+            ("als", ALSParams(rank=rank, num_iterations=5, lambda_=0.1))
+        ],
+    )
+    ctx = ComputeContext.create(batch="qps-bench")
+    run_train(engine, params, engine_id="qps", ctx=ctx, storage=storage)
+    _, algorithms, models, _ = load_deployment(
+        engine, params, engine_id="qps", ctx=ctx, storage=storage
+    )
+    algo, model = algorithms[0], models[0]
+    rng = np.random.default_rng(3)
+    batch = 256
+    queries = [
+        {"user": f"u{rng.integers(0, n_users)}", "num": 10}
+        for _ in range(batch)
+    ]
+    algo.batch_predict(model, queries)  # warm/compile
+    done = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        algo.batch_predict(model, queries)
+        done += batch
+    elapsed = time.perf_counter() - t0
+    return done / elapsed, batch
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=10.0)
+    ap.add_argument("--clients", type=int, default=64)
+    ap.add_argument("--users", type=int, default=2000)
+    ap.add_argument("--items", type=int, default=1000)
+    ap.add_argument("--rank", type=int, default=32)
+    ap.add_argument(
+        "--mode", choices=["http", "device"], default="http",
+        help="http = full stack; device = batched predict only",
+    )
+    args = ap.parse_args()
+
+    storage = seed_storage(args.users, args.items)
+    if args.mode == "device":
+        qps, batch = device_capacity(
+            storage, args.rank, args.users, args.seconds
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": "serving_device_qps",
+                    "value": round(qps, 1),
+                    "unit": "qps",
+                    "vs_baseline": round(qps / 1000.0, 2),
+                    "batch": batch,
+                }
+            )
+        )
+        return 0
+
+    server, http_srv = build_server(storage, args.rank, "127.0.0.1")
+    try:
+        # warm the serving path (compile the batched predict)
+        drive("127.0.0.1", http_srv.port, args.users, 2.0, 8)
+        ok, errs, lats, elapsed = drive(
+            "127.0.0.1", http_srv.port, args.users,
+            args.seconds, args.clients,
+        )
+    finally:
+        http_srv.shutdown()
+        server.close()
+    qps = ok / elapsed
+    p50 = lats[len(lats) // 2] * 1e3 if lats else float("nan")
+    p99 = lats[int(len(lats) * 0.99)] * 1e3 if lats else float("nan")
+    print(
+        json.dumps(
+            {
+                "metric": "serving_qps",
+                "value": round(qps, 1),
+                "unit": "qps",
+                "vs_baseline": round(qps / 1000.0, 2),
+                "errors": errs,
+                "p50_ms": round(p50, 2),
+                "p99_ms": round(p99, 2),
+                "clients": args.clients,
+            }
+        )
+    )
+    return 0 if errs == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

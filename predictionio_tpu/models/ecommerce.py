@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -179,7 +180,8 @@ class ECommAlgorithm(Algorithm):
             scores, cand = similarity.top_k_dot(
                 jnp.asarray(qvec), jnp.asarray(model.item_factors), k
             )
-            scores, cand = np.asarray(scores)[0], np.asarray(cand)[0]
+            scores, cand = jax.device_get((scores, cand))  # parallel fetch
+            scores, cand = scores[0], cand[0]
         else:
             # cold user: popularity ranking (reference falls back to
             # popular-items scoring)

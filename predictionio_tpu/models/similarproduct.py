@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -155,7 +156,8 @@ class SimilarALSAlgorithm(Algorithm):
         scores, cand = similarity.top_k_cosine(
             jnp.asarray(qvec), jnp.asarray(model.item_factors), k
         )
-        scores, cand = np.asarray(scores)[0], np.asarray(cand)[0]
+        scores, cand = jax.device_get((scores, cand))  # parallel fetch
+        scores, cand = scores[0], cand[0]
 
         categories = set(query.get("categories") or [])
         white = set(query.get("whiteList") or [])

@@ -34,7 +34,9 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_NEG = float(np.finfo(np.float32).min)
+# -inf, not finfo.min: unrankable slots (over-masked rows, NaN factors)
+# must come back with score -inf exactly like the XLA lax.top_k path
+_NEG = float("-inf")
 
 
 def _merge_block(scores, gcols, num, best_s, best_i):
@@ -73,7 +75,7 @@ def _merge_block(scores, gcols, num, best_s, best_i):
             [jnp.full((b, 1), _NEG, best_s.dtype), best_s[:, :-1]], axis=1
         )
         prev_i = jnp.concatenate(
-            [jnp.full((b, 1), -1, best_i.dtype), best_i[:, :-1]], axis=1
+            [jnp.zeros((b, 1), best_i.dtype), best_i[:, :-1]], axis=1
         )
         new_s = jnp.where(
             pos < rank, best_s, jnp.where(pos == rank, m, prev_s)
@@ -107,7 +109,10 @@ def _topk_kernel(
     @pl.when(j == 0)
     def _init():
         best_s_ref[:] = jnp.full_like(best_s_ref, _NEG)
-        best_i_ref[:] = jnp.full_like(best_i_ref, -1)
+        # index 0, not -1: slots that never fill (fewer rankable items
+        # than num) must still hold a VALID index, matching the XLA
+        # path's contract (arbitrary index, score -inf)
+        best_i_ref[:] = jnp.zeros_like(best_i_ref)
 
     scores = jax.lax.dot_general(
         q_ref[:],
@@ -203,7 +208,7 @@ def fused_top_k_dot(
         )(*operands)
     else:
         best_s = jnp.full((b, num), _NEG, jnp.float32)
-        best_i = jnp.full((b, num), -1, jnp.int32)
+        best_i = jnp.zeros((b, num), jnp.int32)
 
     if head < n_items:
         tail_s = jnp.where(

@@ -46,9 +46,11 @@ def _use_pallas(batch: int, n_items: int) -> bool:
     override = os.environ.get("PIO_PALLAS_TOPK")
     if override is not None:
         return override.strip().lower() in {"1", "true", "yes", "on"}
+    # compiled Mosaic kernels exist only for TPU; every other backend
+    # would hit the (slow) interpreter, so never auto-select it there
     return (
         batch * n_items * 4 >= _PALLAS_MIN_INTERMEDIATE_BYTES
-        and jax.default_backend() not in ("cpu", "gpu")
+        and jax.default_backend() == "tpu"
     )
 
 

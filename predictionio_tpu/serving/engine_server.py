@@ -66,6 +66,7 @@ class EngineServer:
         max_wait_ms: float = 2.0,
         plugins: PluginContext | None = None,
         server_config=None,
+        warmup: bool = True,
     ):
         self._engine = engine
         self._params = params
@@ -81,6 +82,7 @@ class EngineServer:
         self._max_batch = max_batch
         self._max_wait_ms = max_wait_ms
         self._plugins = plugins or PluginContext()
+        self._warmup = warmup
         if server_config is None:
             from predictionio_tpu.serving.config import ServerConfig
 
@@ -115,6 +117,8 @@ class EngineServer:
             storage=self._storage,
         )
         old = self._batchers
+        if self._warmup:
+            self._precompile(algorithms, models)
         batchers = [
             MicroBatcher(
                 (lambda a, m: lambda qs: a.batch_predict(m, qs))(
@@ -136,6 +140,31 @@ class EngineServer:
             instance.id,
             len(batchers),
         )
+
+    def _precompile(self, algorithms, models) -> None:
+        """Compile every power-of-two batch bucket before traffic hits.
+
+        XLA compiles per static shape; without this, each new bucket
+        size compiles lazily mid-traffic (seconds-long p99 spikes on
+        first occurrence). Algorithms expose a neutral ``warmup_query``
+        (default ``{}``); ones whose predict cannot run on it just skip.
+        """
+        for algo, model in zip(algorithms, models):
+            query = getattr(algo, "warmup_query", lambda: {})()
+            bucket = 1
+            while True:
+                try:
+                    algo.batch_predict(model, [query] * bucket)
+                except Exception as e:  # noqa: BLE001 - warmup best-effort
+                    logger.debug(
+                        "warmup skipped (batch %d): %s", bucket, e
+                    )
+                    break
+                if bucket >= self._max_batch:
+                    # covers the next-pow2 bucket a non-power-of-two
+                    # max_batch rounds up into at predict time
+                    break
+                bucket *= 2
 
     # -- routes -----------------------------------------------------------
     def _status(self, request: Request) -> Response:

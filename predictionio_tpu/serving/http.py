@@ -149,6 +149,9 @@ class HTTPServer:
 
         class _Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # response header+body go out in one write; without NODELAY
+            # Nagle + delayed ACK adds ~40 ms to every keep-alive request
+            disable_nagle_algorithm = True
 
             def setup(self):
                 # TLS handshake runs here, in the per-connection thread —

@@ -112,12 +112,38 @@ class TestFusedTopK:
 
 
 class TestDispatch:
-    def test_env_override_forces_pallas(self, monkeypatch):
+    def test_env_override_off_forces_xla(self, monkeypatch):
         monkeypatch.setenv("PIO_PALLAS_TOPK", "0")
         q, items = _random(2, 50, 4)
         s, i = top_k_dot(q, items, 3)
         xs, xi = _top_k_dot_xla(q, items, 3)
         assert (np.asarray(i) == np.asarray(xi)).all()
+
+    def test_env_override_on_forces_pallas_interpreter(self, monkeypatch):
+        # on the CPU backend a forced override must route through the
+        # Pallas interpreter, not try to compile Mosaic
+        monkeypatch.setenv("PIO_PALLAS_TOPK", "1")
+        q, items = _random(2, 300, 4, seed=7)
+        s, i = top_k_dot(q, items, 3)
+        xs, xi = _top_k_dot_xla(q, items, 3)
+        np.testing.assert_allclose(
+            np.asarray(s), np.asarray(xs), rtol=1e-5, atol=1e-5
+        )
+        assert (np.asarray(i) == np.asarray(xi)).all()
+
+    def test_overmasked_row_contract(self):
+        # fewer rankable items than num: score -inf, index still valid
+        q, items = _random(2, 40, 4, seed=8)
+        mask = np.ones((2, 40), dtype=bool)
+        mask[:, :3] = False  # only 3 rankable
+        ps, pi = fused_top_k_dot(
+            q, items, 5, mask=jnp.asarray(mask), block=128, interpret=True
+        )
+        ps, pi = np.asarray(ps), np.asarray(pi)
+        assert np.isneginf(ps[:, 3:]).all()
+        assert (pi >= 0).all() and (pi < 40).all()
+        assert np.isfinite(ps[:, :3]).all()
+        assert (pi[:, :3] < 3).all()
 
     def test_cpu_backend_defaults_to_xla(self):
         # conftest forces CPU; the dispatcher must not pick pallas
