@@ -1,0 +1,34 @@
+"""Seed the helloworld quickstart with daily temperature reports
+(counterpart of the reference's data/helloworld/data.csv,
+examples/experimental/scala-local-helloworld/README.md)."""
+
+import argparse
+import random
+
+from predictionio_tpu.client import EventClient
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--access-key", required=True)
+    parser.add_argument("--url", default="http://127.0.0.1:7070")
+    args = parser.parse_args()
+
+    client = EventClient(args.access_key, args.url)
+    random.seed(1)
+    base = {"Mon": 75, "Tue": 80, "Wed": 70, "Thu": 65, "Fri": 68}
+    n = 0
+    for week in range(4):
+        for day, temp in base.items():
+            client.create_event(
+                event="report",
+                entity_type="day",
+                entity_id=day,
+                properties={"temperature": temp + random.uniform(-3, 3)},
+            )
+            n += 1
+    print(f"{n} temperature reports imported.")
+
+
+if __name__ == "__main__":
+    main()

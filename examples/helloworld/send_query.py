@@ -1,0 +1,23 @@
+"""Query the deployed helloworld engine."""
+
+import argparse
+import json
+import urllib.request
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--url", default="http://127.0.0.1:8000")
+    parser.add_argument("--day", default="Mon")
+    args = parser.parse_args()
+    req = urllib.request.Request(
+        f"{args.url}/queries.json",
+        json.dumps({"day": args.day}).encode(),
+        {"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as resp:
+        print(resp.read().decode())
+
+
+if __name__ == "__main__":
+    main()

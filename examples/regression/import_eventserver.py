@@ -1,0 +1,37 @@
+"""Seed the regression quickstart with labeled points
+(counterpart of the reference's data/lr_data.txt,
+examples/experimental/scala-parallel-regression/README.md)."""
+
+import argparse
+import random
+
+from predictionio_tpu.client import EventClient
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--access-key", required=True)
+    parser.add_argument("--url", default="http://127.0.0.1:7070")
+    parser.add_argument("--n", type=int, default=200)
+    args = parser.parse_args()
+
+    client = EventClient(args.access_key, args.url)
+    random.seed(3)
+    true_w = [2.0, -1.0, 0.5]
+    n = 0
+    for i in range(args.n):
+        x = [random.uniform(-1, 1) for _ in true_w]
+        y = sum(w * xi for w, xi in zip(true_w, x)) + 3.0
+        y += random.gauss(0, 0.05)
+        client.create_event(
+            event="point",
+            entity_type="point",
+            entity_id=f"p{i}",
+            properties={"label": y, "features": x},
+        )
+        n += 1
+    print(f"{n} points imported.")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""Query the deployed regression engine."""
+
+import argparse
+import json
+import urllib.request
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--url", default="http://127.0.0.1:8000")
+    parser.add_argument(
+        "--features", default="0.5,0.5,0.5",
+        help="comma-separated feature values",
+    )
+    args = parser.parse_args()
+    features = [float(x) for x in args.features.split(",")]
+    req = urllib.request.Request(
+        f"{args.url}/queries.json",
+        json.dumps({"features": features}).encode(),
+        {"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as resp:
+        print(resp.read().decode())
+
+
+if __name__ == "__main__":
+    main()

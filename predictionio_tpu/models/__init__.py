@@ -30,3 +30,15 @@ try:
     _TEMPLATES.append("ecommerce")
 except ImportError:  # pragma: no cover
     pass
+try:
+    from predictionio_tpu.models import helloworld  # noqa: F401
+
+    _TEMPLATES.append("helloworld")
+except ImportError:  # pragma: no cover
+    pass
+try:
+    from predictionio_tpu.models import regression  # noqa: F401
+
+    _TEMPLATES.append("regression")
+except ImportError:  # pragma: no cover
+    pass

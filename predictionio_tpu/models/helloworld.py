@@ -1,0 +1,125 @@
+"""HelloWorld template — the smallest possible engine.
+
+Capability parity with the reference
+``examples/experimental/scala-local-helloworld/HelloWorld.scala``
+(and its java-local twin): training data is (day, temperature) pairs,
+the model is the mean temperature per day, a query ``{"day": "Mon"}``
+answers ``{"temperature": <mean>}``. The reference reads a CSV
+(``data/helloworld/data.csv``); this version reads either the event
+store ("report" events on entity type "day" carrying a ``temperature``
+property) or a CSV file, whichever the params name.
+
+Deliberately tiny, but still TPU-shaped: the per-day mean is a
+``segment_sum`` on device — the same primitive every bigger aggregation
+in this framework uses — so the tutorial teaches the real pattern.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.core import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    IdentityPreparator,
+    Params,
+    register_engine,
+)
+from predictionio_tpu.data.store import EventStore
+from predictionio_tpu.parallel.mesh import ComputeContext
+from predictionio_tpu.utils.bimap import BiMap
+
+
+@dataclasses.dataclass(frozen=True)
+class HelloDataSourceParams(Params):
+    app_name: str = ""       # read "report" events from this app…
+    filepath: str = ""       # …or "day,temperature" CSV lines from a file
+    event_name: str = "report"
+    day_entity_type: str = "day"
+
+
+@dataclasses.dataclass
+class HelloTrainingData:
+    days: np.ndarray          # [N] str
+    temperatures: np.ndarray  # [N] float32
+
+
+class HelloDataSource(DataSource):
+    params_class = HelloDataSourceParams
+
+    def read_training(self, ctx: ComputeContext) -> HelloTrainingData:
+        p = self.params
+        days, temps = [], []
+        if p.filepath:
+            with open(p.filepath) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    day, temp = line.split(",")
+                    days.append(day.strip())
+                    temps.append(float(temp))
+        else:
+            for event in EventStore().find(
+                p.app_name,
+                entity_type=p.day_entity_type,
+                event_names=[p.event_name],
+            ):
+                days.append(event.entity_id)
+                temps.append(float(event.properties.get("temperature")))
+        if not days:
+            raise ValueError("no temperature data found")
+        return HelloTrainingData(
+            days=np.asarray(days),
+            temperatures=np.asarray(temps, np.float32),
+        )
+
+
+@dataclasses.dataclass
+class HelloModel:
+    day_map: BiMap
+    means: np.ndarray  # [n_days] float32
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _segment_mean(codes: jax.Array, values: jax.Array, n: int):
+    total = jax.ops.segment_sum(values, codes, num_segments=n)
+    count = jax.ops.segment_sum(
+        jnp.ones_like(values), codes, num_segments=n
+    )
+    return total / jnp.maximum(count, 1.0)
+
+
+class HelloAlgorithm(Algorithm):
+
+    def train(self, ctx: ComputeContext, pd: HelloTrainingData) -> HelloModel:
+        day_map, codes = BiMap.string_int_with_codes(pd.days)
+        means = _segment_mean(
+            jnp.asarray(codes), jnp.asarray(pd.temperatures), len(day_map)
+        )
+        return HelloModel(day_map=day_map, means=np.asarray(means))
+
+    def predict(self, model: HelloModel, query: dict) -> dict:
+        idx = model.day_map.get(str(query.get("day", "")), None)
+        if idx is None:
+            return {"temperature": None}
+        return {"temperature": float(model.means[idx])}
+
+
+def helloworld_engine() -> Engine:
+    return Engine(
+        HelloDataSource,
+        IdentityPreparator,
+        {"hello": HelloAlgorithm},
+        FirstServing,
+    )
+
+
+register_engine("helloworld", helloworld_engine)

@@ -1,0 +1,189 @@
+"""HelloWorld + regression template tests (reference
+examples/experimental/scala-local-helloworld and
+scala-parallel-regression)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core import EngineParams
+from predictionio_tpu.core.workflow import load_deployment, run_train
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import App
+from predictionio_tpu.models.helloworld import (
+    HelloDataSourceParams,
+    helloworld_engine,
+)
+from predictionio_tpu.models.regression import (
+    RegressionAlgorithmParams,
+    RegressionDataSourceParams,
+    regression_engine,
+)
+from predictionio_tpu.parallel.mesh import ComputeContext
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return ComputeContext.create(batch="exp-tpl-test")
+
+
+class TestHelloWorld:
+    def _seed(self, storage):
+        app_id = storage.get_meta_data_apps().insert(
+            App(id=0, name="helloapp")
+        )
+        events = storage.get_events()
+        events.init(app_id)
+        temps = {"Mon": [74.0, 76.0], "Tue": [80.0], "Wed": [70.0, 72.0]}
+        for day, values in temps.items():
+            for t in values:
+                events.insert(
+                    Event(
+                        event="report",
+                        entity_type="day",
+                        entity_id=day,
+                        properties=DataMap({"temperature": t}),
+                    ),
+                    app_id,
+                )
+        return temps
+
+    def test_mean_per_day(self, ctx, memory_storage):
+        self._seed(memory_storage)
+        engine = helloworld_engine()
+        params = EngineParams(
+            data_source=("", HelloDataSourceParams(app_name="helloapp")),
+            algorithms=[("hello", None)],
+        )
+        run_train(
+            engine, params, engine_id="hello", ctx=ctx,
+            storage=memory_storage,
+        )
+        _, algos, models, serving = load_deployment(
+            engine, params, engine_id="hello", ctx=ctx,
+            storage=memory_storage,
+        )
+        predict = lambda q: serving.serve(
+            q, [a.predict(m, q) for a, m in zip(algos, models)]
+        )
+        assert predict({"day": "Mon"})["temperature"] == pytest.approx(75.0)
+        assert predict({"day": "Tue"})["temperature"] == pytest.approx(80.0)
+        assert predict({"day": "Sat"})["temperature"] is None
+
+    def test_csv_file_source(self, ctx, tmp_path):
+        csv = tmp_path / "data.csv"
+        csv.write_text("Mon,75\nTue,80\nMon,77\n")
+        engine = helloworld_engine()
+        params = EngineParams(
+            data_source=("", HelloDataSourceParams(filepath=str(csv))),
+            algorithms=[("hello", None)],
+        )
+        data = engine.make_data_source(params).read_training(ctx)
+        assert len(data.days) == 3
+
+
+class TestRegression:
+    true_w = np.array([2.0, -1.0, 0.5], np.float32)
+    intercept = 3.0
+
+    def _seed(self, storage, n=200):
+        app_id = storage.get_meta_data_apps().insert(
+            App(id=0, name="regapp")
+        )
+        events = storage.get_events()
+        events.init(app_id)
+        rng = np.random.default_rng(5)
+        X = rng.uniform(-1, 1, (n, 3)).astype(np.float32)
+        y = X @ self.true_w + self.intercept
+        y += rng.normal(0, 0.01, n).astype(np.float32)
+        for i in range(n):
+            events.insert(
+                Event(
+                    event="point",
+                    entity_type="point",
+                    entity_id=f"p{i}",
+                    properties=DataMap(
+                        {
+                            "label": float(y[i]),
+                            "features": [float(v) for v in X[i]],
+                        }
+                    ),
+                ),
+                app_id,
+            )
+
+    def _params(self, algos):
+        return EngineParams(
+            data_source=(
+                "", RegressionDataSourceParams(app_name="regapp", eval_k=3)
+            ),
+            algorithms=algos,
+        )
+
+    @pytest.mark.parametrize("solver", ["sgd", "normal"])
+    def test_recovers_weights(self, ctx, memory_storage, solver):
+        self._seed(memory_storage)
+        engine = regression_engine()
+        params = self._params(
+            [
+                (
+                    "SGD",
+                    RegressionAlgorithmParams(
+                        solver=solver, num_iterations=800, step_size=0.3
+                    ),
+                )
+            ]
+        )
+        run_train(
+            engine, params, engine_id=f"reg-{solver}", ctx=ctx,
+            storage=memory_storage,
+        )
+        _, algos, models, _ = load_deployment(
+            engine, params, engine_id=f"reg-{solver}", ctx=ctx,
+            storage=memory_storage,
+        )
+        model = models[0]
+        np.testing.assert_allclose(
+            model.weights, self.true_w, atol=0.05
+        )
+        assert model.intercept == pytest.approx(3.0, abs=0.05)
+        pred = algos[0].predict(model, {"features": [0.5, 0.5, 0.5]})
+        assert pred == pytest.approx(
+            float(np.array([0.5, 0.5, 0.5]) @ self.true_w + 3.0), abs=0.1
+        )
+
+    def test_multi_step_size_average_serving(self, ctx, memory_storage):
+        """Three SGD configs averaged — the reference Run.scala setup."""
+        self._seed(memory_storage)
+        engine = regression_engine()
+        params = self._params(
+            [
+                ("SGD", RegressionAlgorithmParams(step_size=s))
+                for s in (0.1, 0.2, 0.4)
+            ]
+        )
+        run_train(
+            engine, params, engine_id="reg-multi", ctx=ctx,
+            storage=memory_storage,
+        )
+        _, algos, models, serving = load_deployment(
+            engine, params, engine_id="reg-multi", ctx=ctx,
+            storage=memory_storage,
+        )
+        q = {"features": [0.2, -0.3, 0.8]}
+        preds = [a.predict(m, q) for a, m in zip(algos, models)]
+        combined = serving.serve(q, preds)
+        assert combined == pytest.approx(sum(preds) / 3)
+
+    def test_read_eval_folds(self, ctx, memory_storage):
+        self._seed(memory_storage, n=60)
+        engine = regression_engine()
+        params = self._params([("SGD", RegressionAlgorithmParams())])
+        ds = engine.make_data_source(params)
+        folds = ds.read_eval(ctx)
+        assert len(folds) == 3
+        total_test = sum(len(qa) for _, _, qa in folds)
+        assert total_test == 60
+        for train, info, qa in folds:
+            assert len(train.labels) + len(qa) == 60
+            q, a = qa[0]
+            assert len(q["features"]) == 3 and isinstance(a, float)
