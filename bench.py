@@ -7,12 +7,24 @@ the TPU epoch over the same jitted program on this host's CPU backend
 stand-in for the reference's Spark-local-CPU training until a Spark rig
 exists. >1.0 means the TPU wins.
 
-Workload: 49,152 users × 8,192 items, ~2M implicit interactions,
-rank 32 — ml-1m/ml-10m territory, sized to keep the whole bench under a
-couple of minutes including compiles. Epochs are timed as a fused
-on-device run (``EPOCHS_PER_DISPATCH`` chained in one dispatch, as real
-training runs them), so the number reflects device throughput, not
-host↔device round-trips.
+Driver-proofing: the measurement itself runs in a worker subprocess.
+Backend init on the tunneled TPU platform can raise transient
+``UNAVAILABLE`` errors (this erased round 1's perf record), so the
+orchestrator retries the worker with bounded backoff and, if the TPU
+stays down, falls back to a CPU-backend measurement — the driver always
+receives one parseable JSON line, with a structured ``error`` field on
+degraded runs instead of a traceback.
+
+Workloads:
+
+* default — 49,152 users × 8,192 items, ~2M nnz, rank 32 (ml-1m/10m
+  territory; whole bench < a couple of minutes including compiles).
+* ``--large`` / PIO_BENCH_SCALE=ml20m — 138,493 × 26,744, 20M nnz,
+  rank 32: the MovieLens-20M shape from BASELINE.md's target table.
+
+Epochs are timed as a fused on-device run (``EPOCHS_PER_DISPATCH``
+chained in one dispatch, as real training runs them), so the number
+reflects device throughput, not host↔device round-trips.
 """
 
 from __future__ import annotations
@@ -25,29 +37,47 @@ import time
 
 import numpy as np
 
-N_USERS = 49_152
-N_ITEMS = 8_192
-NNZ = 2_000_000
-RANK = 32
+WORKLOADS = {
+    # name: (n_users, n_items, nnz, rank)
+    "default": (49_152, 8_192, 2_000_000, 32),
+    "ml20m": (138_493, 26_744, 20_000_000, 32),
+}
 BLOCK_LEN = 64
 EPOCHS_PER_DISPATCH = 8
 TIMED_ROUNDS = 3
-BENCH_VERSION = "v2-bucketed"
+BENCH_VERSION = "v3-driverproof"
+
+MAX_TPU_ATTEMPTS = 4
+RETRY_BACKOFF_S = (10.0, 30.0, 60.0)  # between attempts
+WORKER_TIMEOUT_S = 1500  # one worker run (compile ~40s + epochs)
+_RETRYABLE = (
+    "UNAVAILABLE",
+    "Unable to initialize backend",
+    "DEADLINE_EXCEEDED",
+    "failed to connect",
+)
 
 _CACHE = os.path.join(os.path.dirname(__file__), ".bench_cpu_baseline.json")
 
 
-def make_data():
+def _scale() -> str:
+    if "--large" in sys.argv:
+        return "ml20m"
+    return os.environ.get("PIO_BENCH_SCALE", "default")
+
+
+def make_data(scale: str):
+    n_users, n_items, nnz, _rank = WORKLOADS[scale]
     rng = np.random.default_rng(42)
     # power-law item popularity, uniform users
-    pop = rng.zipf(1.3, NNZ) % N_ITEMS
-    rows = rng.integers(0, N_USERS, NNZ).astype(np.int32)
+    pop = rng.zipf(1.3, nnz) % n_items
+    rows = rng.integers(0, n_users, nnz).astype(np.int32)
     cols = pop.astype(np.int32)
-    vals = rng.integers(1, 6, NNZ).astype(np.float32)
+    vals = rng.integers(1, 6, nnz).astype(np.float32)
     return rows, cols, vals
 
 
-def run_epoch_bench() -> float:
+def run_epoch_bench(scale: str) -> dict:
     """Median per-epoch wall-clock of the fused alternating solve."""
     import jax
     import jax.numpy as jnp
@@ -59,30 +89,33 @@ def run_epoch_bench() -> float:
     )
     from predictionio_tpu.parallel.mesh import ComputeContext
 
+    n_users, n_items, nnz, rank = WORKLOADS[scale]
     ctx = ComputeContext.create(batch="bench")
     n_data = ctx.data_parallelism
-    rows, cols, vals = make_data()
+    rows, cols, vals = make_data(scale)
 
+    t_pack = time.perf_counter()
     user_packed = build_bucketed(
-        rows, cols, vals, N_USERS, block_len=BLOCK_LEN,
+        rows, cols, vals, n_users, block_len=BLOCK_LEN,
         row_multiple=n_data,
     )
     item_packed = build_bucketed(
-        cols, rows, vals, N_ITEMS, block_len=BLOCK_LEN,
+        cols, rows, vals, n_items, block_len=BLOCK_LEN,
         row_multiple=n_data,
     )
+    pack_seconds = time.perf_counter() - t_pack
     run = make_train_step(ctx, user_packed, item_packed, True, 1.0)
     u_slabs, u_heavy = _device_slabs(ctx, user_packed)
     i_slabs, i_heavy = _device_slabs(ctx, item_packed)
 
     rng = np.random.default_rng(7)
     y = jax.device_put(
-        (rng.normal(size=(item_packed.n_rows_padded, RANK))
-         / np.sqrt(RANK)).astype(np.float32),
+        (rng.normal(size=(item_packed.n_rows_padded, rank))
+         / np.sqrt(rank)).astype(np.float32),
         ctx.replicated,
     )
     x = jax.device_put(
-        np.zeros((user_packed.n_rows_padded, RANK), np.float32),
+        np.zeros((user_packed.n_rows_padded, rank), np.float32),
         ctx.replicated,
     )
     lam = jnp.float32(0.01)
@@ -107,12 +140,58 @@ def run_epoch_bench() -> float:
         times.append(
             (time.perf_counter() - t0) / EPOCHS_PER_DISPATCH
         )
-    return float(np.median(times))
+    return {
+        "seconds": float(np.median(times)),
+        "pack_seconds": round(pack_seconds, 3),
+        "backend": jax.default_backend(),
+        "workload": f"{n_users}x{n_items}x{nnz}@r{rank}",
+    }
 
 
-def cpu_baseline_seconds() -> float | None:
+def _worker_env(side: str, scale: str) -> dict:
+    env = dict(os.environ)
+    env["PIO_BENCH_SIDE"] = side
+    env["PIO_BENCH_SCALE"] = scale
+    if side == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+    else:
+        # let the default (TPU) platform register even if the
+        # orchestrator inherited a cpu pin from its environment
+        env.pop("JAX_PLATFORMS", None)
+    return env
+
+
+def _run_worker(side: str, scale: str, timeout: float):
+    """Run one measurement subprocess; return (result_dict, err_string)."""
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=_worker_env(side, scale),
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"{side} worker timed out after {timeout}s"
+    lines = out.stdout.strip().splitlines()
+    if out.returncode == 0 and lines:
+        try:
+            return json.loads(lines[-1]), None
+        except ValueError:
+            pass
+    tail = (out.stderr or out.stdout or "").strip().splitlines()
+    return None, " | ".join(tail[-3:]) if tail else f"rc={out.returncode}"
+
+
+def _retryable(err: str | None) -> bool:
+    return err is not None and any(tok in err for tok in _RETRYABLE)
+
+
+def cpu_baseline_seconds(scale: str) -> float | None:
     """Same program on the host CPU backend, cached across runs."""
-    key = f"{BENCH_VERSION}-{N_USERS}x{N_ITEMS}x{NNZ}x{RANK}"
+    n_users, n_items, nnz, rank = WORKLOADS[scale]
+    key = f"{BENCH_VERSION}-{n_users}x{n_items}x{nnz}x{rank}"
     try:
         with open(_CACHE) as f:
             cache = json.load(f)
@@ -120,22 +199,10 @@ def cpu_baseline_seconds() -> float | None:
             return float(cache["seconds"])
     except (OSError, ValueError):
         pass
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PIO_BENCH_SIDE"] = "cpu"
-    try:
-        out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            env=env,
-            capture_output=True,
-            text=True,
-            timeout=3600,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-        )
-        line = out.stdout.strip().splitlines()[-1]
-        seconds = float(json.loads(line)["value"])
-    except Exception:
+    result, _err = _run_worker("cpu", scale, timeout=3600)
+    if result is None:
         return None
+    seconds = float(result["seconds"])
     try:
         with open(_CACHE, "w") as f:
             json.dump({"key": key, "seconds": seconds}, f)
@@ -145,27 +212,98 @@ def cpu_baseline_seconds() -> float | None:
 
 
 def main() -> None:
-    if os.environ.get("PIO_BENCH_SIDE") == "cpu":
-        import jax
+    scale = _scale()
+    side = os.environ.get("PIO_BENCH_SIDE")
+    if side:  # worker mode: measure on the pinned backend, raw JSON out
+        if side == "cpu":
+            import jax
 
-        jax.config.update("jax_platforms", "cpu")
-        secs = run_epoch_bench()
-        print(json.dumps({"metric": "als_epoch_time_cpu", "value": secs}))
+            jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(run_epoch_bench(scale)))
         return
 
-    secs = run_epoch_bench()
-    baseline = cpu_baseline_seconds()
-    vs = (baseline / secs) if baseline else 0.0
+    # orchestrator: retry the TPU-side worker across transient backend
+    # init failures, then fall back to CPU so the driver always parses
+    # a metric line (round 1 lost its perf record to one UNAVAILABLE).
+    errors: list[str] = []
+    result = None
+    cpu_clean = None  # a worker that cleanly ran on the cpu backend
+    for attempt in range(MAX_TPU_ATTEMPTS):
+        result, err = _run_worker("tpu", scale, timeout=WORKER_TIMEOUT_S)
+        if result is not None and result.get("backend") == "cpu":
+            # the TPU plugin failed to register and JAX fell back to
+            # CPU: not a TPU number, and retrying won't change it —
+            # keep the measurement for the degraded record below
+            cpu_clean = result
+            errors.append(
+                f"attempt {attempt + 1}: tpu worker ran on cpu backend"
+            )
+            result = None
+            break
+        if result is not None:
+            break
+        errors.append(f"attempt {attempt + 1}: {err}")
+        if not _retryable(err) or attempt == MAX_TPU_ATTEMPTS - 1:
+            break
+        time.sleep(RETRY_BACKOFF_S[min(attempt, len(RETRY_BACKOFF_S) - 1)])
+
+    metric = "als_epoch_time" + ("_ml20m" if scale == "ml20m" else "")
+    if result is not None:
+        secs = float(result["seconds"])
+        baseline = cpu_baseline_seconds(scale)
+        record = {
+            "metric": metric,
+            "value": round(secs, 4),
+            "unit": "s",
+            "vs_baseline": round(baseline / secs, 2) if baseline else 0.0,
+            "extra": {
+                "backend": result.get("backend"),
+                "workload": result.get("workload"),
+                "pack_seconds": result.get("pack_seconds"),
+                "cpu_epoch_seconds": round(baseline, 4) if baseline else None,
+                "attempts": len(errors) + 1,
+            },
+        }
+        print(json.dumps(record))
+        return
+
+    # terminal TPU failure: degrade to a CPU measurement, keep rc 0,
+    # and surface the failure as structured data instead of a traceback
+    if cpu_clean is not None:
+        cpu_result, cpu_err = cpu_clean, None
+    else:
+        cpu_result, cpu_err = _run_worker("cpu", scale, timeout=3600)
+    if cpu_result is not None:
+        secs = float(cpu_result["seconds"])
+        print(
+            json.dumps(
+                {
+                    "metric": metric,
+                    "value": round(secs, 4),
+                    "unit": "s",
+                    "vs_baseline": 1.0,
+                    "degraded": "cpu-fallback",
+                    "error": errors,
+                    "extra": {
+                        "backend": "cpu",
+                        "workload": cpu_result.get("workload"),
+                    },
+                }
+            )
+        )
+        return
     print(
         json.dumps(
             {
-                "metric": "als_epoch_time",
-                "value": round(secs, 4),
+                "metric": metric,
+                "value": None,
                 "unit": "s",
-                "vs_baseline": round(vs, 2),
+                "vs_baseline": 0.0,
+                "error": errors + [f"cpu fallback: {cpu_err}"],
             }
         )
     )
+    sys.exit(1)
 
 
 if __name__ == "__main__":

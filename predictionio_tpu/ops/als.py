@@ -45,8 +45,10 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
 
-from predictionio_tpu.parallel.mesh import ComputeContext
+from predictionio_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, ComputeContext
 
 logger = logging.getLogger(__name__)
 
@@ -370,6 +372,51 @@ def _solve(a, b, cnt, yty, lam, implicit, k, dtype):
     return jnp.where(jnp.isfinite(x), x, 0.0)
 
 
+def _assemble_and_solve(
+    y, slab_arrays, heavy_arrays, heavy_owner, n_heavy_slots,
+    implicit, alpha, lam,
+):
+    """Shared one-direction solve body: slab stats → heavy scatter-add →
+    batched normal-equation solve. Used by both the replicated
+    (GSPMD-constrained) and model-sharded (shard_map) paths — the only
+    difference between them is where ``y`` comes from and how the solved
+    stats rows are reassembled into factor layout.
+    """
+    k = y.shape[1]
+    dtype = y.dtype
+    parts_a, parts_b, parts_cnt = [], [], []
+    for (idx, weights, valid) in slab_arrays:
+        a, b, cnt = _slab_stats(
+            y, idx, weights, valid, implicit, alpha, dtype
+        )
+        parts_a.append(a)
+        parts_b.append(b)
+        parts_cnt.append(cnt)
+    if n_heavy_slots:
+        parts_a.append(jnp.zeros((n_heavy_slots, k, k), dtype))
+        parts_b.append(jnp.zeros((n_heavy_slots, k), dtype))
+        parts_cnt.append(jnp.zeros((n_heavy_slots,), dtype))
+    a = jnp.concatenate(parts_a, axis=0)
+    b = jnp.concatenate(parts_b, axis=0)
+    cnt = jnp.concatenate(parts_cnt, axis=0)
+    if heavy_arrays:
+        idx, weights, valid = heavy_arrays
+        ha, hb, hcnt = _slab_stats(
+            y, idx, weights, valid, implicit, alpha, dtype
+        )
+        owner = jnp.asarray(heavy_owner)
+        # few sub-rows (head of the power law): small scatter-add
+        a = a.at[owner].add(ha)
+        b = b.at[owner].add(hb)
+        cnt = cnt.at[owner].add(hcnt)
+    yty = (
+        jnp.einsum("ik,im->km", y, y, preferred_element_type=dtype)
+        if implicit
+        else None
+    )
+    return _solve(a, b, cnt, yty, lam, implicit, k, dtype)
+
+
 def make_bucketed_solver(
     ctx: ComputeContext,
     packed: Bucketed,
@@ -393,39 +440,10 @@ def make_bucketed_solver(
     replicated = ctx.replicated
 
     def solve(y, slab_arrays, heavy_arrays, lam):
-        k = y.shape[1]
-        dtype = y.dtype
-        parts_a, parts_b, parts_cnt = [], [], []
-        for (idx, weights, valid) in slab_arrays:
-            a, b, cnt = _slab_stats(
-                y, idx, weights, valid, implicit, alpha, dtype
-            )
-            parts_a.append(a)
-            parts_b.append(b)
-            parts_cnt.append(cnt)
-        if n_heavy_slots:
-            parts_a.append(jnp.zeros((n_heavy_slots, k, k), dtype))
-            parts_b.append(jnp.zeros((n_heavy_slots, k), dtype))
-            parts_cnt.append(jnp.zeros((n_heavy_slots,), dtype))
-        a = jnp.concatenate(parts_a, axis=0)
-        b = jnp.concatenate(parts_b, axis=0)
-        cnt = jnp.concatenate(parts_cnt, axis=0)
-        if heavy_arrays is not None:
-            idx, weights, valid = heavy_arrays
-            ha, hb, hcnt = _slab_stats(
-                y, idx, weights, valid, implicit, alpha, dtype
-            )
-            owner = jnp.asarray(heavy_owner)
-            # few sub-rows (head of the power law): small scatter-add
-            a = a.at[owner].add(ha)
-            b = b.at[owner].add(hb)
-            cnt = cnt.at[owner].add(hcnt)
-        yty = (
-            jnp.einsum("ik,im->km", y, y, preferred_element_type=dtype)
-            if implicit
-            else None
+        x_stats = _assemble_and_solve(
+            y, slab_arrays, heavy_arrays, heavy_owner, n_heavy_slots,
+            implicit, alpha, lam,
         )
-        x_stats = _solve(a, b, cnt, yty, lam, implicit, k, dtype)
         x = jnp.take(x_stats, jnp.asarray(inv_perm), axis=0)
         return jax.lax.with_sharding_constraint(x, replicated)
 
@@ -491,6 +509,329 @@ def make_train_step(
 
 
 # --------------------------------------------------------------------------
+# Model-sharded training (factor matrices sharded over MODEL_AXIS)
+# --------------------------------------------------------------------------
+#
+# The reference blocks the user/item factor RDDs across the cluster
+# (examples/scala-parallel-recommendation/custom-query/src/main/scala/
+# ALSModel.scala:10-12; MLlib ALS blocks by user/item). The TPU-native
+# equivalent: factor matrices live sharded over the ``model`` mesh axis
+# (persistent HBM per device drops model_parallelism×), stats rows are
+# split over ALL devices (data×model — every chip solves normal
+# equations), and the only collectives per half-iteration are two
+# all-gathers: the opposite side's factor slices (needed for the slab
+# gather) and the solved stats rows (resharded back to factor layout).
+# An all-gather of the factor slices beats a psum of partial Gramians
+# here: it moves I·k floats instead of R·k² and doesn't duplicate the
+# Gramian einsum per model shard.
+
+
+@dataclasses.dataclass
+class ShardPlan:
+    """Device-major layout for one solve direction under shard_map.
+
+    ``shard_map`` sees each slab row-split over the combined
+    (data, model) axes, so the concatenated stats layout becomes
+    device-major: device ``i`` holds rows ``[i*c_local, (i+1)*c_local)``
+    of the all-gathered stats. ``inv_perm_dm`` re-expresses
+    :attr:`Bucketed.inv_perm` in that layout. Heavy sub-rows are
+    regrouped so every sub-row's owner slot lives on the same device
+    (``heavy_owner_local`` is a device-local stats position), which
+    keeps the heavy scatter-add device-local.
+    """
+
+    heavy: Slab | None                    # regrouped per-shard heavy slab
+    heavy_owner_local: np.ndarray | None  # [rows] int32 — local stats pos
+    inv_perm_dm: np.ndarray               # [n_rows_padded] int32
+    c_local: int                          # stats rows per device
+    n_heavy_slots_local: int              # heavy stat slots per device
+    n_shards: int
+
+
+def plan_shards(packed: Bucketed, n_shards: int) -> ShardPlan:
+    """Host-side layout planning for the model-sharded solver."""
+    rbs = [s.idx.shape[0] for s in packed.slabs]
+    per = []
+    for rb in rbs:
+        if rb % n_shards:
+            raise ValueError(
+                "slab rows not divisible by n_shards; "
+                "build_bucketed with row_multiple=n_shards"
+            )
+        per.append(rb // n_shards)
+    c_slab = int(sum(per))
+    n_slab_rows = int(sum(rbs))
+    slab_ends = np.cumsum(rbs)
+    offsets_global = np.concatenate([[0], slab_ends[:-1]])
+    local_off = np.concatenate([[0], np.cumsum(per)[:-1]]).astype(np.int64)
+    per_arr = np.asarray(per, np.int64)
+
+    heavy_out = None
+    owner_local = None
+    h_slots_per = 0
+    slot_local: dict[int, tuple[int, int]] = {}
+    heavy = packed.heavy
+    if heavy is not None:
+        real = heavy.valid.any(axis=1)
+        real_rows = np.nonzero(real)[0]
+        owners_glob = packed.heavy_owner_pos[real_rows].astype(np.int64)
+        slots, slot_counts = np.unique(owners_glob, return_counts=True)
+        # greedy balance: heaviest slot first onto the lightest shard
+        shard_sub = np.zeros(n_shards, np.int64)
+        shard_slots: list[list[int]] = [[] for _ in range(n_shards)]
+        for t in np.argsort(-slot_counts):
+            i = int(np.argmin(shard_sub))
+            shard_sub[i] += slot_counts[t]
+            shard_slots[i].append(int(slots[t]))
+        h_slots_per = max(len(s) for s in shard_slots)
+        rb_h_per = int(shard_sub.max())
+        width = heavy.idx.shape[1]
+        h_idx = np.zeros((n_shards * rb_h_per, width), np.int32)
+        h_wt = np.zeros((n_shards * rb_h_per, width), np.float32)
+        h_vd = np.zeros((n_shards * rb_h_per, width), np.float32)
+        owner_local = np.zeros(n_shards * rb_h_per, np.int32)
+        for i in range(n_shards):
+            fill = 0
+            for t_local, slot in enumerate(shard_slots[i]):
+                slot_local[slot] = (i, t_local)
+                rows_sel = real_rows[owners_glob == slot]
+                n = len(rows_sel)
+                dst = i * rb_h_per + fill
+                h_idx[dst:dst + n] = heavy.idx[rows_sel]
+                h_wt[dst:dst + n] = heavy.weights[rows_sel]
+                h_vd[dst:dst + n] = heavy.valid[rows_sel]
+                owner_local[dst:dst + n] = c_slab + t_local
+                fill += n
+        heavy_out = Slab(idx=h_idx, weights=h_wt, valid=h_vd)
+    c_local = c_slab + h_slots_per
+
+    inv = packed.inv_perm.astype(np.int64)
+    inv_dm = np.zeros_like(inv)
+    is_reg = inv < n_slab_rows
+    pos = inv[is_reg]
+    slab_of = np.searchsorted(slab_ends, pos, side="right")
+    j = pos - offsets_global[slab_of]
+    shard = j // per_arr[slab_of]
+    local = local_off[slab_of] + (j % per_arr[slab_of])
+    inv_dm[is_reg] = shard * c_local + local
+    for e in np.nonzero(~is_reg)[0]:
+        i, t_local = slot_local[int(inv[e])]
+        inv_dm[e] = i * c_local + c_slab + t_local
+    return ShardPlan(
+        heavy=heavy_out,
+        heavy_owner_local=owner_local,
+        inv_perm_dm=inv_dm.astype(np.int32),
+        c_local=c_local,
+        n_heavy_slots_local=h_slots_per,
+        n_shards=n_shards,
+    )
+
+
+@dataclasses.dataclass
+class ShardedSide:
+    """Device-staged arrays for one solve direction (sharded mode)."""
+
+    slabs: tuple            # ((idx, weights, valid), ...) — P((data,model))
+    heavy: tuple            # () or (idx, weights, valid, owner_local)
+    inv: jax.Array          # [n_rows_padded] int32 — P(model)
+    n_heavy_slots_local: int
+
+
+def stage_sharded(
+    ctx: ComputeContext, packed: Bucketed, plan: ShardPlan
+) -> ShardedSide:
+    rows_sharded = ctx.sharding((DATA_AXIS, MODEL_AXIS))
+    put = lambda a: jax.device_put(a, rows_sharded)  # noqa: E731
+    slabs = tuple(
+        (put(s.idx), put(s.weights), put(s.valid)) for s in packed.slabs
+    )
+    heavy: tuple = ()
+    if plan.heavy is not None:
+        heavy = (
+            put(plan.heavy.idx),
+            put(plan.heavy.weights),
+            put(plan.heavy.valid),
+            put(plan.heavy_owner_local),
+        )
+    inv = jax.device_put(plan.inv_perm_dm, ctx.sharding(MODEL_AXIS))
+    return ShardedSide(
+        slabs=slabs,
+        heavy=heavy,
+        inv=inv,
+        n_heavy_slots_local=plan.n_heavy_slots_local,
+    )
+
+
+def _sharded_half(
+    y_full, side_slabs, side_heavy, inv_local, n_heavy_local,
+    implicit, alpha, lam,
+):
+    """One solve direction, written per-device (shard_map body).
+
+    ``y_full`` is the all-gathered opposite factors; slab rows are this
+    device's share of the (data×model)-split stats rows. Returns this
+    device's model-shard rows of the new factor matrix. Heavy owner
+    slots are device-local stats positions by construction (ShardPlan),
+    so the scatter-add needs no collective.
+    """
+    heavy_triple = side_heavy[:3] if side_heavy else None
+    heavy_owner = side_heavy[3] if side_heavy else None
+    x_stats = _assemble_and_solve(
+        y_full, side_slabs, heavy_triple, heavy_owner, n_heavy_local,
+        implicit, alpha, lam,
+    )
+    # device-major reassembly: model (minor) then data (major) matches
+    # the P((data, model)) row split of the slabs
+    xs = lax.all_gather(x_stats, MODEL_AXIS, axis=0, tiled=True)
+    xs = lax.all_gather(xs, DATA_AXIS, axis=0, tiled=True)
+    return jnp.take(xs, inv_local, axis=0)
+
+
+def _sharded_specs(side: ShardedSide):
+    rows = P((DATA_AXIS, MODEL_AXIS), None)
+    slab_specs = tuple((rows, rows, rows) for _ in side.slabs)
+    heavy_specs: tuple = ()
+    if side.heavy:
+        heavy_specs = (rows, rows, rows, P((DATA_AXIS, MODEL_AXIS)))
+    return slab_specs, heavy_specs
+
+
+def make_sharded_train_step(
+    ctx: ComputeContext,
+    u_side: ShardedSide,
+    i_side: ShardedSide,
+    implicit: bool,
+    alpha: float,
+):
+    """Fused multi-epoch trainer with model-sharded factor matrices.
+
+    Returned fn: ``(x, y, lam, n_iters) → (x, y)`` where ``x``/``y``
+    carry sharding ``P(model)`` — each device holds a
+    ``1/model_parallelism`` row slice persistently.
+    """
+    mesh = ctx.mesh
+    u_slab_specs, u_heavy_specs = _sharded_specs(u_side)
+    i_slab_specs, i_heavy_specs = _sharded_specs(i_side)
+    u_nh = u_side.n_heavy_slots_local
+    i_nh = i_side.n_heavy_slots_local
+
+    @partial(jax.jit, static_argnames=("n_iters",))
+    def run(x, y, lam, n_iters):
+        def body(x_loc, y_loc, u_slabs, u_heavy, u_inv,
+                 i_slabs, i_heavy, i_inv, lam_):
+            def it(_, carry):
+                xl, yl = carry
+                y_full = lax.all_gather(
+                    yl, MODEL_AXIS, axis=0, tiled=True
+                )
+                xl = _sharded_half(
+                    y_full, u_slabs, u_heavy, u_inv, u_nh,
+                    implicit, alpha, lam_,
+                )
+                x_full = lax.all_gather(
+                    xl, MODEL_AXIS, axis=0, tiled=True
+                )
+                yl = _sharded_half(
+                    x_full, i_slabs, i_heavy, i_inv, i_nh,
+                    implicit, alpha, lam_,
+                )
+                return xl, yl
+
+            return lax.fori_loop(0, n_iters, it, (x_loc, y_loc))
+
+        f = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(
+                P(MODEL_AXIS, None), P(MODEL_AXIS, None),
+                u_slab_specs, u_heavy_specs, P(MODEL_AXIS),
+                i_slab_specs, i_heavy_specs, P(MODEL_AXIS),
+                P(),
+            ),
+            out_specs=(P(MODEL_AXIS, None), P(MODEL_AXIS, None)),
+            check_vma=False,
+        )
+        return f(
+            x, y, u_side.slabs, u_side.heavy, u_side.inv,
+            i_side.slabs, i_side.heavy, i_side.inv, lam,
+        )
+
+    return run
+
+
+def make_sharded_half_step(
+    ctx: ComputeContext, side: ShardedSide, implicit: bool, alpha: float
+):
+    """Single-direction sharded solve: ``(y, lam) → x`` (both P(model))."""
+    mesh = ctx.mesh
+    slab_specs, heavy_specs = _sharded_specs(side)
+    nh = side.n_heavy_slots_local
+
+    @jax.jit
+    def solve_once(y, lam):
+        def body(y_loc, slabs, heavy, inv, lam_):
+            y_full = lax.all_gather(y_loc, MODEL_AXIS, axis=0, tiled=True)
+            return _sharded_half(
+                y_full, slabs, heavy, inv, nh, implicit, alpha, lam_
+            )
+
+        f = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(
+                P(MODEL_AXIS, None), slab_specs, heavy_specs,
+                P(MODEL_AXIS), P(),
+            ),
+            out_specs=P(MODEL_AXIS, None),
+            check_vma=False,
+        )
+        return f(y, side.slabs, side.heavy, side.inv, lam)
+
+    return solve_once
+
+
+def check_factor_sharding(
+    ctx: ComputeContext,
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    n_users: int,
+    n_items: int,
+    rank: int = 8,
+    block_len: int = 8,
+) -> None:
+    """Validation probe: run one sharded training step and assert the
+    factor matrices are genuinely split over MODEL_AXIS — each device
+    holds exactly a ``1/model_parallelism`` row slice (not a replicated
+    copy). Used by the test suite and the driver's multichip dryrun.
+    """
+    n_dev = ctx.n_devices
+    up = build_bucketed(rows, cols, vals, n_users, block_len=block_len,
+                        row_multiple=n_dev)
+    ip = build_bucketed(cols, rows, vals, n_items, block_len=block_len,
+                        row_multiple=n_dev)
+    u_side = stage_sharded(ctx, up, plan_shards(up, n_dev))
+    i_side = stage_sharded(ctx, ip, plan_shards(ip, n_dev))
+    run = make_sharded_train_step(ctx, u_side, i_side, True, 1.0)
+    place = ctx.sharding(MODEL_AXIS)
+    x = jax.device_put(
+        np.zeros((up.n_rows_padded, rank), np.float32), place
+    )
+    y = jax.device_put(
+        np.ones((ip.n_rows_padded, rank), np.float32), place
+    )
+    x, y = run(x, y, jnp.float32(0.1), n_iters=1)
+    m_par = max(ctx.model_parallelism, 1)
+    for arr, n_pad in ((x, up.n_rows_padded), (y, ip.n_rows_padded)):
+        shard_rows = {s.data.shape[0] for s in arr.addressable_shards}
+        if shard_rows != {n_pad // m_par}:
+            raise AssertionError(
+                f"factors not model-sharded: shard rows {shard_rows}, "
+                f"expected {{{n_pad // m_par}}}"
+            )
+
+
+# --------------------------------------------------------------------------
 # Training loop
 # --------------------------------------------------------------------------
 
@@ -522,6 +863,7 @@ def train_als(
     checkpoint_dir: str | None = None,
     checkpoint_every: int = 0,
     resume: bool = False,
+    factor_sharding: str = "auto",
 ) -> ALSFactors:
     """Alternate user/item normal-equation solves on the mesh.
 
@@ -535,17 +877,32 @@ def train_als(
     iterations (atomic npz) and ``resume=True`` continues from the
     latest checkpoint after a restart. ``row_chunk`` is retained for
     call compatibility (the bucketed layout needs no chunked scan).
+
+    ``factor_sharding`` selects the factor-matrix layout: "replicated"
+    keeps both factor matrices replicated per device (1D data meshes);
+    "sharded" stores them split over ``MODEL_AXIS`` with stats rows
+    split over all devices (the TPU-native equivalent of the
+    reference's cluster-blocked factor RDDs, ALSModel.scala:10-12);
+    "auto" picks "sharded" whenever the mesh has a model axis > 1.
     """
     del row_chunk
-    n_data = ctx.data_parallelism
+    if factor_sharding not in ("auto", "sharded", "replicated"):
+        raise ValueError(
+            f"factor_sharding must be 'auto', 'sharded' or 'replicated', "
+            f"got {factor_sharding!r}"
+        )
+    sharded = factor_sharding == "sharded" or (
+        factor_sharding == "auto" and ctx.model_parallelism > 1
+    )
+    row_multiple = ctx.n_devices if sharded else ctx.data_parallelism
 
     user_packed = build_bucketed(
         user_ids, item_ids, values, n_users,
-        block_len=block_len, row_multiple=n_data, s_max=s_max,
+        block_len=block_len, row_multiple=row_multiple, s_max=s_max,
     )
     item_packed = build_bucketed(
         item_ids, user_ids, values, n_items,
-        block_len=block_len, row_multiple=n_data, s_max=s_max,
+        block_len=block_len, row_multiple=row_multiple, s_max=s_max,
     )
 
     # init at the logical item count (mesh-size independent), zero padding
@@ -579,30 +936,57 @@ def train_als(
         (item_packed.n_rows_padded, rank), np.asarray(init).dtype
     )
     item_factors[:n_items] = init
-    item_factors = ctx.replicate(item_factors)
-    user_factors = ctx.replicate(
-        np.zeros((user_packed.n_rows_padded, rank), np.asarray(init).dtype)
+    factor_place = ctx.sharding(MODEL_AXIS) if sharded else ctx.replicated
+    item_factors = jax.device_put(item_factors, factor_place)
+    user_factors = jax.device_put(
+        np.zeros((user_packed.n_rows_padded, rank), np.asarray(init).dtype),
+        factor_place,
     )
-
-    u_slabs, u_heavy = _device_slabs(ctx, user_packed)
-    i_slabs, i_heavy = _device_slabs(ctx, item_packed)
     lam = jnp.asarray(reg, dtype)
+
+    # jit is lazy, so constructing the half-step solvers up front costs
+    # nothing unless they are actually called (timer / edge paths)
+    if sharded:
+        u_side = stage_sharded(
+            ctx, user_packed, plan_shards(user_packed, ctx.n_devices)
+        )
+        i_side = stage_sharded(
+            ctx, item_packed, plan_shards(item_packed, ctx.n_devices)
+        )
+        solve_u_half = make_sharded_half_step(ctx, u_side, implicit, alpha)
+        solve_i_half = make_sharded_half_step(ctx, i_side, implicit, alpha)
+        _run = make_sharded_train_step(ctx, u_side, i_side, implicit, alpha)
+
+        def step(x, y, n):
+            return _run(x, y, lam, n_iters=n)
+    else:
+        u_slabs, u_heavy = _device_slabs(ctx, user_packed)
+        i_slabs, i_heavy = _device_slabs(ctx, item_packed)
+        _su = make_solve_side(ctx, user_packed, implicit, alpha)
+        _si = make_solve_side(ctx, item_packed, implicit, alpha)
+
+        def solve_u_half(y, lam_):
+            return _su(y, u_slabs, u_heavy, lam_)
+
+        def solve_i_half(x, lam_):
+            return _si(x, i_slabs, i_heavy, lam_)
+
+        _run = make_train_step(ctx, user_packed, item_packed, implicit, alpha)
+
+        def step(x, y, n):
+            return _run(
+                x, y, u_slabs, u_heavy, i_slabs, i_heavy, lam, n_iters=n
+            )
 
     ran_any = False
     if timer is not None:
         # profiling mode: dispatch each half-iteration separately
-        solve_users = make_solve_side(ctx, user_packed, implicit, alpha)
-        solve_items = make_solve_side(ctx, item_packed, implicit, alpha)
         for it in range(start_iteration, iterations):
             with timer.step("als/user_solve", sync_value=None):
-                user_factors = solve_users(
-                    item_factors, u_slabs, u_heavy, lam
-                )
+                user_factors = solve_u_half(item_factors, lam)
                 _sync_scalar(user_factors)
             with timer.step("als/item_solve", sync_value=None):
-                item_factors = solve_items(
-                    user_factors, i_slabs, i_heavy, lam
-                )
+                item_factors = solve_i_half(user_factors, lam)
                 _sync_scalar(item_factors)
             ran_any = True
             _maybe_checkpoint(
@@ -610,9 +994,6 @@ def train_als(
                 user_factors, item_factors, n_users, n_items,
             )
     else:
-        run = make_train_step(
-            ctx, user_packed, item_packed, implicit, alpha
-        )
         checkpointing = bool(ckpt_path) and checkpoint_every > 0
         chunk = (
             checkpoint_every
@@ -629,10 +1010,7 @@ def train_als(
                 n = min(chunk - it % chunk, iterations - it)
             else:
                 n = min(chunk, iterations - it)
-            user_factors, item_factors = run(
-                user_factors, item_factors,
-                u_slabs, u_heavy, i_slabs, i_heavy, lam, n_iters=n,
-            )
+            user_factors, item_factors = step(user_factors, item_factors, n)
             it += n
             ran_any = True
             _maybe_checkpoint(
@@ -648,8 +1026,7 @@ def train_als(
                 user_factors=resumed_user_factors[:n_users],
                 item_factors=np.asarray(item_factors)[:n_items],
             )
-        solve_users = make_solve_side(ctx, user_packed, implicit, alpha)
-        user_factors = solve_users(item_factors, u_slabs, u_heavy, lam)
+        user_factors = solve_u_half(item_factors, lam)
     return ALSFactors(
         user_factors=np.asarray(user_factors)[:n_users],
         item_factors=np.asarray(item_factors)[:n_items],

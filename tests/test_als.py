@@ -218,6 +218,125 @@ class TestSolveCorrectness:
         np.testing.assert_allclose(factors.item_factors[4], 0.0, atol=1e-6)
 
 
+@pytest.fixture(scope="module")
+def ctx42():
+    """2D data×model mesh — the factor-sharded training configuration."""
+    return ComputeContext.create(batch="als-2d", mesh_shape=(4, 2))
+
+
+class TestShardedFactors:
+    """Model-axis factor sharding (VERDICT r1 #2): the 2D mesh must do
+    real work in training and agree with the replicated 1-device run."""
+
+    def _data(self, heavy=False):
+        rng = np.random.default_rng(21)
+        nnz = 600
+        rows = rng.integers(0, 24, nnz).astype(np.int32)
+        cols = rng.integers(0, 18, nnz).astype(np.int32)
+        vals = rng.integers(1, 5, nnz).astype(np.float32)
+        if heavy:
+            # rows 0/1 and item 0 get degree ≫ s_max·block_len so the
+            # heavy (sub-row split) path engages in both directions
+            hr = np.concatenate([
+                np.zeros(60, np.int32), np.ones(60, np.int32)])
+            hc = np.concatenate([
+                np.arange(60, dtype=np.int32) % 18,
+                np.zeros(60, np.int32)])
+            rows = np.concatenate([rows, hr])
+            cols = np.concatenate([cols, hc])
+            vals = np.concatenate([vals, np.ones(120, np.float32)])
+        # dedupe duplicate (row, col) pairs: keep first occurrence
+        _, keep = np.unique(
+            rows.astype(np.int64) * 1000 + cols, return_index=True
+        )
+        return rows[keep], cols[keep], vals[keep]
+
+    def test_2d_mesh_matches_1device(self, ctx42, ctx1):
+        rows, cols, vals = self._data()
+        kwargs = dict(
+            n_users=24, n_items=18, rank=4, iterations=3, reg=0.1,
+            alpha=2.0, block_len=4,
+        )
+        f2d = train_als(ctx42, rows, cols, vals, **kwargs)
+        f1 = train_als(ctx1, rows, cols, vals, **kwargs)
+        np.testing.assert_allclose(
+            f2d.user_factors, f1.user_factors, rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            f2d.item_factors, f1.item_factors, rtol=1e-4, atol=1e-5
+        )
+
+    def test_sharded_heavy_rows_match(self, ctx42, ctx1):
+        rows, cols, vals = self._data(heavy=True)
+        kwargs = dict(
+            n_users=24, n_items=18, rank=4, iterations=3, reg=0.1,
+            alpha=1.0, block_len=4, s_max=2,
+        )
+        f2d = train_als(ctx42, rows, cols, vals, **kwargs)
+        f1 = train_als(ctx1, rows, cols, vals, **kwargs)
+        np.testing.assert_allclose(
+            f2d.user_factors, f1.user_factors, rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            f2d.item_factors, f1.item_factors, rtol=1e-4, atol=1e-5
+        )
+
+    def test_sharded_explicit_mode(self, ctx42, ctx1):
+        rows, cols, vals = self._data()
+        kwargs = dict(
+            n_users=24, n_items=18, rank=4, iterations=3, reg=0.1,
+            implicit=False, block_len=4,
+        )
+        f2d = train_als(ctx42, rows, cols, vals, **kwargs)
+        f1 = train_als(ctx1, rows, cols, vals, **kwargs)
+        np.testing.assert_allclose(
+            f2d.user_factors, f1.user_factors, rtol=1e-4, atol=1e-5
+        )
+
+    def test_forced_sharded_on_data_mesh(self, ctx8, ctx1):
+        """factor_sharding="sharded" also works on a pure data mesh
+        (n_shards = n_devices, model axis of size 1)."""
+        rows, cols, vals = self._data()
+        kwargs = dict(
+            n_users=24, n_items=18, rank=4, iterations=2, reg=0.1,
+            block_len=4,
+        )
+        fs = train_als(
+            ctx8, rows, cols, vals, factor_sharding="sharded", **kwargs
+        )
+        f1 = train_als(ctx1, rows, cols, vals, **kwargs)
+        np.testing.assert_allclose(
+            fs.user_factors, f1.user_factors, rtol=1e-4, atol=1e-5
+        )
+
+    def test_factors_actually_sharded_on_device(self, ctx42):
+        """The in-loop factor arrays must be split over MODEL_AXIS —
+        each device holds 1/model_parallelism of the rows (not a
+        replicated copy constrained at the end)."""
+        from predictionio_tpu.ops.als import check_factor_sharding
+
+        rows, cols, vals = self._data()
+        check_factor_sharding(
+            ctx42, rows, cols, vals, 24, 18, rank=4, block_len=4
+        )
+
+    def test_plan_shards_covers_all_nnz(self):
+        from predictionio_tpu.ops.als import build_bucketed, plan_shards
+
+        rows, cols, vals = self._data(heavy=True)
+        packed = build_bucketed(
+            rows, cols, vals, 24, block_len=4, row_multiple=8, s_max=2
+        )
+        plan = plan_shards(packed, 8)
+        total = sum(s.weights.sum() for s in packed.slabs)
+        if plan.heavy is not None:
+            total += plan.heavy.weights.sum()
+        np.testing.assert_allclose(total, vals.sum(), rtol=1e-5)
+        # inv_perm_dm is a valid permutation into the device-major layout
+        assert plan.inv_perm_dm.max() < 8 * plan.c_local
+        assert len(np.unique(plan.inv_perm_dm)) == packed.n_rows_padded
+
+
 class TestReviewRegressions:
     def test_explicit_zero_rating_counts(self, ctx8):
         """A real 0-valued rating must contribute to the normal equations
