@@ -49,7 +49,8 @@ BENCH_VERSION = "v3-driverproof"
 
 MAX_TPU_ATTEMPTS = 4
 RETRY_BACKOFF_S = (10.0, 30.0, 60.0)  # between attempts
-WORKER_TIMEOUT_S = 1500  # one worker run (compile ~40s + epochs)
+WORKER_TIMEOUT_S = 900   # one worker run (compile ~40s + epochs)
+TOTAL_TPU_BUDGET_S = 1800  # stop retrying past this (hung-tunnel guard)
 _RETRYABLE = (
     "UNAVAILABLE",
     "Unable to initialize backend",
@@ -228,8 +229,15 @@ def main() -> None:
     errors: list[str] = []
     result = None
     cpu_clean = None  # a worker that cleanly ran on the cpu backend
+    t_start = time.monotonic()
     for attempt in range(MAX_TPU_ATTEMPTS):
-        result, err = _run_worker("tpu", scale, timeout=WORKER_TIMEOUT_S)
+        remaining = TOTAL_TPU_BUDGET_S - (time.monotonic() - t_start)
+        if remaining < 60:
+            errors.append("tpu retry budget exhausted")
+            break
+        result, err = _run_worker(
+            "tpu", scale, timeout=min(WORKER_TIMEOUT_S, remaining)
+        )
         if result is not None and result.get("backend") == "cpu":
             # the TPU plugin failed to register and JAX fell back to
             # CPU: not a TPU number, and retrying won't change it —

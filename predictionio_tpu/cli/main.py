@@ -236,14 +236,28 @@ def cmd_upgrade(args) -> int:
         c.id
         for c in storage.get_meta_data_channels().get_by_app_id(app.id)
     ]
+    import pickle
+    import tempfile
+
     total = 0
     for cid in channel_ids:
         dst.init(app.id, cid)
-        # drain the source scan first: both sources may share an
-        # underlying store, and inserting into a table mid-scan over a
-        # live cursor can revisit rows
-        events = list(src.find(app.id, cid))
-        total += _batched_insert(events, dst, app.id, cid)
+        # snapshot the source scan before inserting: both sources may
+        # share an underlying store, and inserting mid-scan over a live
+        # cursor can revisit rows. Spool to disk, not RAM — a migration
+        # verb targets event stores far bigger than memory.
+        with tempfile.TemporaryFile() as spool:
+            n = 0
+            for ev in src.find(app.id, cid):
+                pickle.dump(ev, spool, protocol=pickle.HIGHEST_PROTOCOL)
+                n += 1
+            spool.seek(0)
+
+            def _replay(f=spool, count=n):
+                for _ in range(count):
+                    yield pickle.load(f)
+
+            total += _batched_insert(_replay(), dst, app.id, cid)
     print(
         f"Migrated {total} events of app {args.app_name} from "
         f"{args.from_source} to {args.to_source}."
@@ -539,6 +553,38 @@ def cmd_run(args) -> int:
     return 0
 
 
+def cmd_launch(args) -> int:
+    """Spawn N coordinated processes of a command — the multi-host
+    launch boundary (reference Runner.runOnSpark spawning spark-submit,
+    tools/Runner.scala:92-210). Children receive
+    PIO_COORDINATOR_ADDRESS / PIO_NUM_PROCESSES / PIO_PROCESS_ID and
+    should call ``predictionio_tpu.parallel.distributed.initialize()``
+    (``pio-tpu run`` and ``pio-tpu train`` do so automatically)."""
+    from predictionio_tpu.parallel.distributed import launch_processes
+
+    argv = list(args.cmd)
+    if argv and argv[0] == "--":
+        argv = argv[1:]
+    if not argv:
+        print("error: launch needs a command to run", file=sys.stderr)
+        return 1
+    if argv[0].endswith(".py") or ":" in argv[0]:
+        # convenience: a script path or module:fn target becomes a
+        # python invocation (module:fn routes through `pio-tpu run`)
+        if argv[0].endswith(".py"):
+            argv = [sys.executable] + argv
+        else:
+            argv = [
+                sys.executable, "-m", "predictionio_tpu.cli.main", "run",
+            ] + argv
+    return launch_processes(
+        argv,
+        num_processes=args.num_processes,
+        coordinator_address=args.coordinator_address,
+        timeout=args.timeout or None,
+    )
+
+
 # -- parser ----------------------------------------------------------------
 
 
@@ -685,6 +731,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch", default="run")
     p.add_argument("--mesh-shape", dest="mesh_shape")
     p.set_defaults(func=cmd_run)
+
+    p = sub.add_parser("launch")
+    p.add_argument(
+        "-n", "--num-processes", type=int, default=1,
+        help="process count (one per TPU host)",
+    )
+    p.add_argument(
+        "--coordinator-address", dest="coordinator_address",
+        help="host:port of process 0 (default: 127.0.0.1:<free port>)",
+    )
+    p.add_argument(
+        "--timeout", type=float, default=0.0,
+        help="seconds to wait for all processes (0 = no limit)",
+    )
+    p.add_argument(
+        "cmd", nargs=argparse.REMAINDER,
+        help="command to run (script.py, module:fn, or full argv after --)",
+    )
+    p.set_defaults(func=cmd_launch)
 
     return parser
 

@@ -22,6 +22,8 @@ import dataclasses
 import json
 import logging
 import math
+import os
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Generic, Sequence, TypeVar
 
 from predictionio_tpu.core.controller import params_to_json
@@ -187,17 +189,35 @@ def _engine_params_json(params: EngineParams) -> dict:
 
 
 class MetricEvaluator:
-    """Score every candidate, pick the best (MetricEvaluator.scala:215-259)."""
+    """Score every candidate, pick the best (MetricEvaluator.scala:215-259).
+
+    Candidates are evaluated concurrently (the reference's ``.par`` at
+    MetricEvaluator.scala:224): threads suffice because the heavy work
+    (train / batch-predict) runs inside XLA, which releases the GIL,
+    and FastEvalEngine's caches are single-flight thread-safe.
+    ``parallelism=1`` (or env ``PIO_EVAL_PARALLELISM=1``) forces the
+    sequential path.
+    """
 
     def __init__(
         self,
         metric: Metric,
         other_metrics: Sequence[Metric] = (),
         output_path: str | None = None,
+        parallelism: int | None = None,
     ):
         self.metric = metric
         self.other_metrics = list(other_metrics)
         self.output_path = output_path
+        self.parallelism = parallelism
+
+    def _eval_parallelism(self, n_candidates: int) -> int:
+        if self.parallelism is not None:
+            return max(1, self.parallelism)
+        env = os.environ.get("PIO_EVAL_PARALLELISM", "")
+        if env:
+            return max(1, int(env))
+        return min(4, n_candidates)
 
     def evaluate(
         self,
@@ -208,9 +228,26 @@ class MetricEvaluator:
     ) -> MetricEvaluatorResult:
         if not engine_params_list:
             raise ValueError("engine_params_list must not be empty")
+        n = len(engine_params_list)
+        workers = self._eval_parallelism(n)
+        if workers > 1 and n > 1:
+            with ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="pio-eval"
+            ) as pool:
+                eval_datas = list(
+                    pool.map(
+                        lambda p: engine.eval(ctx, p, workflow),
+                        engine_params_list,
+                    )
+                )
+        else:
+            eval_datas = [
+                engine.eval(ctx, p, workflow) for p in engine_params_list
+            ]
         scores: list[tuple[EngineParams, MetricScores]] = []
-        for i, params in enumerate(engine_params_list):
-            eval_data = engine.eval(ctx, params, workflow)
+        for i, (params, eval_data) in enumerate(
+            zip(engine_params_list, eval_datas)
+        ):
             score = MetricScores(
                 score=self.metric.calculate(eval_data),
                 other_scores=[
@@ -220,7 +257,7 @@ class MetricEvaluator:
             logger.info(
                 "candidate %d/%d: %s = %s",
                 i + 1,
-                len(engine_params_list),
+                n,
                 self.metric.header,
                 score.score,
             )
@@ -264,6 +301,12 @@ class Evaluation:
     engine_params_list: Sequence[EngineParams]
     other_metrics: Sequence[Metric] = ()
     output_path: str | None = None
+    #: memoize pipeline prefixes across candidates (run_evaluation wraps
+    #: plain Engines in FastEvalEngine); set False to force re-runs
+    fast_eval: bool = True
+    #: candidate-evaluation thread count (None → PIO_EVAL_PARALLELISM
+    #: env or min(4, n_candidates))
+    parallelism: int | None = None
 
 
 #: EngineParamsGenerator (reference EngineParamsGenerator.scala:27-43)

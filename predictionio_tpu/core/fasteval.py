@@ -13,12 +13,20 @@ Cache keys are the (name, params) tuples themselves — controller params
 are frozen dataclasses, so equality/hash is structural, which is
 exactly the reference's prefix-equality semantics
 (FastEvalEngine.scala:50-83).
+
+Caches are thread-safe with single-flight semantics: the reference
+scores candidates in parallel (MetricEvaluator.scala:224 ``.par``) and
+:class:`~predictionio_tpu.core.evaluation.MetricEvaluator` does the
+same with threads, so two candidates racing on a shared prefix must
+compute it exactly once (the loser blocks on the winner's future).
 """
 
 from __future__ import annotations
 
 import logging
-from typing import Any, Mapping, Sequence
+import threading
+from concurrent.futures import Future
+from typing import Any
 
 from predictionio_tpu.core.engine import Engine, EngineParams, WorkflowParams
 from predictionio_tpu.parallel.mesh import ComputeContext
@@ -40,10 +48,11 @@ class FastEvalEngine(Engine):
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
-        self._data_source_cache: dict[Any, Any] = {}
-        self._preparator_cache: dict[Any, Any] = {}
-        self._algorithms_cache: dict[Any, Any] = {}
-        self._predict_cache: dict[Any, Any] = {}
+        self._lock = threading.Lock()
+        self._data_source_cache: dict[Any, Future] = {}
+        self._preparator_cache: dict[Any, Future] = {}
+        self._algorithms_cache: dict[Any, Future] = {}
+        self._predict_cache: dict[Any, Future] = {}
         self.cache_hits = {
             "data_source": 0,
             "preparator": 0,
@@ -51,45 +60,73 @@ class FastEvalEngine(Engine):
             "predict": 0,
         }
 
+    @classmethod
+    def from_engine(cls, engine: Engine) -> "FastEvalEngine":
+        """Wrap a plain Engine's component maps in a fresh FastEval
+        instance (used by ``run_evaluation`` to memoize by default)."""
+        return cls(
+            engine.data_source_classes,
+            engine.preparator_classes,
+            engine.algorithm_classes,
+            engine.serving_classes,
+        )
+
+    def _memo(self, cache: dict, key, hit_name: str, compute):
+        """Single-flight memoization: first caller computes, concurrent
+        callers for the same key block on its future; failures are not
+        cached (a transient error should not poison the sweep)."""
+        with self._lock:
+            fut = cache.get(key)
+            if fut is None:
+                fut = Future()
+                cache[key] = fut
+                owner = True
+            else:
+                self.cache_hits[hit_name] += 1
+                owner = False
+        if owner:
+            try:
+                fut.set_result(compute())
+            except BaseException as exc:
+                with self._lock:
+                    cache.pop(key, None)
+                fut.set_exception(exc)
+                raise
+        return fut.result()
+
     def _folds(self, ctx, params: EngineParams):
-        key = ("ds", params.data_source)
-        if key not in self._data_source_cache:
-            self._data_source_cache[key] = self.make_data_source(
-                params
-            ).read_eval(ctx)
-        else:
-            self.cache_hits["data_source"] += 1
-        return self._data_source_cache[key]
+        return self._memo(
+            self._data_source_cache,
+            ("ds", params.data_source),
+            "data_source",
+            lambda: self.make_data_source(params).read_eval(ctx),
+        )
 
     def _prepared(self, ctx, params: EngineParams, fold: int):
-        key = ("prep", params.data_source, params.preparator, fold)
-        if key not in self._preparator_cache:
-            td = self._folds(ctx, params)[fold][0]
-            self._preparator_cache[key] = self.make_preparator(
-                params
-            ).prepare(ctx, td)
-        else:
-            self.cache_hits["preparator"] += 1
-        return self._preparator_cache[key]
+        return self._memo(
+            self._preparator_cache,
+            ("prep", params.data_source, params.preparator, fold),
+            "preparator",
+            lambda: self.make_preparator(params).prepare(
+                ctx, self._folds(ctx, params)[fold][0]
+            ),
+        )
 
     def _model(self, ctx, params: EngineParams, algo_pair, fold: int):
-        key = (
-            "algo",
-            params.data_source,
-            params.preparator,
-            algo_pair,
-            fold,
-        )
-        if key not in self._algorithms_cache:
+        def compute():
             name, p = algo_pair
             algo = self._one(self.algorithm_classes, name, "algorithm")(p)
-            self._algorithms_cache[key] = (
+            return (
                 algo,
                 algo.train(ctx, self._prepared(ctx, params, fold)),
             )
-        else:
-            self.cache_hits["algorithms"] += 1
-        return self._algorithms_cache[key]
+
+        return self._memo(
+            self._algorithms_cache,
+            ("algo", params.data_source, params.preparator, algo_pair, fold),
+            "algorithms",
+            compute,
+        )
 
     def _predictions(
         self, ctx, params: EngineParams, algo_pair, fold: int, queries
@@ -97,22 +134,23 @@ class FastEvalEngine(Engine):
         # serving is part of the key: supplement() may rewrite queries
         # (stricter than the reference's AlgorithmsPrefix, which assumes
         # identity supplement at eval time)
-        key = (
-            "pred",
-            params.data_source,
-            params.preparator,
-            algo_pair,
-            params.serving,
-            fold,
-        )
-        if key not in self._predict_cache:
+        def compute():
             algo, model = self._model(ctx, params, algo_pair, fold)
-            self._predict_cache[key] = list(
-                algo.batch_predict(model, queries)
-            )
-        else:
-            self.cache_hits["predict"] += 1
-        return self._predict_cache[key]
+            return list(algo.batch_predict(model, queries))
+
+        return self._memo(
+            self._predict_cache,
+            (
+                "pred",
+                params.data_source,
+                params.preparator,
+                algo_pair,
+                params.serving,
+                fold,
+            ),
+            "predict",
+            compute,
+        )
 
     def eval(
         self,

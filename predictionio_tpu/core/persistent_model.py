@@ -1,0 +1,171 @@
+"""MANUAL-persistence reference implementation.
+
+Capability parity with the reference's
+``LocalFileSystemPersistentModel`` (controller/
+LocalFileSystemPersistentModel.scala:40-74): an out-of-the-box
+``PersistentModel`` so MANUAL-mode algorithms don't have to hand-roll
+``save_model``/``load_model``. The reference java-serializes the model
+under ``PIO_FS_TMPDIR`` keyed by instance id; here the model is split
+into
+
+* **array state** — numpy / jax array fields, written as an orbax
+  checkpoint (the TPU-native replacement for Kryo blobs: sharded
+  ``jax.Array``s are written per-shard without a host gather, which is
+  what makes MANUAL mode usable for model-sharded factor matrices), and
+* **aux skeleton** — everything else (BiMaps, params, plain fields),
+  pickled.
+
+Use as a mixin on an :class:`~predictionio_tpu.core.controller.Algorithm`::
+
+    class MyAlgo(LocalFileSystemPersistentModel, Algorithm):
+        ...
+
+and the algorithm gets ``persistence_mode=MANUAL`` with working
+``save_model``/``load_model`` for dataclass / dict / pure-array models.
+Storage root: ``$PIO_FS_BASEDIR/pmodels/<AlgoClass>/<instance_id>/``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import pickle
+import shutil
+from typing import Any
+
+import jax
+import numpy as np
+
+from predictionio_tpu.core.controller import PersistenceMode
+
+logger = logging.getLogger(__name__)
+
+_AUX_FILE = "aux.pkl"
+_STATE_DIR = "state"
+_ARRAY_KINDS = (np.ndarray, jax.Array)
+
+
+def _base_dir() -> str:
+    return os.environ.get(
+        "PIO_FS_BASEDIR", os.path.join(os.path.expanduser("~"), ".piotpu")
+    )
+
+
+def _sync_checkpointer():
+    """A synchronous orbax checkpointer (the default StandardCheckpointer
+    commits in a background thread, which can outlive short-lived
+    processes — MANUAL save must be durable when save_model returns)."""
+    import orbax.checkpoint as ocp
+
+    return ocp.Checkpointer(ocp.StandardCheckpointHandler())
+
+
+def _split_model(model: Any) -> tuple[dict[str, Any], Any]:
+    """Split a model into (array fields, picklable skeleton).
+
+    Dataclasses and dicts are decomposed one level deep — array-valued
+    entries go to the orbax state, the rest stays in the skeleton with
+    a ``None`` placeholder. Anything else is treated as pure aux.
+    """
+    if dataclasses.is_dataclass(model) and not isinstance(model, type):
+        arrays = {
+            f.name: getattr(model, f.name)
+            for f in dataclasses.fields(model)
+            if isinstance(getattr(model, f.name), _ARRAY_KINDS)
+        }
+        skeleton = dataclasses.replace(
+            model, **{k: None for k in arrays}
+        )
+        return arrays, skeleton
+    if isinstance(model, dict):
+        arrays = {
+            k: v for k, v in model.items()
+            if isinstance(k, str) and isinstance(v, _ARRAY_KINDS)
+        }
+        skeleton = {k: v for k, v in model.items() if k not in arrays}
+        return arrays, skeleton
+    if isinstance(model, _ARRAY_KINDS):
+        return {"__model__": model}, None
+    return {}, model
+
+
+def _join_model(arrays: dict[str, Any], skeleton: Any) -> Any:
+    if "__model__" in arrays and skeleton is None:
+        return arrays["__model__"]
+    if dataclasses.is_dataclass(skeleton) and not isinstance(skeleton, type):
+        return dataclasses.replace(skeleton, **arrays)
+    if isinstance(skeleton, dict):
+        return {**skeleton, **arrays}
+    return skeleton
+
+
+def save_persistent_model(
+    directory: str, model: Any, overwrite: bool = True
+) -> str:
+    """Write a model split into orbax array state + pickled skeleton."""
+    directory = os.path.abspath(directory)
+    if overwrite and os.path.exists(directory):
+        shutil.rmtree(directory)
+    os.makedirs(directory, exist_ok=True)
+    arrays, skeleton = _split_model(model)
+    if arrays:
+        with _sync_checkpointer() as ckptr:
+            ckptr.save(os.path.join(directory, _STATE_DIR), arrays)
+    tmp = os.path.join(directory, _AUX_FILE + ".tmp")
+    with open(tmp, "wb") as f:
+        pickle.dump(
+            {"skeleton": skeleton, "array_keys": sorted(arrays)},
+            f,
+            protocol=pickle.HIGHEST_PROTOCOL,
+        )
+    os.replace(tmp, os.path.join(directory, _AUX_FILE))
+    logger.info(
+        "persistent model saved to %s (%d array field(s))",
+        directory,
+        len(arrays),
+    )
+    return directory
+
+
+def load_persistent_model(directory: str) -> Any:
+    directory = os.path.abspath(directory)
+    aux_path = os.path.join(directory, _AUX_FILE)
+    if not os.path.exists(aux_path):
+        raise FileNotFoundError(
+            f"no persistent model at {directory} (missing {_AUX_FILE})"
+        )
+    with open(aux_path, "rb") as f:
+        aux = pickle.load(f)
+    arrays: dict[str, Any] = {}
+    if aux["array_keys"]:
+        with _sync_checkpointer() as ckptr:
+            state = ckptr.restore(os.path.join(directory, _STATE_DIR))
+        arrays = {k: np.asarray(state[k]) for k in aux["array_keys"]}
+    return _join_model(arrays, aux["skeleton"])
+
+
+class LocalFileSystemPersistentModel:
+    """Algorithm mixin: MANUAL persistence to the local filesystem.
+
+    Equivalent of the reference's LocalFileSystemPersistentModel +
+    PersistentModelLoader pair (LocalFileSystemPersistentModel.scala:
+    40-74) — subclassing it is all an algorithm needs for MANUAL mode.
+    """
+
+    persistence_mode = PersistenceMode.MANUAL
+
+    def persistent_model_dir(self, instance_id: str) -> str:
+        return os.path.join(
+            _base_dir(), "pmodels", type(self).__name__, instance_id
+        )
+
+    def save_model(self, instance_id: str, model: Any) -> None:
+        save_persistent_model(
+            self.persistent_model_dir(instance_id), model
+        )
+
+    def load_model(self, instance_id: str, ctx: Any) -> Any:
+        return load_persistent_model(
+            self.persistent_model_dir(instance_id)
+        )

@@ -138,6 +138,7 @@ def run_evaluation(
     workflow/CoreWorkflow.scala:100-157): EvaluationInstance INIT →
     EVALCOMPLETED with one-liner / HTML / JSON results persisted."""
     from predictionio_tpu.core.evaluation import MetricEvaluator
+    from predictionio_tpu.core.fasteval import FastEvalEngine
     from predictionio_tpu.data.storage import EvaluationInstance
 
     workflow = workflow or WorkflowParams()
@@ -156,13 +157,24 @@ def run_evaluation(
     instance = instances.get(instance_id)
     ctx = ctx or ComputeContext.create(batch=batch or "evaluation")
     try:
+        # memoize pipeline prefixes by default so a grid sweep reads /
+        # prepares / trains each distinct prefix once (reference wires
+        # FastEvalEngine the same way for tuning); only wrap plain
+        # Engines — a subclass may override eval() with custom logic
+        engine = evaluation.engine
+        if (
+            getattr(evaluation, "fast_eval", True)
+            and type(engine) is Engine
+        ):
+            engine = FastEvalEngine.from_engine(engine)
         evaluator = MetricEvaluator(
             metric=evaluation.metric,
             other_metrics=evaluation.other_metrics,
             output_path=evaluation.output_path,
+            parallelism=getattr(evaluation, "parallelism", None),
         )
         result = evaluator.evaluate(
-            ctx, evaluation.engine, evaluation.engine_params_list, workflow
+            ctx, engine, evaluation.engine_params_list, workflow
         )
     except Exception:
         instances.update(

@@ -1,12 +1,11 @@
 """SQLite storage backend — the durable zero-dependency default.
 
-Plays the role of the reference's JDBC backend
-(``data/.../storage/jdbc/*.scala``, 1,332 LoC: scalikejdbc against
-PostgreSQL/MySQL) using Python's stdlib ``sqlite3``. Like the reference's
-``JDBCLEvents`` it keeps one event table per (app, channel)
-(``JDBCLEvents.scala`` table name ``<namespace>_<appId>[_<channelId>]``),
-indexed by event time for time-range scans, and stores all seven metadata
-DAO tables plus the model blob store in the same file.
+Plays the role of the reference's JDBC backend for dev/single-host use
+(``data/.../storage/jdbc/*.scala``: scalikejdbc against
+PostgreSQL/MySQL) using Python's stdlib ``sqlite3``. All DAO logic
+lives in :mod:`predictionio_tpu.data.storage.sql_common`, shared with
+the networked :mod:`~predictionio_tpu.data.storage.postgres` backend —
+this module only supplies the sqlite dialect and connection handling.
 
 Thread-safety: one connection per thread via ``threading.local`` (sqlite
 connections are not shareable across threads); WAL mode so the event
@@ -15,705 +14,79 @@ server's concurrent reader/writer threads do not serialize on the file.
 
 from __future__ import annotations
 
-import datetime as _dt
-import json
 import os
 import sqlite3
-import threading
-import uuid
-from typing import Iterator, Sequence
+from typing import Any, Sequence
 
-from predictionio_tpu.data.datamap import DataMap
-from predictionio_tpu.data.event import Event
-from predictionio_tpu.data.storage.base import (
-    AccessKey,
-    AccessKeysBackend,
-    App,
-    AppsBackend,
-    Channel,
-    ChannelsBackend,
-    EngineInstance,
-    EngineInstancesBackend,
-    EngineManifest,
-    EngineManifestsBackend,
-    EvaluationInstance,
-    EvaluationInstancesBackend,
-    EventsBackend,
-    Model,
-    ModelsBackend,
+from predictionio_tpu.data.storage.sql_common import (
+    SQLAccessKeys,
+    SQLApps,
+    SQLChannels,
+    SQLClient,
+    SQLDialect,
+    SQLEngineInstances,
+    SQLEngineManifests,
+    SQLEvaluationInstances,
+    SQLEvents,
+    SQLModels,
 )
 
 
-def _iso(t: _dt.datetime) -> str:
-    # Naive datetimes are UTC by convention (same rule as Event.__post_init__)
-    if t.tzinfo is None:
-        t = t.replace(tzinfo=_dt.timezone.utc)
-    return t.astimezone(_dt.timezone.utc).isoformat()
+class SQLiteDialect(SQLDialect):
+    placeholder = "?"
+    autoinc_pk = "INTEGER PRIMARY KEY AUTOINCREMENT"
+    blob_type = "BLOB"
+    integrity_errors = (sqlite3.IntegrityError,)
+    operational_errors = (sqlite3.OperationalError,)
+
+    def upsert(self, table: str, cols: Sequence[str],
+               pk: Sequence[str]) -> str:
+        return (
+            f"INSERT OR REPLACE INTO {table} ({','.join(cols)}) "
+            f"VALUES ({','.join('?' * len(cols))})"
+        )
+
+    def insert_autoinc(self, cur, table: str, cols: Sequence[str],
+                       values: Sequence[Any]) -> int:
+        cur.execute(
+            f"INSERT INTO {table} ({','.join(cols)}) "
+            f"VALUES ({','.join('?' * len(cols))})",
+            tuple(values),
+        )
+        return cur.lastrowid
 
 
-def _from_iso(s: str) -> _dt.datetime:
-    return _dt.datetime.fromisoformat(s)
-
-
-class SQLiteClient:
+class SQLiteClient(SQLClient):
     """Shared connection manager for all DAOs of one storage source."""
 
     def __init__(self, config: dict | None = None):
+        super().__init__()
+        self.dialect = SQLiteDialect()
         config = config or {}
         path = config.get("PATH") or config.get(
             "URL", os.path.join(os.getcwd(), "pio.sqlite")
         )
         if path != ":memory:":
-            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            os.makedirs(
+                os.path.dirname(os.path.abspath(path)), exist_ok=True
+            )
         self.path = path
-        self._local = threading.local()
-        self._init_lock = threading.Lock()
-        self._ensure_schema()
+        self.ensure_metadata_schema()
 
-    @property
-    def conn(self) -> sqlite3.Connection:
-        conn = getattr(self._local, "conn", None)
-        if conn is None:
-            conn = sqlite3.connect(self.path, timeout=30.0)
-            conn.execute("PRAGMA journal_mode=WAL")
-            conn.execute("PRAGMA synchronous=NORMAL")
-            self._local.conn = conn
+    def _connect(self) -> sqlite3.Connection:
+        conn = sqlite3.connect(self.path, timeout=30.0)
+        conn.execute("PRAGMA journal_mode=WAL")
+        conn.execute("PRAGMA synchronous=NORMAL")
         return conn
 
-    def _ensure_schema(self) -> None:
-        with self._init_lock, self.conn as c:
-            c.executescript(
-                """
-                CREATE TABLE IF NOT EXISTS apps (
-                  id INTEGER PRIMARY KEY AUTOINCREMENT,
-                  name TEXT UNIQUE NOT NULL,
-                  description TEXT);
-                CREATE TABLE IF NOT EXISTS access_keys (
-                  key TEXT PRIMARY KEY,
-                  appid INTEGER NOT NULL,
-                  events TEXT NOT NULL);
-                CREATE TABLE IF NOT EXISTS channels (
-                  id INTEGER PRIMARY KEY AUTOINCREMENT,
-                  name TEXT NOT NULL,
-                  appid INTEGER NOT NULL,
-                  UNIQUE(name, appid));
-                CREATE TABLE IF NOT EXISTS engine_instances (
-                  id TEXT PRIMARY KEY,
-                  status TEXT, start_time TEXT, end_time TEXT,
-                  engine_id TEXT, engine_version TEXT, engine_variant TEXT,
-                  engine_factory TEXT, batch TEXT, env TEXT, mesh_conf TEXT,
-                  data_source_params TEXT, preparator_params TEXT,
-                  algorithms_params TEXT, serving_params TEXT);
-                CREATE TABLE IF NOT EXISTS evaluation_instances (
-                  id TEXT PRIMARY KEY,
-                  status TEXT, start_time TEXT, end_time TEXT,
-                  evaluation_class TEXT, engine_params_generator_class TEXT,
-                  batch TEXT, env TEXT, evaluator_results TEXT,
-                  evaluator_results_html TEXT, evaluator_results_json TEXT);
-                CREATE TABLE IF NOT EXISTS engine_manifests (
-                  id TEXT NOT NULL,
-                  version TEXT NOT NULL,
-                  name TEXT NOT NULL,
-                  description TEXT,
-                  files TEXT NOT NULL,
-                  engine_factory TEXT NOT NULL,
-                  PRIMARY KEY (id, version));
-                CREATE TABLE IF NOT EXISTS models (
-                  id TEXT PRIMARY KEY,
-                  models BLOB NOT NULL);
-                """
-            )
 
-    def event_table(self, app_id: int, channel_id: int | None) -> str:
-        # Reference JDBC table naming: <namespace>_<appId>[_<channelId>]
-        return f"events_{app_id}" + (
-            f"_{channel_id}" if channel_id is not None else ""
-        )
-
-
-class SQLiteApps(AppsBackend):
-    def __init__(self, client: SQLiteClient):
-        self._c = client
-
-    def insert(self, app: App) -> int | None:
-        try:
-            with self._c.conn as c:
-                if app.id > 0:
-                    c.execute(
-                        "INSERT INTO apps (id, name, description) VALUES (?,?,?)",
-                        (app.id, app.name, app.description),
-                    )
-                    return app.id
-                cur = c.execute(
-                    "INSERT INTO apps (name, description) VALUES (?,?)",
-                    (app.name, app.description),
-                )
-                return cur.lastrowid
-        except sqlite3.IntegrityError:
-            return None
-
-    def _row(self, r) -> App:
-        return App(id=r[0], name=r[1], description=r[2])
-
-    def get(self, app_id: int) -> App | None:
-        r = self._c.conn.execute(
-            "SELECT id, name, description FROM apps WHERE id=?", (app_id,)
-        ).fetchone()
-        return self._row(r) if r else None
-
-    def get_by_name(self, name: str) -> App | None:
-        r = self._c.conn.execute(
-            "SELECT id, name, description FROM apps WHERE name=?", (name,)
-        ).fetchone()
-        return self._row(r) if r else None
-
-    def get_all(self) -> list[App]:
-        rows = self._c.conn.execute(
-            "SELECT id, name, description FROM apps ORDER BY id"
-        ).fetchall()
-        return [self._row(r) for r in rows]
-
-    def update(self, app: App) -> bool:
-        with self._c.conn as c:
-            cur = c.execute(
-                "UPDATE apps SET name=?, description=? WHERE id=?",
-                (app.name, app.description, app.id),
-            )
-            return cur.rowcount > 0
-
-    def delete(self, app_id: int) -> bool:
-        with self._c.conn as c:
-            return c.execute(
-                "DELETE FROM apps WHERE id=?", (app_id,)
-            ).rowcount > 0
-
-
-class SQLiteAccessKeys(AccessKeysBackend):
-    def __init__(self, client: SQLiteClient):
-        self._c = client
-
-    def insert(self, access_key: AccessKey) -> str | None:
-        key = access_key.key or self.generate_key()
-        try:
-            with self._c.conn as c:
-                c.execute(
-                    "INSERT INTO access_keys (key, appid, events) VALUES (?,?,?)",
-                    (key, access_key.appid, json.dumps(list(access_key.events))),
-                )
-            return key
-        except sqlite3.IntegrityError:
-            return None
-
-    def _row(self, r) -> AccessKey:
-        return AccessKey(key=r[0], appid=r[1], events=tuple(json.loads(r[2])))
-
-    def get(self, key: str) -> AccessKey | None:
-        r = self._c.conn.execute(
-            "SELECT key, appid, events FROM access_keys WHERE key=?", (key,)
-        ).fetchone()
-        return self._row(r) if r else None
-
-    def get_all(self) -> list[AccessKey]:
-        return [
-            self._row(r)
-            for r in self._c.conn.execute(
-                "SELECT key, appid, events FROM access_keys"
-            ).fetchall()
-        ]
-
-    def get_by_app_id(self, app_id: int) -> list[AccessKey]:
-        return [
-            self._row(r)
-            for r in self._c.conn.execute(
-                "SELECT key, appid, events FROM access_keys WHERE appid=?",
-                (app_id,),
-            ).fetchall()
-        ]
-
-    def update(self, access_key: AccessKey) -> bool:
-        with self._c.conn as c:
-            cur = c.execute(
-                "UPDATE access_keys SET appid=?, events=? WHERE key=?",
-                (
-                    access_key.appid,
-                    json.dumps(list(access_key.events)),
-                    access_key.key,
-                ),
-            )
-            return cur.rowcount > 0
-
-    def delete(self, key: str) -> bool:
-        with self._c.conn as c:
-            return c.execute(
-                "DELETE FROM access_keys WHERE key=?", (key,)
-            ).rowcount > 0
-
-
-class SQLiteChannels(ChannelsBackend):
-    def __init__(self, client: SQLiteClient):
-        self._c = client
-
-    def insert(self, channel: Channel) -> int | None:
-        if not Channel.is_valid_name(channel.name):
-            return None
-        try:
-            with self._c.conn as c:
-                if channel.id > 0:
-                    c.execute(
-                        "INSERT INTO channels (id, name, appid) VALUES (?,?,?)",
-                        (channel.id, channel.name, channel.appid),
-                    )
-                    return channel.id
-                cur = c.execute(
-                    "INSERT INTO channels (name, appid) VALUES (?,?)",
-                    (channel.name, channel.appid),
-                )
-                return cur.lastrowid
-        except sqlite3.IntegrityError:
-            return None
-
-    def get(self, channel_id: int) -> Channel | None:
-        r = self._c.conn.execute(
-            "SELECT id, name, appid FROM channels WHERE id=?", (channel_id,)
-        ).fetchone()
-        return Channel(id=r[0], name=r[1], appid=r[2]) if r else None
-
-    def get_by_app_id(self, app_id: int) -> list[Channel]:
-        return [
-            Channel(id=r[0], name=r[1], appid=r[2])
-            for r in self._c.conn.execute(
-                "SELECT id, name, appid FROM channels WHERE appid=?",
-                (app_id,),
-            ).fetchall()
-        ]
-
-    def delete(self, channel_id: int) -> bool:
-        with self._c.conn as c:
-            return c.execute(
-                "DELETE FROM channels WHERE id=?", (channel_id,)
-            ).rowcount > 0
-
-
-_EI_COLS = (
-    "id status start_time end_time engine_id engine_version engine_variant "
-    "engine_factory batch env mesh_conf data_source_params preparator_params "
-    "algorithms_params serving_params"
-).split()
-
-
-class SQLiteEngineInstances(EngineInstancesBackend):
-    def __init__(self, client: SQLiteClient):
-        self._c = client
-
-    def _to_row(self, i: EngineInstance):
-        return (
-            i.id, i.status, _iso(i.start_time), _iso(i.end_time),
-            i.engine_id, i.engine_version, i.engine_variant,
-            i.engine_factory, i.batch, json.dumps(i.env),
-            json.dumps(i.mesh_conf), i.data_source_params,
-            i.preparator_params, i.algorithms_params, i.serving_params,
-        )
-
-    def _from_row(self, r) -> EngineInstance:
-        return EngineInstance(
-            id=r[0], status=r[1],
-            start_time=_from_iso(r[2]), end_time=_from_iso(r[3]),
-            engine_id=r[4], engine_version=r[5], engine_variant=r[6],
-            engine_factory=r[7], batch=r[8], env=json.loads(r[9]),
-            mesh_conf=json.loads(r[10]), data_source_params=r[11],
-            preparator_params=r[12], algorithms_params=r[13],
-            serving_params=r[14],
-        )
-
-    def insert(self, instance: EngineInstance) -> str:
-        iid = instance.id or uuid.uuid4().hex
-        row = (iid,) + self._to_row(instance)[1:]
-        with self._c.conn as c:
-            c.execute(
-                f"INSERT OR REPLACE INTO engine_instances "
-                f"({','.join(_EI_COLS)}) VALUES ({','.join('?' * len(_EI_COLS))})",
-                row,
-            )
-        return iid
-
-    def get(self, instance_id: str) -> EngineInstance | None:
-        r = self._c.conn.execute(
-            f"SELECT {','.join(_EI_COLS)} FROM engine_instances WHERE id=?",
-            (instance_id,),
-        ).fetchone()
-        return self._from_row(r) if r else None
-
-    def get_all(self) -> list[EngineInstance]:
-        return [
-            self._from_row(r)
-            for r in self._c.conn.execute(
-                f"SELECT {','.join(_EI_COLS)} FROM engine_instances"
-            ).fetchall()
-        ]
-
-    def get_completed(
-        self, engine_id: str, engine_version: str, engine_variant: str
-    ) -> list[EngineInstance]:
-        rows = self._c.conn.execute(
-            f"SELECT {','.join(_EI_COLS)} FROM engine_instances "
-            "WHERE status='COMPLETED' AND engine_id=? AND engine_version=? "
-            "AND engine_variant=? ORDER BY start_time DESC",
-            (engine_id, engine_version, engine_variant),
-        ).fetchall()
-        return [self._from_row(r) for r in rows]
-
-    def get_latest_completed(
-        self, engine_id: str, engine_version: str, engine_variant: str
-    ) -> EngineInstance | None:
-        completed = self.get_completed(
-            engine_id, engine_version, engine_variant
-        )
-        return completed[0] if completed else None
-
-    def update(self, instance: EngineInstance) -> bool:
-        sets = ",".join(f"{c}=?" for c in _EI_COLS[1:])
-        with self._c.conn as c:
-            cur = c.execute(
-                f"UPDATE engine_instances SET {sets} WHERE id=?",
-                self._to_row(instance)[1:] + (instance.id,),
-            )
-            return cur.rowcount > 0
-
-    def delete(self, instance_id: str) -> bool:
-        with self._c.conn as c:
-            return c.execute(
-                "DELETE FROM engine_instances WHERE id=?", (instance_id,)
-            ).rowcount > 0
-
-
-_EM_COLS = "id version name description files engine_factory".split()
-
-
-class SQLiteEngineManifests(EngineManifestsBackend):
-    def __init__(self, client: SQLiteClient):
-        self._c = client
-
-    def _from_row(self, r) -> EngineManifest:
-        return EngineManifest(
-            id=r[0], version=r[1], name=r[2], description=r[3],
-            files=tuple(json.loads(r[4])), engine_factory=r[5],
-        )
-
-    def insert(self, manifest: EngineManifest) -> None:
-        with self._c.conn as c:
-            c.execute(
-                f"INSERT OR REPLACE INTO engine_manifests "
-                f"({','.join(_EM_COLS)}) VALUES (?,?,?,?,?,?)",
-                (
-                    manifest.id, manifest.version, manifest.name,
-                    manifest.description, json.dumps(list(manifest.files)),
-                    manifest.engine_factory,
-                ),
-            )
-
-    def get(self, manifest_id: str, version: str) -> EngineManifest | None:
-        row = self._c.conn.execute(
-            f"SELECT {','.join(_EM_COLS)} FROM engine_manifests "
-            "WHERE id=? AND version=?",
-            (manifest_id, version),
-        ).fetchone()
-        return self._from_row(row) if row else None
-
-    def get_all(self) -> list[EngineManifest]:
-        rows = self._c.conn.execute(
-            f"SELECT {','.join(_EM_COLS)} FROM engine_manifests"
-        ).fetchall()
-        return [self._from_row(r) for r in rows]
-
-    def update(self, manifest: EngineManifest, upsert: bool = False) -> None:
-        if not upsert and self.get(manifest.id, manifest.version) is None:
-            raise KeyError(
-                f"engine manifest ({manifest.id}, {manifest.version}) "
-                "not found"
-            )
-        self.insert(manifest)
-
-    def delete(self, manifest_id: str, version: str) -> bool:
-        with self._c.conn as c:
-            return c.execute(
-                "DELETE FROM engine_manifests WHERE id=? AND version=?",
-                (manifest_id, version),
-            ).rowcount > 0
-
-
-_EVI_COLS = (
-    "id status start_time end_time evaluation_class "
-    "engine_params_generator_class batch env evaluator_results "
-    "evaluator_results_html evaluator_results_json"
-).split()
-
-
-class SQLiteEvaluationInstances(EvaluationInstancesBackend):
-    def __init__(self, client: SQLiteClient):
-        self._c = client
-
-    def _to_row(self, i: EvaluationInstance):
-        return (
-            i.id, i.status, _iso(i.start_time), _iso(i.end_time),
-            i.evaluation_class, i.engine_params_generator_class, i.batch,
-            json.dumps(i.env), i.evaluator_results,
-            i.evaluator_results_html, i.evaluator_results_json,
-        )
-
-    def _from_row(self, r) -> EvaluationInstance:
-        return EvaluationInstance(
-            id=r[0], status=r[1],
-            start_time=_from_iso(r[2]), end_time=_from_iso(r[3]),
-            evaluation_class=r[4], engine_params_generator_class=r[5],
-            batch=r[6], env=json.loads(r[7]), evaluator_results=r[8],
-            evaluator_results_html=r[9], evaluator_results_json=r[10],
-        )
-
-    def insert(self, instance: EvaluationInstance) -> str:
-        iid = instance.id or uuid.uuid4().hex
-        row = (iid,) + self._to_row(instance)[1:]
-        with self._c.conn as c:
-            c.execute(
-                f"INSERT OR REPLACE INTO evaluation_instances "
-                f"({','.join(_EVI_COLS)}) VALUES ({','.join('?' * len(_EVI_COLS))})",
-                row,
-            )
-        return iid
-
-    def get(self, instance_id: str) -> EvaluationInstance | None:
-        r = self._c.conn.execute(
-            f"SELECT {','.join(_EVI_COLS)} FROM evaluation_instances WHERE id=?",
-            (instance_id,),
-        ).fetchone()
-        return self._from_row(r) if r else None
-
-    def get_all(self) -> list[EvaluationInstance]:
-        return [
-            self._from_row(r)
-            for r in self._c.conn.execute(
-                f"SELECT {','.join(_EVI_COLS)} FROM evaluation_instances"
-            ).fetchall()
-        ]
-
-    def get_completed(self) -> list[EvaluationInstance]:
-        rows = self._c.conn.execute(
-            f"SELECT {','.join(_EVI_COLS)} FROM evaluation_instances "
-            "WHERE status='EVALCOMPLETED' ORDER BY start_time DESC"
-        ).fetchall()
-        return [self._from_row(r) for r in rows]
-
-    def update(self, instance: EvaluationInstance) -> bool:
-        sets = ",".join(f"{c}=?" for c in _EVI_COLS[1:])
-        with self._c.conn as c:
-            cur = c.execute(
-                f"UPDATE evaluation_instances SET {sets} WHERE id=?",
-                self._to_row(instance)[1:] + (instance.id,),
-            )
-            return cur.rowcount > 0
-
-    def delete(self, instance_id: str) -> bool:
-        with self._c.conn as c:
-            return c.execute(
-                "DELETE FROM evaluation_instances WHERE id=?", (instance_id,)
-            ).rowcount > 0
-
-
-class SQLiteModels(ModelsBackend):
-    def __init__(self, client: SQLiteClient):
-        self._c = client
-
-    def insert(self, model: Model) -> None:
-        with self._c.conn as c:
-            c.execute(
-                "INSERT OR REPLACE INTO models (id, models) VALUES (?,?)",
-                (model.id, model.models),
-            )
-
-    def get(self, model_id: str) -> Model | None:
-        r = self._c.conn.execute(
-            "SELECT id, models FROM models WHERE id=?", (model_id,)
-        ).fetchone()
-        return Model(id=r[0], models=r[1]) if r else None
-
-    def delete(self, model_id: str) -> bool:
-        with self._c.conn as c:
-            return c.execute(
-                "DELETE FROM models WHERE id=?", (model_id,)
-            ).rowcount > 0
-
-
-class SQLiteEvents(EventsBackend):
-    """Event DAO over per-(app, channel) tables indexed by event time
-    (reference JDBCLEvents.scala init/insert/find)."""
-
-    def __init__(self, client: SQLiteClient):
-        self._c = client
-
-    def init(self, app_id: int, channel_id: int | None = None) -> bool:
-        t = self._c.event_table(app_id, channel_id)
-        with self._c.conn as c:
-            c.executescript(
-                f"""
-                CREATE TABLE IF NOT EXISTS {t} (
-                  id TEXT PRIMARY KEY,
-                  event TEXT NOT NULL,
-                  entity_type TEXT NOT NULL,
-                  entity_id TEXT NOT NULL,
-                  target_entity_type TEXT,
-                  target_entity_id TEXT,
-                  properties TEXT NOT NULL,
-                  event_time TEXT NOT NULL,
-                  tags TEXT NOT NULL,
-                  pr_id TEXT,
-                  creation_time TEXT NOT NULL);
-                CREATE INDEX IF NOT EXISTS {t}_time ON {t} (event_time);
-                CREATE INDEX IF NOT EXISTS {t}_entity
-                  ON {t} (entity_type, entity_id);
-                """
-            )
-        return True
-
-    def remove(self, app_id: int, channel_id: int | None = None) -> bool:
-        t = self._c.event_table(app_id, channel_id)
-        with self._c.conn as c:
-            c.execute(f"DROP TABLE IF EXISTS {t}")
-        return True
-
-    def close(self) -> None:
-        pass
-
-    def _to_row(self, e: Event):
-        return (
-            e.event_id, e.event, e.entity_type, e.entity_id,
-            e.target_entity_type, e.target_entity_id,
-            json.dumps(e.properties.to_dict()), _iso(e.event_time),
-            json.dumps(list(e.tags)), e.pr_id, _iso(e.creation_time),
-        )
-
-    def _from_row(self, r) -> Event:
-        return Event(
-            event_id=r[0], event=r[1], entity_type=r[2], entity_id=r[3],
-            target_entity_type=r[4], target_entity_id=r[5],
-            properties=DataMap(json.loads(r[6])),
-            event_time=_from_iso(r[7]), tags=tuple(json.loads(r[8])),
-            pr_id=r[9], creation_time=_from_iso(r[10]),
-        )
-
-    def insert(
-        self, event: Event, app_id: int, channel_id: int | None = None
-    ) -> str:
-        stamped = event.with_id(event.event_id)
-        t = self._c.event_table(app_id, channel_id)
-        sql = f"INSERT OR REPLACE INTO {t} VALUES ({','.join('?' * 11)})"
-        try:
-            with self._c.conn as c:
-                c.execute(sql, self._to_row(stamped))
-        except sqlite3.OperationalError:
-            # table not yet init()-ed — auto-create, matching MemoryEvents
-            self.init(app_id, channel_id)
-            with self._c.conn as c:
-                c.execute(sql, self._to_row(stamped))
-        return stamped.event_id
-
-    def insert_batch(
-        self,
-        events: Sequence[Event],
-        app_id: int,
-        channel_id: int | None = None,
-    ) -> list[str]:
-        stamped = [e.with_id(e.event_id) for e in events]
-        t = self._c.event_table(app_id, channel_id)
-        sql = f"INSERT OR REPLACE INTO {t} VALUES ({','.join('?' * 11)})"
-        rows = [self._to_row(e) for e in stamped]
-        try:
-            with self._c.conn as c:
-                c.executemany(sql, rows)
-        except sqlite3.OperationalError:
-            self.init(app_id, channel_id)
-            with self._c.conn as c:
-                c.executemany(sql, rows)
-        return [e.event_id for e in stamped]
-
-    def get(
-        self, event_id: str, app_id: int, channel_id: int | None = None
-    ) -> Event | None:
-        t = self._c.event_table(app_id, channel_id)
-        try:
-            r = self._c.conn.execute(
-                f"SELECT * FROM {t} WHERE id=?", (event_id,)
-            ).fetchone()
-        except sqlite3.OperationalError:
-            return None
-        return self._from_row(r) if r else None
-
-    def delete(
-        self, event_id: str, app_id: int, channel_id: int | None = None
-    ) -> bool:
-        t = self._c.event_table(app_id, channel_id)
-        with self._c.conn as c:
-            try:
-                return c.execute(
-                    f"DELETE FROM {t} WHERE id=?", (event_id,)
-                ).rowcount > 0
-            except sqlite3.OperationalError:
-                return False
-
-    def find(
-        self,
-        app_id: int,
-        channel_id: int | None = None,
-        start_time: _dt.datetime | None = None,
-        until_time: _dt.datetime | None = None,
-        entity_type: str | None = None,
-        entity_id: str | None = None,
-        event_names: Sequence[str] | None = None,
-        target_entity_type=...,
-        target_entity_id=...,
-        limit: int | None = None,
-        reversed: bool = False,
-    ) -> Iterator[Event]:
-        t = self._c.event_table(app_id, channel_id)
-        where, params = [], []
-        if start_time is not None:
-            where.append("event_time >= ?")
-            params.append(_iso(start_time))
-        if until_time is not None:
-            where.append("event_time < ?")
-            params.append(_iso(until_time))
-        if entity_type is not None:
-            where.append("entity_type = ?")
-            params.append(entity_type)
-        if entity_id is not None:
-            where.append("entity_id = ?")
-            params.append(entity_id)
-        if event_names is not None:
-            where.append(
-                f"event IN ({','.join('?' * len(event_names))})"
-            )
-            params.extend(event_names)
-        if target_entity_type is not ...:
-            if target_entity_type is None:
-                where.append("target_entity_type IS NULL")
-            else:
-                where.append("target_entity_type = ?")
-                params.append(target_entity_type)
-        if target_entity_id is not ...:
-            if target_entity_id is None:
-                where.append("target_entity_id IS NULL")
-            else:
-                where.append("target_entity_id = ?")
-                params.append(target_entity_id)
-        sql = f"SELECT * FROM {t}"
-        if where:
-            sql += " WHERE " + " AND ".join(where)
-        sql += f" ORDER BY event_time {'DESC' if reversed else 'ASC'}"
-        if limit is not None and limit > 0:
-            sql += f" LIMIT {int(limit)}"
-        elif limit == 0:
-            return
-        try:
-            cur = self._c.conn.execute(sql, params)
-        except sqlite3.OperationalError:
-            return  # table not initialized → no events
-        for r in cur:
-            yield self._from_row(r)
+# DAO names kept for the registry and external callers; the bodies are
+# the shared SQL implementations.
+SQLiteApps = SQLApps
+SQLiteAccessKeys = SQLAccessKeys
+SQLiteChannels = SQLChannels
+SQLiteEngineInstances = SQLEngineInstances
+SQLiteEngineManifests = SQLEngineManifests
+SQLiteEvaluationInstances = SQLEvaluationInstances
+SQLiteModels = SQLModels
+SQLiteEvents = SQLEvents

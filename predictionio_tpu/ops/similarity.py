@@ -37,6 +37,9 @@ def _top_k_dot_xla(
     mask: jax.Array | None = None,  # [B, I] True = exclude
 ) -> tuple[jax.Array, jax.Array]:
     scores = queries @ items.T  # [B, I] — MXU
+    # NaN scores (corrupted factors) map to -inf, matching the Pallas
+    # kernel's masking — both top_k_dot paths must rank identically
+    scores = jnp.where(jnp.isnan(scores), -jnp.inf, scores)
     if mask is not None:
         scores = jnp.where(mask, -jnp.inf, scores)
     return jax.lax.top_k(scores, num)

@@ -64,3 +64,78 @@ def initialize(
 
 def is_coordinator() -> bool:
     return jax.process_index() == 0
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch_processes(
+    argv: list[str],
+    num_processes: int,
+    coordinator_address: str | None = None,
+    env: dict | None = None,
+    timeout: float | None = None,
+) -> int:
+    """Spawn ``num_processes`` copies of ``argv`` with the multi-host env
+    contract set — the ``spark-submit`` boundary
+    (tools/Runner.scala:92-210: one driver process launched with PIO_*
+    env forwarded; here one process per TPU host, rank in env).
+
+    Each child gets ``PIO_COORDINATOR_ADDRESS`` / ``PIO_NUM_PROCESSES``
+    / ``PIO_PROCESS_ID`` on top of the parent env (so ``PIO_STORAGE_*``
+    flows through exactly as the reference forwards it). Returns the
+    first nonzero child exit code, else 0; on failure or timeout the
+    remaining children are terminated.
+    """
+    import subprocess
+    import time as _time
+
+    if num_processes < 1:
+        raise ValueError("num_processes must be ≥ 1")
+    coordinator_address = (
+        coordinator_address or f"127.0.0.1:{_free_port()}"
+    )
+    base_env = dict(os.environ if env is None else env)
+    base_env["PIO_COORDINATOR_ADDRESS"] = coordinator_address
+    base_env["PIO_NUM_PROCESSES"] = str(num_processes)
+    procs = []
+    for rank in range(num_processes):
+        child_env = dict(base_env)
+        child_env["PIO_PROCESS_ID"] = str(rank)
+        procs.append(subprocess.Popen(argv, env=child_env))
+    logger.info(
+        "launched %d process(es) for %r (coordinator %s)",
+        num_processes,
+        argv,
+        coordinator_address,
+    )
+    deadline = _time.monotonic() + timeout if timeout else None
+    rc = 0
+    try:
+        for p in procs:
+            remaining = (
+                max(0.1, deadline - _time.monotonic()) if deadline else None
+            )
+            try:
+                code = p.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                rc = rc or 124
+                break
+            if code and not rc:
+                rc = code
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+    return rc

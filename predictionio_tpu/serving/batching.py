@@ -19,12 +19,23 @@ from typing import Any, Callable, Sequence
 logger = logging.getLogger(__name__)
 
 
+class BatcherOverloaded(Exception):
+    """Queue depth bound hit — shed the request instead of queuing it.
+
+    Deliberately NOT a RuntimeError: callers distinguish overload
+    (client should back off, 503 fast) from a closed batcher mid-reload
+    (retry against the fresh set).
+    """
+
+
 class MicroBatcher:
     """Coalesce submit()-ed items into batches for ``batch_fn``.
 
     A batch is dispatched when ``max_batch`` items are waiting or
     ``max_wait_ms`` elapsed since the first queued item — the classic
-    latency/throughput knob.
+    latency/throughput knob. ``max_queue`` bounds queued items: beyond
+    it, ``submit`` raises :class:`BatcherOverloaded` so overload turns
+    into fast shedding rather than client-side timeout hangs.
     """
 
     def __init__(
@@ -32,10 +43,14 @@ class MicroBatcher:
         batch_fn: Callable[[Sequence[Any]], Sequence[Any]],
         max_batch: int = 64,
         max_wait_ms: float = 2.0,
+        max_queue: int | None = None,
     ):
         self._batch_fn = batch_fn
         self._max_batch = max_batch
         self._max_wait = max_wait_ms / 1000.0
+        self._max_queue = (
+            max_queue if max_queue is not None else 8 * max_batch
+        )
         self._queue: queue.Queue = queue.Queue()
         self._closed = threading.Event()
         self._submit_lock = threading.Lock()
@@ -48,6 +63,13 @@ class MicroBatcher:
         with self._submit_lock:
             if self._closed.is_set():
                 raise RuntimeError("batcher is closed")
+            if (
+                self._max_queue > 0
+                and self._queue.qsize() >= self._max_queue
+            ):
+                raise BatcherOverloaded(
+                    f"batch queue at capacity ({self._max_queue})"
+                )
             future: Future = Future()
             self._queue.put((item, future))
             return future

@@ -93,11 +93,22 @@ class ServerConfig:
 
     # -- key auth (reference KeyAuthentication.withAccessKeyFromFile) -----
     def check_key(self, request: Request) -> None:
-        """Raise 401 unless auth is off or the ``accessKey`` query param
-        matches the configured server key."""
+        """Raise 401 unless auth is off or the supplied server key
+        matches. The key is read from (in order) the
+        ``X-PIO-Server-Key`` header, an ``Authorization: Bearer`` header,
+        or the ``accessKey`` query param (reference parity) — prefer the
+        headers: query strings leak into request logs, shell history,
+        and upstream proxies when TLS terminates early."""
         if not self.key_auth_enforced:
             return
-        supplied = request.query.get("accessKey", "")
+        headers = getattr(request, "headers", None) or {}
+        supplied = headers.get("X-PIO-Server-Key", "")
+        if not supplied:
+            auth = headers.get("Authorization", "")
+            if auth.startswith("Bearer "):
+                supplied = auth[len("Bearer "):].strip()
+        if not supplied:
+            supplied = request.query.get("accessKey", "")
         # compare as bytes: compare_digest rejects non-ASCII str input
         if not self.access_key or not hmac.compare_digest(
             supplied.encode("utf-8"), self.access_key.encode("utf-8")

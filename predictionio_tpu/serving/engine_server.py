@@ -33,7 +33,7 @@ from predictionio_tpu.data.datamap import DataMap
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage import Storage, get_storage
 from predictionio_tpu.parallel.mesh import ComputeContext
-from predictionio_tpu.serving.batching import MicroBatcher
+from predictionio_tpu.serving.batching import BatcherOverloaded, MicroBatcher
 from predictionio_tpu.serving.plugins import (
     OUTPUT_SNIFFER,
     PluginContext,
@@ -64,6 +64,8 @@ class EngineServer:
         feedback_app_id: int | None = None,
         max_batch: int = 64,
         max_wait_ms: float = 2.0,
+        max_queue: int | None = None,
+        predict_timeout_s: float = 30.0,
         plugins: PluginContext | None = None,
         server_config=None,
         warmup: bool = True,
@@ -81,6 +83,8 @@ class EngineServer:
         self._feedback_app_id = feedback_app_id
         self._max_batch = max_batch
         self._max_wait_ms = max_wait_ms
+        self._max_queue = max_queue
+        self._predict_timeout_s = predict_timeout_s
         self._plugins = plugins or PluginContext()
         self._warmup = warmup
         if server_config is None:
@@ -126,6 +130,7 @@ class EngineServer:
                 ),
                 max_batch=self._max_batch,
                 max_wait_ms=self._max_wait_ms,
+                max_queue=self._max_queue,
             )
             for algo, model in zip(algorithms, models)
         ]
@@ -147,24 +152,54 @@ class EngineServer:
         XLA compiles per static shape; without this, each new bucket
         size compiles lazily mid-traffic (seconds-long p99 spikes on
         first occurrence). Algorithms expose a neutral ``warmup_query``
-        (default ``{}``); ones whose predict cannot run on it just skip.
+        (default ``{}``).
+
+        Failure policy: a first-bucket failure means the warmup query is
+        unsupported for this algorithm (INFO, served cold by design); a
+        failure AFTER a smaller bucket succeeded suggests predict itself
+        is broken at that shape (WARNING). One failing bucket does not
+        skip the rest — larger buckets may compile fine — but repeated
+        failures cap out rather than burn the whole reload window.
         """
+        t0 = time.perf_counter()
         for algo, model in zip(algorithms, models):
+            name = type(algo).__name__
             query = getattr(algo, "warmup_query", lambda: {})()
-            bucket = 1
+            bucket, failures, compiled = 1, 0, 0
             while True:
                 try:
                     algo.batch_predict(model, [query] * bucket)
+                    compiled += 1
                 except Exception as e:  # noqa: BLE001 - warmup best-effort
-                    logger.debug(
-                        "warmup skipped (batch %d): %s", bucket, e
-                    )
-                    break
+                    failures += 1
+                    if compiled == 0:
+                        logger.info(
+                            "%s: warmup query unsupported (batch %d: %s)"
+                            " — serving cold",
+                            name, bucket, e,
+                        )
+                    else:
+                        logger.warning(
+                            "%s: warmup FAILED at batch %d after smaller "
+                            "buckets compiled — predict may be broken at "
+                            "this shape: %s",
+                            name, bucket, e,
+                        )
+                    if failures >= 3:
+                        break
                 if bucket >= self._max_batch:
                     # covers the next-pow2 bucket a non-power-of-two
                     # max_batch rounds up into at predict time
                     break
                 bucket *= 2
+            logger.info(
+                "%s: warmup compiled %d bucket(s)%s",
+                name, compiled,
+                f", {failures} failed" if failures else "",
+            )
+        logger.info(
+            "warmup finished in %.1fs", time.perf_counter() - t0
+        )
 
     # -- routes -----------------------------------------------------------
     def _status(self, request: Request) -> Response:
@@ -196,6 +231,10 @@ class EngineServer:
             supplemented = serving.supplement(query)
             try:
                 futures = [b.submit(supplemented) for b in batchers]
+            except BatcherOverloaded:
+                # queue-depth bound hit: shed immediately instead of
+                # queueing into a predict-timeout hang
+                raise HTTPError(503, "server overloaded; retry later")
             except RuntimeError:
                 # /reload swapped+closed the batchers between our snapshot
                 # and submit — retry once against the fresh set
@@ -203,7 +242,9 @@ class EngineServer:
             break
         else:
             raise HTTPError(503, "server is reloading; retry")
-        predictions = [f.result(timeout=30.0) for f in futures]
+        predictions = [
+            f.result(timeout=self._predict_timeout_s) for f in futures
+        ]
         prediction = serving.serve(supplemented, predictions)
 
         if self._feedback:

@@ -165,7 +165,13 @@ class HTTPServer:
                 super().setup()
 
             def log_message(self, fmt, *args):  # route through logging
-                logger.debug("%s %s", self.address_string(), fmt % args)
+                line = fmt % args
+                # keys travel in query strings for reference parity;
+                # they must not land in logs
+                line = re.sub(
+                    r"(accessKey=)[^&\s\"]+", r"\1[redacted]", line
+                )
+                logger.debug("%s %s", self.address_string(), line)
 
             def _handle(self):
                 parsed = urlparse(self.path)

@@ -1,0 +1,54 @@
+"""Child process for the 2-process jax.distributed integration test.
+
+Launched by ``launch_processes`` with the PIO_* env contract; joins the
+job via ``distributed.initialize()``, then runs a tiny pjit program
+over the GLOBAL device set (2 processes × 2 virtual CPU devices) and
+checks the collective result — the minimal proof that the multi-host
+boundary (reference Runner.runOnSpark, tools/Runner.scala:92-210)
+actually coordinates processes.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+from predictionio_tpu.parallel import distributed  # noqa: E402
+
+
+def main() -> None:
+    distributed.initialize()
+    assert jax.process_count() == 2, (
+        f"expected 2 processes, got {jax.process_count()}"
+    )
+    devs = np.array(jax.devices())  # global: 2 hosts × 2 devices
+    assert len(devs) == 4, f"expected 4 global devices, got {len(devs)}"
+    mesh = Mesh(devs, ("data",))
+    n = 8
+    x = jax.make_array_from_callback(
+        (n,),
+        NamedSharding(mesh, P("data")),
+        lambda idx: np.arange(n, dtype=np.float32)[idx],
+    )
+    total = jax.jit(
+        lambda a: a.sum(), out_shardings=NamedSharding(mesh, P())
+    )(x)
+    val = float(np.asarray(jax.device_get(total)))
+    expected = n * (n - 1) / 2
+    assert val == expected, (val, expected)
+    print(
+        f"distributed OK rank={jax.process_index()}/"
+        f"{jax.process_count()} sum={val}",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""Multi-host launch boundary tests (VERDICT r1 #7): the process
+launcher must coordinate a real 2-process jax.distributed job
+(reference Runner.runOnSpark, tools/Runner.scala:92-210 — `local[4]`
+threads never crossed a process boundary; this does)."""
+
+import os
+import subprocess
+import sys
+
+from predictionio_tpu.parallel.distributed import launch_processes
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+
+
+class TestLaunchProcesses:
+    def test_two_process_distributed_pjit_job(self):
+        """Two coordinated processes run a global-mesh pjit reduction."""
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        rc = launch_processes(
+            [sys.executable, os.path.join(_HERE, "distributed_child.py")],
+            num_processes=2,
+            env=env,
+            timeout=180,
+        )
+        assert rc == 0
+
+    def test_env_contract(self):
+        """Children see coordinator address, world size, and their rank."""
+        probe = (
+            "import os,sys;"
+            "assert os.environ['PIO_NUM_PROCESSES']=='2';"
+            "assert os.environ['PIO_COORDINATOR_ADDRESS'];"
+            "sys.exit(int(os.environ['PIO_PROCESS_ID']))"
+        )
+        # ranks 0 and 1 exit with their rank: first nonzero rc is 1
+        rc = launch_processes(
+            [sys.executable, "-c", probe], num_processes=2, timeout=60
+        )
+        assert rc == 1
+
+    def test_failure_propagates_and_terminates(self):
+        rc = launch_processes(
+            [sys.executable, "-c", "import sys; sys.exit(3)"],
+            num_processes=2,
+            timeout=60,
+        )
+        assert rc == 3
+
+    def test_cli_launch_verb(self):
+        """`pio-tpu launch -n 2 -- <cmd>` sets the contract env."""
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        out = subprocess.run(
+            [
+                sys.executable, "-m", "predictionio_tpu.cli.main",
+                "launch", "-n", "2", "--",
+                sys.executable, "-c",
+                # single write: two children share the pipe, and a
+                # print() may issue multiple write() calls that interleave
+                "import os,sys;"
+                "sys.stdout.write('rank %s\\n' % os.environ['PIO_PROCESS_ID'])",
+            ],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert out.returncode == 0, out.stderr
+        ranks = sorted(
+            line for line in out.stdout.splitlines() if "rank" in line
+        )
+        assert ranks == ["rank 0", "rank 1"]

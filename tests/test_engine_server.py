@@ -280,3 +280,96 @@ class TestReviewRegressions:
         done.set()
         [t.join() for t in threads]
         assert errors == []
+
+
+class TestLoadShedding:
+    """Overload sheds at the queue-depth bound with a fast 503 instead
+    of queueing into a predict-timeout hang (VERDICT r1 weak #7)."""
+
+    def test_batcher_overload_raises(self):
+        import threading
+
+        from predictionio_tpu.serving.batching import (
+            BatcherOverloaded,
+            MicroBatcher,
+        )
+
+        release = threading.Event()
+
+        def slow_fn(items):
+            release.wait(timeout=10)
+            return items
+
+        b = MicroBatcher(
+            slow_fn, max_batch=1, max_wait_ms=0.1, max_queue=3
+        )
+        try:
+            futures = [b.submit(i) for i in range(3)]
+            # worker holds one batch; queue fills to the bound
+            import time
+
+            time.sleep(0.1)
+            b.submit(99)  # qsize dropped by the in-flight item
+            with pytest.raises(BatcherOverloaded):
+                for _ in range(10):
+                    b.submit(100)
+            release.set()
+            for f in futures:
+                f.result(timeout=10)
+        finally:
+            release.set()
+            b.close()
+
+    def test_overload_maps_to_503(self, ctx, memory_storage):
+        import threading
+
+        run_train(
+            _engine(), _params(), engine_id="srv-shed", ctx=ctx,
+            storage=memory_storage,
+        )
+        es = EngineServer(
+            _engine(),
+            _params(),
+            engine_id="srv-shed",
+            storage=memory_storage,
+            ctx=ctx,
+            max_batch=1,
+            max_queue=1,
+            warmup=False,
+        )
+        # swap in a batcher whose work blocks, then overfill it
+        release = threading.Event()
+        from predictionio_tpu.serving.batching import MicroBatcher
+
+        slow = MicroBatcher(
+            lambda items: (release.wait(10), items)[1],
+            max_batch=1, max_wait_ms=0.1, max_queue=1,
+        )
+        es._batchers = [slow]
+        http = es.serve(host="127.0.0.1", port=0)
+        http.start()
+        try:
+            base = f"http://127.0.0.1:{http.port}"
+            results = []
+
+            def fire():
+                results.append(
+                    _call(f"{base}/queries.json", "POST", {"x": 1})[0]
+                )
+
+            threads = [
+                threading.Thread(target=fire) for _ in range(6)
+            ]
+            for t in threads:
+                t.start()
+            import time
+
+            time.sleep(0.3)
+            release.set()
+            for t in threads:
+                t.join(timeout=15)
+            assert 503 in results, results
+        finally:
+            release.set()
+            http.shutdown()
+            es.close()

@@ -147,6 +147,123 @@ class TestFastEvalEngine:
         assert plain.best_score.score == fast.best_score.score
 
 
+class CountingDataSource(FakeDataSource):
+    """read_eval counter with an optional artificial delay (class-level:
+    fresh component instances are created per candidate)."""
+
+    reads = 0
+    delay = 0.0
+
+    def read_eval(self, ctx):
+        import time as _t
+
+        type(self).reads += 1
+        if type(self).delay:
+            _t.sleep(type(self).delay)
+        return super().read_eval(ctx)
+
+
+def _counting_engine(cls=Engine):
+    return cls(CountingDataSource, FakePreparator, FakeAlgorithm, FakeServing)
+
+
+class TestParallelTuning:
+    """VERDICT r1 #6: candidates scored concurrently; run_evaluation
+    memoizes prefixes by default (reference MetricEvaluator.scala:224
+    `.par` + FastEvalEngine)."""
+
+    def _grid(self):
+        # 3×3 grid: 3 preparator ids × 3 algorithm ids, one shared DS
+        return [
+            EngineParams(
+                data_source=("", FakeParams(id=1)),
+                preparator=("", FakeParams(id=prep)),
+                algorithms=[("", FakeParams(id=algo))],
+                serving=("", FakeParams()),
+            )
+            for prep in (1, 2, 3)
+            for algo in (4, 5, 6)
+        ]
+
+    def test_grid_sweep_reads_data_source_once(self, ctx, memory_storage):
+        CountingDataSource.reads = 0
+        evaluation = Evaluation(
+            engine=_counting_engine(),  # plain Engine: auto-wrapped
+            metric=QueryEcho(),
+            engine_params_list=self._grid(),
+        )
+        _iid, result = run_evaluation(
+            evaluation, ctx=ctx, storage=memory_storage
+        )
+        # 9 candidates share one data-source params: exactly 1 read,
+        # not one per candidate (the reference FastEval guarantee)
+        assert CountingDataSource.reads == 1
+        assert len(result.engine_params_scores) == 9
+        # best = highest prep+algo (prediction encodes the pipeline)
+        assert result.best_engine_params.preparator[1].id == 3
+        assert result.best_engine_params.algorithms[0][1].id == 6
+
+    def test_parallel_matches_sequential(self, ctx):
+        grid = self._grid()
+        seq = MetricEvaluator(QueryEcho(), parallelism=1).evaluate(
+            ctx, _engine(), grid
+        )
+        par = MetricEvaluator(QueryEcho(), parallelism=4).evaluate(
+            ctx, _engine(), grid
+        )
+        assert [s.score for _p, s in seq.engine_params_scores] == [
+            s.score for _p, s in par.engine_params_scores
+        ]
+        assert seq.best_idx == par.best_idx
+
+    def test_parallel_wall_clock_sublinear(self, ctx):
+        import time
+
+        CountingDataSource.reads = 0
+        CountingDataSource.delay = 0.15
+        try:
+            # plain engine (no memoization): every candidate pays the
+            # slow read — the pool must overlap them
+            grid = self._grid()[:4]
+            t0 = time.perf_counter()
+            MetricEvaluator(QueryEcho(), parallelism=4).evaluate(
+                ctx, _counting_engine(), grid
+            )
+            parallel_s = time.perf_counter() - t0
+            assert CountingDataSource.reads == 4
+            # 4 × 0.15s sequential ≥ 0.6s; overlapped ≈ 0.15s + overhead
+            assert parallel_s < 0.45, f"no overlap: {parallel_s:.3f}s"
+        finally:
+            CountingDataSource.delay = 0.0
+
+    def test_single_flight_cache_under_race(self, ctx):
+        """Concurrent candidates sharing a slow prefix must compute it
+        exactly once (losers block on the winner's future)."""
+        CountingDataSource.reads = 0
+        CountingDataSource.delay = 0.1
+        try:
+            engine = _counting_engine(FastEvalEngine)
+            MetricEvaluator(QueryEcho(), parallelism=4).evaluate(
+                ctx, engine, self._grid()
+            )
+            assert CountingDataSource.reads == 1
+            assert engine.cache_hits["data_source"] >= 8
+        finally:
+            CountingDataSource.delay = 0.0
+
+    def test_fast_eval_opt_out(self, ctx, memory_storage):
+        CountingDataSource.reads = 0
+        evaluation = Evaluation(
+            engine=_counting_engine(),
+            metric=QueryEcho(),
+            engine_params_list=self._grid()[:3],
+            fast_eval=False,
+            parallelism=1,
+        )
+        run_evaluation(evaluation, ctx=ctx, storage=memory_storage)
+        assert CountingDataSource.reads == 3  # no memoization
+
+
 class TestRunEvaluation:
     def test_lifecycle_and_results_persisted(self, ctx, memory_storage):
         evaluation = Evaluation(

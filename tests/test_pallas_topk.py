@@ -4,6 +4,8 @@ Runs the kernel in interpreter mode (tests force the CPU backend,
 tests/conftest.py) — the driver's real-chip bench exercises the compiled
 Mosaic path."""
 
+import os
+
 import numpy as np
 import pytest
 import jax
@@ -151,3 +153,54 @@ class TestDispatch:
 
         assert jax.default_backend() == "cpu"
         assert not _use_pallas(1024, 1_000_000)
+
+
+@pytest.mark.tpu
+@pytest.mark.skipif(
+    not os.environ.get("PIO_TPU_TESTS"),
+    reason="real-TPU test: set PIO_TPU_TESTS=1 to run",
+)
+class TestCompiledMosaicOnTPU:
+    """Compiled (non-interpreter) Mosaic kernel vs the XLA path on real
+    hardware — covers layouts CI's interpreter runs can't: non-128-
+    multiple num, non-power-of-two batch (ADVICE r1). The test process
+    pins CPU, so the compiled check runs in a TPU subprocess."""
+
+    def test_compiled_matches_xla(self):
+        import subprocess
+        import sys
+
+        code = r"""
+import os
+
+import numpy as np
+import jax, jax.numpy as jnp
+from predictionio_tpu.ops.pallas_topk import fused_top_k_dot
+from predictionio_tpu.ops.similarity import _top_k_dot_xla
+assert jax.default_backend() == "tpu", jax.default_backend()
+rng = np.random.default_rng(3)
+for b, n_items, num in ((5, 4000, 7), (3, 1000, 50), (8, 2048, 100)):
+    q = jnp.asarray(rng.normal(size=(b, 16)).astype(np.float32))
+    it = jnp.asarray(rng.normal(size=(n_items, 16)).astype(np.float32))
+    ps, pi = fused_top_k_dot(q, it, num, block=512)
+    xs, xi = _top_k_dot_xla(q, it, num)
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(ps)), np.asarray(jax.device_get(xs)),
+        rtol=1e-4, atol=1e-4,
+    )
+    assert (np.asarray(jax.device_get(pi))
+            == np.asarray(jax.device_get(xi))).all(), (b, n_items, num)
+print("compiled mosaic OK")
+"""
+        env = {
+            k: v for k, v in os.environ.items()
+            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+        }
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            env=env, capture_output=True, text=True, timeout=600,
+        )
+        if "UNAVAILABLE" in (out.stderr or ""):
+            pytest.skip("TPU backend unavailable")
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "compiled mosaic OK" in out.stdout

@@ -1,0 +1,153 @@
+"""MANUAL-persistence reference implementation tests (VERDICT r1 #8;
+reference LocalFileSystemPersistentModel.scala:40-74): round-trip
+through the mixin, and the full train→persist→load_deployment cycle."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from fake_engine import FakeParams, FakePD
+from predictionio_tpu.core import Engine, EngineParams
+from predictionio_tpu.core.controller import (
+    Algorithm,
+    DataSource,
+    IdentityPreparator,
+    PersistenceMode,
+    Serving,
+)
+from predictionio_tpu.core.persistent_model import (
+    LocalFileSystemPersistentModel,
+    load_persistent_model,
+    save_persistent_model,
+)
+from predictionio_tpu.core.workflow import load_deployment, run_train
+from predictionio_tpu.parallel.mesh import ComputeContext
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return ComputeContext.create(batch="pmodel-test")
+
+
+@dataclasses.dataclass
+class ToyModel:
+    weights: np.ndarray
+    bias: np.ndarray
+    vocab: list
+    scale: float
+
+
+class TestSplitRoundTrip:
+    def test_dataclass_model(self, tmp_path, monkeypatch):
+        model = ToyModel(
+            weights=np.arange(12, dtype=np.float32).reshape(3, 4),
+            bias=np.ones(4, np.float32),
+            vocab=["a", "b"],
+            scale=2.5,
+        )
+        d = str(tmp_path / "m1")
+        save_persistent_model(d, model)
+        out = load_persistent_model(d)
+        np.testing.assert_allclose(out.weights, model.weights)
+        np.testing.assert_allclose(out.bias, model.bias)
+        assert out.vocab == ["a", "b"]
+        assert out.scale == 2.5
+
+    def test_dict_model(self, tmp_path):
+        model = {"w": np.zeros((2, 2), np.float32), "names": ("x", "y")}
+        d = str(tmp_path / "m2")
+        save_persistent_model(d, model)
+        out = load_persistent_model(d)
+        np.testing.assert_allclose(out["w"], model["w"])
+        assert out["names"] == ("x", "y")
+
+    def test_bare_array_model(self, tmp_path):
+        arr = np.linspace(0, 1, 7, dtype=np.float32)
+        d = str(tmp_path / "m3")
+        save_persistent_model(d, arr)
+        np.testing.assert_allclose(load_persistent_model(d), arr)
+
+    def test_sharded_jax_array_round_trips(self, tmp_path):
+        """A mesh-sharded factor matrix saves without error and restores
+        bit-exact — the MANUAL-mode case the helper exists for."""
+        import jax
+
+        ctx = ComputeContext.create(batch="pm-shard", mesh_shape=(4, 2))
+        host = np.arange(64, dtype=np.float32).reshape(8, 8)
+        sharded = jax.device_put(host, ctx.sharding("model"))
+        d = str(tmp_path / "m4")
+        save_persistent_model(d, {"factors": sharded})
+        out = load_persistent_model(d)
+        np.testing.assert_allclose(out["factors"], host)
+
+    def test_overwrite_replaces(self, tmp_path):
+        d = str(tmp_path / "m5")
+        save_persistent_model(d, {"w": np.zeros(2, np.float32)})
+        save_persistent_model(d, {"w": np.ones(3, np.float32)})
+        out = load_persistent_model(d)
+        np.testing.assert_allclose(out["w"], np.ones(3))
+
+    def test_missing_model_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            load_persistent_model(str(tmp_path / "nope"))
+
+
+class ManualDataSource(DataSource):
+    params_class = FakeParams
+
+    def read_training(self, ctx):
+        return FakePD(source_id=self.params.id, prep_id=0)
+
+
+class ManualAlgorithm(LocalFileSystemPersistentModel, Algorithm):
+    params_class = FakeParams
+    train_calls = 0
+
+    def train(self, ctx, pd):
+        type(self).train_calls += 1
+        return ToyModel(
+            weights=np.full((2, 2), float(self.params.id), np.float32),
+            bias=np.zeros(2, np.float32),
+            vocab=["v"],
+            scale=1.0,
+        )
+
+    def predict(self, model, query):
+        return float(model.weights[0, 0]) + query
+
+
+class PassServing(Serving):
+    params_class = FakeParams
+
+    def serve(self, query, predictions):
+        return predictions[0]
+
+
+class TestManualLifecycle:
+    def test_train_persist_deploy(self, ctx, memory_storage, tmp_path,
+                                  monkeypatch):
+        monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+        engine = Engine(
+            ManualDataSource, IdentityPreparator, ManualAlgorithm,
+            PassServing,
+        )
+        params = EngineParams(
+            data_source=("", FakeParams(id=7)),
+            algorithms=[("", FakeParams(id=7))],
+        )
+        assert ManualAlgorithm("").persistence_mode is PersistenceMode.MANUAL
+        ManualAlgorithm.train_calls = 0
+        iid = run_train(
+            engine, params, engine_id="manual-e", ctx=ctx,
+            storage=memory_storage,
+        )
+        assert ManualAlgorithm.train_calls == 1
+        # deploy loads via the mixin — no retrain, correct weights
+        _inst, algos, models, serving = load_deployment(
+            engine, params, engine_id="manual-e", ctx=ctx,
+            storage=memory_storage,
+        )
+        assert ManualAlgorithm.train_calls == 1  # no retrain happened
+        np.testing.assert_allclose(models[0].weights, 7.0)
+        assert serving.serve(1, [algos[0].predict(models[0], 1)]) == 8.0

@@ -199,3 +199,57 @@ class TestTLS:
                 )
         finally:
             http.shutdown()
+
+
+class TestHeaderKeyAuth:
+    """The server key is also accepted via X-PIO-Server-Key or
+    Authorization: Bearer headers, preferred over the query param
+    (ADVICE r1: query strings leak into logs and proxies)."""
+
+    def _req(self, url, headers=None):
+        req = urllib.request.Request(url, headers=headers or {})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    @pytest.fixture()
+    def server(self):
+        cfg = ServerConfig(key_auth_enforced=True, access_key="hkey")
+        router = Router()
+        router.route("GET", "/", lambda req: Response(200, {"ok": True}))
+        http = HTTPServer(
+            router, host="127.0.0.1", port=0, server_config=cfg,
+            enforce_key=True,
+        )
+        http.start()
+        yield f"http://127.0.0.1:{http.port}"
+        http.shutdown()
+
+    def test_x_pio_server_key_header(self, server):
+        assert self._req(server + "/") == 401
+        assert self._req(
+            server + "/", {"X-PIO-Server-Key": "hkey"}
+        ) == 200
+        assert self._req(
+            server + "/", {"X-PIO-Server-Key": "wrong"}
+        ) == 401
+
+    def test_bearer_header(self, server):
+        assert self._req(
+            server + "/", {"Authorization": "Bearer hkey"}
+        ) == 200
+        assert self._req(
+            server + "/", {"Authorization": "Bearer nope"}
+        ) == 401
+
+    def test_header_preferred_over_query(self, server):
+        # wrong header + right query param → rejected (header wins)
+        assert self._req(
+            server + "/?accessKey=hkey", {"X-PIO-Server-Key": "bad"}
+        ) == 401
+        # right header + wrong query param → accepted
+        assert self._req(
+            server + "/?accessKey=bad", {"X-PIO-Server-Key": "hkey"}
+        ) == 200
