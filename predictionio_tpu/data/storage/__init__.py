@@ -37,6 +37,7 @@ from predictionio_tpu.data.storage.base import (
     EventsBackend,
     Model,
     ModelsBackend,
+    StorageError,
 )
 
 __all__ = [
@@ -48,10 +49,6 @@ __all__ = [
     "Storage", "StorageError", "register_backend", "get_storage",
     "set_storage",
 ]
-
-
-class StorageError(RuntimeError):
-    """Reference ``StorageClientException``."""
 
 
 @dataclass
@@ -127,6 +124,30 @@ def _register_builtins() -> None:
         BackendSpec(
             client=lambda config: config,
             models=lambda config: localfs.LocalFSModels(config),
+        ),
+    )
+    # networked production store (reference default: jdbc postgres,
+    # Storage.scala "PGSQL" source); the client module imports lazily so
+    # registry setup never pays for a driver probe
+    def _postgres_client(config: dict):
+        from predictionio_tpu.data.storage import postgres
+
+        return postgres.PostgresClient(config)
+
+    from predictionio_tpu.data.storage import sql_common
+
+    register_backend(
+        "postgres",
+        BackendSpec(
+            client=_postgres_client,
+            apps=sql_common.SQLApps,
+            access_keys=sql_common.SQLAccessKeys,
+            channels=sql_common.SQLChannels,
+            engine_instances=sql_common.SQLEngineInstances,
+            engine_manifests=sql_common.SQLEngineManifests,
+            evaluation_instances=sql_common.SQLEvaluationInstances,
+            models=sql_common.SQLModels,
+            events=sql_common.SQLEvents,
         ),
     )
     # native C++ event log (events only, like the reference's hbase

@@ -28,6 +28,17 @@ from typing import Iterable, Iterator, Sequence
 from predictionio_tpu.data.datamap import PropertyMap
 from predictionio_tpu.data.event import Event
 
+
+class StorageError(RuntimeError):
+    """Reference ``StorageClientException`` (Storage.scala:46-48): raised
+    for unreachable backends, missing drivers, unknown backend types, and
+    unbound repositories. Defined here (not the package ``__init__``) so
+    backend modules can import it without a circular import."""
+
+
+# Reference-spelled alias
+StorageClientException = StorageError
+
 # --------------------------------------------------------------------------
 # Metadata records
 # --------------------------------------------------------------------------

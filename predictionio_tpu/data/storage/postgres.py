@@ -12,10 +12,10 @@ All DAO logic is shared with sqlite via
 only the postgres dialect (``%s`` placeholders, ``ON CONFLICT`` upsert,
 ``BIGSERIAL`` ids, ``BYTEA`` blobs) and driver/connection handling.
 The driver is autodetected: ``psycopg2`` then ``pg8000`` (both speak
-DB-API); a clear StorageClientException tells the operator what to
-install when neither is importable — mirroring the reference, which
-likewise needs the JDBC driver jar on the classpath
-(JDBCUtils.driverType).
+DB-API), falling back to the vendored pure-Python wire-protocol driver
+:mod:`predictionio_tpu.data.storage.pgwire` — so the backend works with
+zero extra installs, mirroring the reference's JDBC-driver-on-classpath
+requirement (JDBCUtils.driverType) without the classpath.
 
 Config (``PIO_STORAGE_SOURCES_<NAME>_*``)::
 
@@ -37,7 +37,7 @@ from __future__ import annotations
 from typing import Any, Sequence
 from urllib.parse import urlparse
 
-from predictionio_tpu.data.storage.base import StorageClientException
+from predictionio_tpu.data.storage.base import StorageError
 from predictionio_tpu.data.storage.sql_common import (
     SQLAccessKeys,
     SQLApps,
@@ -66,10 +66,9 @@ def _load_driver():
         return pg8000.dbapi, "pg8000"
     except ImportError:
         pass
-    raise StorageClientException(
-        "postgres backend needs a driver: install psycopg2-binary or "
-        "pg8000 (neither is importable)"
-    )
+    from predictionio_tpu.data.storage import pgwire
+
+    return pgwire, "pgwire"
 
 
 class PostgresDialect(SQLDialect):
@@ -138,7 +137,7 @@ class PostgresClient(SQLClient):
         try:
             self.ensure_metadata_schema()
         except Exception as exc:  # connection refused, bad auth, ...
-            raise StorageClientException(
+            raise StorageError(
                 f"cannot reach postgres at "
                 f"{self._conn_kwargs['host']}:{self._conn_kwargs['port']}"
                 f"/{self._conn_kwargs['database']}: {exc}"

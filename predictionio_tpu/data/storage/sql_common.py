@@ -5,10 +5,13 @@ scalikejdbc DAOs that run unchanged against PostgreSQL or MySQL). The
 same shape here: every DAO below is written against a tiny
 :class:`SQLDialect` seam (placeholder style, upsert syntax, autoincrement
 column, blob type, driver exception classes), so the sqlite backend and
-the networked postgres backend share ~95% of their logic — and the
-storage contract tests exercising sqlite validate the shared code paths
-for postgres too (the reference gates its Postgres/HBase contract runs
-on service availability the same way, .travis.yml:30-55).
+the networked postgres backend share ~95% of their logic. Both dialects
+run the full storage contract suite: sqlite in-process, postgres end to
+end over a TCP socket against the
+:mod:`~predictionio_tpu.data.storage.minipg` wire-compatible server
+(``PIO_TEST_POSTGRES_URL`` swaps in a live PostgreSQL — the reference
+gates its JDBC contract runs on service availability the same way,
+.travis.yml:30-55).
 
 Schema parity notes: one event table per (app, channel) named
 ``events_<appId>[_<channelId>]`` (reference JDBCLEvents.scala table

@@ -26,12 +26,16 @@ def _t(seconds: int) -> dt.datetime:
     )
 
 
-@pytest.fixture(params=["memory", "sqlite", "eventlog"])
-def storage(request, memory_storage, sqlite_storage, eventlog_storage):
+@pytest.fixture(params=["memory", "sqlite", "eventlog", "postgres"])
+def storage(
+    request, memory_storage, sqlite_storage, eventlog_storage,
+    postgres_storage,
+):
     return {
         "memory": memory_storage,
         "sqlite": sqlite_storage,
         "eventlog": eventlog_storage,
+        "postgres": postgres_storage,
     }[request.param]
 
 
