@@ -186,6 +186,16 @@ class Algorithm(_Controller, Generic[PD, M, Q, P], abc.ABC):
         Default loops; algorithms override with a vmapped/jitted path."""
         return [self.predict(model, q) for q in queries]
 
+    def stage_model(self, ctx: ComputeContext, model: M) -> M:
+        """Deploy-time hook: place model state onto the device(s) ONCE so
+        serving never re-uploads it per request (the reference keeps the
+        deployed model resident in the server JVM,
+        workflow/CreateServer.scala:495-647; the TPU analogue is
+        device-committed ``jax.Array`` factors). Called by
+        ``Engine.prepare_deploy`` for every load and ``/reload``.
+        Default: identity (host-resident models)."""
+        return model
+
     # -- persistence hooks (MANUAL mode) ---------------------------------
     def save_model(self, instance_id: str, model: M) -> None:
         raise NotImplementedError(

@@ -242,6 +242,12 @@ class Engine:
                 td = data_source.read_training(ctx)
                 pd = self.make_preparator(params).prepare(ctx, td)
                 models.append(algo.train(ctx, pd))
+        # stage every model onto the device(s) once — serving must never
+        # pay a per-request host→device model transfer
+        models = [
+            algo.stage_model(ctx, model)
+            for algo, model in zip(algorithms, models)
+        ]
         return algorithms, models, self.make_serving(params)
 
     # -- engine.json variant → EngineParams (Engine.scala:354-417) --------

@@ -16,7 +16,6 @@ from __future__ import annotations
 import dataclasses
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from predictionio_tpu.core import (
@@ -89,8 +88,9 @@ class ECommAlgorithmParams(Params):
 
 @dataclasses.dataclass
 class ECommModel:
-    user_factors: np.ndarray
-    item_factors: np.ndarray
+    # host np.ndarray after train, device jax.Array after staging
+    user_factors: np.ndarray | jax.Array
+    item_factors: np.ndarray | jax.Array
     user_map: BiMap
     item_map: BiMap
     item_categories: dict[str, list[str]]
@@ -129,6 +129,15 @@ class ECommAlgorithm(Algorithm):
             item_map=inter.target_map,
             item_categories=pd.item_categories,
             popularity=popularity,
+        )
+
+    def stage_model(self, ctx, model: ECommModel) -> ECommModel:
+        """Factors live on device after deploy; popularity stays host —
+        the cold-user fallback ranks on the CPU without a device trip."""
+        return dataclasses.replace(
+            model,
+            user_factors=similarity.stage_factors(model.user_factors),
+            item_factors=similarity.stage_factors(model.item_factors),
         )
 
     # -- serve-time business rules (reference ECommAlgorithm.predict) -----
@@ -175,10 +184,13 @@ class ECommAlgorithm(Algorithm):
         user_idx = model.user_map.get(user, -1)
         n_items = len(model.item_factors)
         if user_idx >= 0:
-            qvec = model.user_factors[user_idx][None, :]
             k = min(1 << max(0, (4 * num - 1)).bit_length(), n_items)
-            scores, cand = similarity.top_k_dot(
-                jnp.asarray(qvec), jnp.asarray(model.item_factors), k
+            # fused on-device gather + score + top-k: uploads one index
+            scores, cand = similarity.gather_top_k_dot(
+                model.user_factors,
+                np.asarray([user_idx], np.int32),
+                model.item_factors,
+                k,
             )
             scores, cand = jax.device_get((scores, cand))  # parallel fetch
             scores, cand = scores[0], cand[0]

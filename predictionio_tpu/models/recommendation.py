@@ -19,7 +19,6 @@ import dataclasses
 import logging
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from predictionio_tpu.core import (
@@ -161,8 +160,10 @@ class ALSParams(Params):
 
 @dataclasses.dataclass
 class ALSRecModel:
-    user_factors: np.ndarray
-    item_factors: np.ndarray
+    # np.ndarray after train (host, picklable); device-committed
+    # jax.Array after Algorithm.stage_model at deploy
+    user_factors: np.ndarray | jax.Array
+    item_factors: np.ndarray | jax.Array
     user_map: BiMap
     item_map: BiMap
 
@@ -201,6 +202,17 @@ class ALSAlgorithm(Algorithm[RecTrainingData, ALSRecModel, dict, dict]):
         )
 
     # -- serving ----------------------------------------------------------
+    def stage_model(
+        self, ctx: ComputeContext, model: ALSRecModel
+    ) -> ALSRecModel:
+        """Commit both factor matrices to the device once at deploy; the
+        per-request upload is then just the int32 user indices."""
+        return dataclasses.replace(
+            model,
+            user_factors=similarity.stage_factors(model.user_factors),
+            item_factors=similarity.stage_factors(model.item_factors),
+        )
+
     def predict(self, model: ALSRecModel, query: dict) -> dict:
         return self.batch_predict(model, [query])[0]
 
@@ -219,12 +231,15 @@ class ALSAlgorithm(Algorithm[RecTrainingData, ALSRecModel, dict, dict]):
             [model.user_map.get(q.get("user", ""), -1) for q in queries],
             np.int32,
         )
-        vecs = model.user_factors[np.clip(user_idx, 0, None)]
-        batch_bucket = 1 << max(0, (len(vecs) - 1)).bit_length()
-        if batch_bucket > len(vecs):
-            vecs = np.pad(vecs, ((0, batch_bucket - len(vecs)), (0, 0)))
-        scores, items = similarity.top_k_dot(
-            jnp.asarray(vecs), jnp.asarray(model.item_factors), num_bucket
+        idx = np.clip(user_idx, 0, None)
+        batch_bucket = 1 << max(0, (len(idx) - 1)).bit_length()
+        if batch_bucket > len(idx):
+            idx = np.pad(idx, (0, batch_bucket - len(idx)))
+        # fused gather + score + top-k on device: uploads only `idx`
+        # (factors are staged jax.Arrays after stage_model; the
+        # evaluation path passes host arrays and pays the upload there)
+        scores, items = similarity.gather_top_k_dot(
+            model.user_factors, idx, model.item_factors, num_bucket
         )
         # one parallel device_get: through remote-TPU transports each
         # separate fetch pays a full round trip (~70 ms on the tunnel)

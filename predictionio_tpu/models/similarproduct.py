@@ -19,7 +19,6 @@ from __future__ import annotations
 import dataclasses
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from predictionio_tpu.core import (
@@ -102,7 +101,8 @@ class SimilarALSParams(Params):
 
 @dataclasses.dataclass
 class SimilarModel:
-    item_factors: np.ndarray  # [I, k]
+    # [I, k]; host np.ndarray after train, device jax.Array after staging
+    item_factors: np.ndarray | jax.Array
     item_map: BiMap
     item_categories: dict[str, list[str]]
 
@@ -140,6 +140,14 @@ class SimilarALSAlgorithm(Algorithm):
             item_categories=pd.item_categories,
         )
 
+    def stage_model(
+        self, ctx: ComputeContext, model: SimilarModel
+    ) -> SimilarModel:
+        return dataclasses.replace(
+            model,
+            item_factors=similarity.stage_factors(model.item_factors),
+        )
+
     def predict(self, model: SimilarModel, query: dict) -> dict:
         items = query.get("items") or []
         num = int(query.get("num", 10))
@@ -150,11 +158,17 @@ class SimilarALSAlgorithm(Algorithm):
         ]
         if not idx:
             return {"itemScores": []}
-        qvec = model.item_factors[idx].mean(axis=0, keepdims=True)
         n_items = len(model.item_factors)
         k = min(1 << max(0, (num + len(idx) - 1)).bit_length(), n_items)
-        scores, cand = similarity.top_k_cosine(
-            jnp.asarray(qvec), jnp.asarray(model.item_factors), k
+        # pad the query-item indices to a power-of-two bucket (-1 = pad)
+        # so arbitrary basket sizes cannot force unbounded recompiles;
+        # mean + cosine + top-k are fused into one device dispatch that
+        # uploads only this index vector
+        bucket = 1 << max(0, (len(idx) - 1)).bit_length()
+        idx_arr = np.full(bucket, -1, np.int32)
+        idx_arr[: len(idx)] = idx
+        scores, cand = similarity.gather_mean_top_k_cosine(
+            model.item_factors, idx_arr, k
         )
         scores, cand = jax.device_get((scores, cand))  # parallel fetch
         scores, cand = scores[0], cand[0]

@@ -1,0 +1,235 @@
+"""Staged serving: factor matrices live on the device after deploy and
+are never re-uploaded per request (VERDICT round-2/3: serving used to
+pay a full catalog host→device transfer on every batch). Reference
+analogue: the deployed model stays resident in the server JVM
+(workflow/CreateServer.scala:495-647)."""
+
+from __future__ import annotations
+
+
+import jax
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.models.recommendation import (
+    ALSAlgorithm,
+    ALSParams,
+    ALSRecModel,
+    recommendation_engine,
+)
+from predictionio_tpu.ops import similarity
+from predictionio_tpu.parallel.mesh import ComputeContext
+from predictionio_tpu.utils.bimap import BiMap
+
+
+def _toy_model() -> ALSRecModel:
+    rng = np.random.default_rng(0)
+    users = [f"u{i}" for i in range(6)]
+    items = [f"i{i}" for i in range(8)]
+    return ALSRecModel(
+        user_factors=rng.normal(size=(6, 4)).astype(np.float32),
+        item_factors=rng.normal(size=(8, 4)).astype(np.float32),
+        user_map=BiMap(users),
+        item_map=BiMap(items),
+    )
+
+
+@pytest.fixture()
+def ctx():
+    return ComputeContext.create(batch="test-staging")
+
+
+class TestStageModel:
+    def test_factors_become_device_arrays(self, ctx):
+        algo = ALSAlgorithm(ALSParams())
+        staged = algo.stage_model(ctx, _toy_model())
+        assert isinstance(staged.user_factors, jax.Array)
+        assert isinstance(staged.item_factors, jax.Array)
+
+    def test_stage_is_idempotent(self, ctx):
+        algo = ALSAlgorithm(ALSParams())
+        staged = algo.stage_model(ctx, _toy_model())
+        again = algo.stage_model(ctx, staged)
+        # same device buffers — no re-upload on /reload of an unchanged
+        # model object
+        assert again.user_factors is staged.user_factors
+        assert again.item_factors is staged.item_factors
+
+    def test_batch_predict_uses_staged_arrays_verbatim(
+        self, ctx, monkeypatch
+    ):
+        """The kernel must receive the staged jax.Arrays themselves —
+        any np.ndarray here would mean a per-request catalog upload."""
+        algo = ALSAlgorithm(ALSParams())
+        staged = algo.stage_model(ctx, _toy_model())
+        seen = {}
+        real = similarity.gather_top_k_dot
+
+        def spy(factors, idx, items, num, mask=None):
+            seen["factors"], seen["items"] = factors, items
+            return real(factors, idx, items, num, mask)
+
+        monkeypatch.setattr(
+            "predictionio_tpu.models.recommendation."
+            "similarity.gather_top_k_dot",
+            spy,
+        )
+        out = algo.batch_predict(
+            staged, [{"user": "u1", "num": 3}, {"user": "u4", "num": 2}]
+        )
+        assert seen["factors"] is staged.user_factors
+        assert seen["items"] is staged.item_factors
+        assert len(out) == 2
+        assert len(out[0]["itemScores"]) == 3
+        assert len(out[1]["itemScores"]) == 2
+
+    def test_staged_and_host_predictions_agree(self, ctx):
+        algo = ALSAlgorithm(ALSParams())
+        model = _toy_model()
+        staged = algo.stage_model(ctx, model)
+        queries = [{"user": f"u{i}", "num": 4} for i in range(6)]
+        assert algo.batch_predict(model, queries) == algo.batch_predict(
+            staged, queries
+        )
+
+    def test_unknown_user_still_empty(self, ctx):
+        algo = ALSAlgorithm(ALSParams())
+        staged = algo.stage_model(ctx, _toy_model())
+        out = algo.predict(staged, {"user": "nobody", "num": 3})
+        assert out == {"itemScores": []}
+
+
+class TestDeployStages:
+    def test_prepare_deploy_returns_staged_models(
+        self, ctx, memory_storage
+    ):
+        """End to end: train via the engine, persist, prepare_deploy —
+        the deployed model's factors must be device arrays."""
+        from predictionio_tpu.data.storage import App
+
+        storage = memory_storage
+        app_id = storage.get_meta_data_apps().insert(
+            App(id=0, name="stageapp", description="")
+        )
+        events = storage.get_events()
+        events.init(app_id)
+        rng = np.random.default_rng(1)
+        for u in range(8):
+            for i in rng.choice(10, size=4, replace=False):
+                events.insert(
+                    Event(
+                        event="rate",
+                        entity_type="user",
+                        entity_id=f"u{u}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{i}",
+                        properties=DataMap({"rating": 3.0}),
+                    ),
+                    app_id,
+                )
+        engine = recommendation_engine()
+        params = engine.params_from_variant(
+            {
+                "datasource": {
+                    "params": {"app_name": "stageapp"}
+                },
+                "algorithms": [
+                    {
+                        "name": "als",
+                        "params": {"rank": 4, "num_iterations": 2},
+                    }
+                ],
+            }
+        )
+        models = engine.train(ctx, params)
+        algorithms, deployed, _serving = engine.prepare_deploy(
+            ctx, params, "inst-1", models
+        )
+        assert isinstance(deployed[0].user_factors, jax.Array)
+        assert isinstance(deployed[0].item_factors, jax.Array)
+        # and the full predict path works on the staged model
+        out = algorithms[0].predict(
+            deployed[0], {"user": "u0", "num": 3}
+        )
+        assert len(out["itemScores"]) == 3
+
+
+class TestFusedKernels:
+    """gather_top_k_dot / gather_mean_top_k_cosine vs reference math."""
+
+    def test_gather_top_k_dot_matches_numpy(self):
+        rng = np.random.default_rng(2)
+        uf = rng.normal(size=(5, 3)).astype(np.float32)
+        itf = rng.normal(size=(7, 3)).astype(np.float32)
+        idx = np.array([4, 0, 2], np.int32)
+        scores, items = jax.device_get(
+            similarity.gather_top_k_dot(uf, idx, itf, 3)
+        )
+        want = uf[idx] @ itf.T
+        for b in range(3):
+            order = np.argsort(-want[b])[:3]
+            np.testing.assert_array_equal(items[b], order)
+            np.testing.assert_allclose(
+                scores[b], want[b][order], rtol=1e-5
+            )
+
+    def test_gather_mean_top_k_cosine_ignores_padding(self):
+        rng = np.random.default_rng(3)
+        itf = rng.normal(size=(9, 4)).astype(np.float32)
+        idx_padded = np.array([2, 5, -1, -1], np.int32)
+        s_pad, c_pad = jax.device_get(
+            similarity.gather_mean_top_k_cosine(itf, idx_padded, 4)
+        )
+        s_exact, c_exact = jax.device_get(
+            similarity.gather_mean_top_k_cosine(
+                itf, np.array([2, 5], np.int32), 4
+            )
+        )
+        np.testing.assert_array_equal(c_pad, c_exact)
+        np.testing.assert_allclose(s_pad, s_exact, rtol=1e-5)
+
+    def test_ecommerce_and_similarproduct_stage(self, ctx):
+        from predictionio_tpu.models.ecommerce import (
+            ECommAlgorithm,
+            ECommAlgorithmParams,
+            ECommModel,
+        )
+        from predictionio_tpu.models.similarproduct import (
+            SimilarALSAlgorithm,
+            SimilarALSParams,
+            SimilarModel,
+        )
+
+        rng = np.random.default_rng(4)
+        ec = ECommAlgorithm(
+            ECommAlgorithmParams(unseen_only=False)
+        ).stage_model(
+            ctx,
+            ECommModel(
+                user_factors=rng.normal(size=(3, 2)).astype(np.float32),
+                item_factors=rng.normal(size=(4, 2)).astype(np.float32),
+                user_map=BiMap(["a", "b", "c"]),
+                item_map=BiMap(["w", "x", "y", "z"]),
+                item_categories={},
+                popularity=np.ones(4, np.float32),
+            ),
+        )
+        assert isinstance(ec.user_factors, jax.Array)
+        assert isinstance(ec.item_factors, jax.Array)
+        assert isinstance(ec.popularity, np.ndarray)  # host by design
+
+        sp = SimilarALSAlgorithm(SimilarALSParams()).stage_model(
+            ctx,
+            SimilarModel(
+                item_factors=rng.normal(size=(4, 2)).astype(np.float32),
+                item_map=BiMap(["w", "x", "y", "z"]),
+                item_categories={},
+            ),
+        )
+        assert isinstance(sp.item_factors, jax.Array)
+        out = SimilarALSAlgorithm(SimilarALSParams()).predict(
+            sp, {"items": ["w", "y"], "num": 2}
+        )
+        assert len(out["itemScores"]) == 2
