@@ -50,12 +50,19 @@ BENCH_VERSION = "v3-driverproof"
 MAX_TPU_ATTEMPTS = 4
 RETRY_BACKOFF_S = (10.0, 30.0, 60.0)  # between attempts
 WORKER_TIMEOUT_S = 900   # one worker run (compile ~40s + epochs)
+PREFLIGHT_TIMEOUT_S = 180  # tiny jit probe: a dead tunnel costs ≤3min,
+# not 900s (process start + jax import alone can take >90s on a loaded
+# single-core host — observed while the test suite ran concurrently)
 TOTAL_TPU_BUDGET_S = 1800  # stop retrying past this (hung-tunnel guard)
 _RETRYABLE = (
     "UNAVAILABLE",
     "Unable to initialize backend",
     "DEADLINE_EXCEEDED",
     "failed to connect",
+    # a hung worker (tunnel wedged mid-run) is as transient as a failed
+    # connect — rounds 1/2 lost their perf record because this string
+    # was not retried
+    "timed out after",
 )
 
 _CACHE = os.path.join(os.path.dirname(__file__), ".bench_cpu_baseline.json")
@@ -78,6 +85,22 @@ def make_data(scale: str):
     return rows, cols, vals
 
 
+def _phase(msg: str) -> None:
+    """Per-phase progress on stderr so a hang is diagnosable from the
+    driver's captured output (which phase died, not just 'timed out')."""
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def run_preflight() -> dict:
+    """Compile + run a trivial jit: proves backend init and the
+    dispatch path are alive before committing to the full workload."""
+    import jax
+    import jax.numpy as jnp
+
+    val = float(jax.device_get(jax.jit(jnp.sum)(jnp.arange(8.0))))
+    return {"ok": val == 28.0, "backend": jax.default_backend()}
+
+
 def run_epoch_bench(scale: str) -> dict:
     """Median per-epoch wall-clock of the fused alternating solve."""
     import jax
@@ -93,6 +116,8 @@ def run_epoch_bench(scale: str) -> dict:
     n_users, n_items, nnz, rank = WORKLOADS[scale]
     ctx = ComputeContext.create(batch="bench")
     n_data = ctx.data_parallelism
+    _phase(f"backend up ({ctx.mesh.devices.size} device(s)); generating "
+           f"{scale} data")
     rows, cols, vals = make_data(scale)
 
     t_pack = time.perf_counter()
@@ -105,6 +130,7 @@ def run_epoch_bench(scale: str) -> dict:
         row_multiple=n_data,
     )
     pack_seconds = time.perf_counter() - t_pack
+    _phase(f"pack done in {pack_seconds:.1f}s")
     run = make_train_step(ctx, user_packed, item_packed, True, 1.0)
     u_slabs, u_heavy = _device_slabs(ctx, user_packed)
     i_slabs, i_heavy = _device_slabs(ctx, item_packed)
@@ -130,17 +156,21 @@ def run_epoch_bench(scale: str) -> dict:
     args = (u_slabs, u_heavy, i_slabs, i_heavy, lam)
 
     # warmup (compile)
+    t_compile = time.perf_counter()
     x, y = run(x, y, *args, n_iters=EPOCHS_PER_DISPATCH)
     sync(y)
+    _phase(f"compile+warmup done in {time.perf_counter() - t_compile:.1f}s")
 
     times = []
-    for _ in range(TIMED_ROUNDS):
+    for r in range(TIMED_ROUNDS):
         t0 = time.perf_counter()
         x, y = run(x, y, *args, n_iters=EPOCHS_PER_DISPATCH)
         sync(y)
         times.append(
             (time.perf_counter() - t0) / EPOCHS_PER_DISPATCH
         )
+        _phase(f"round {r + 1}/{TIMED_ROUNDS}: "
+               f"{times[-1]:.4f}s/epoch")
     return {
         "seconds": float(np.median(times)),
         "pack_seconds": round(pack_seconds, 3),
@@ -163,30 +193,131 @@ def _worker_env(side: str, scale: str) -> dict:
 
 
 def _run_worker(side: str, scale: str, timeout: float):
-    """Run one measurement subprocess; return (result_dict, err_string)."""
+    """Run one measurement subprocess; return (result_dict, err_string).
+
+    The worker's stderr (the ``[bench]`` phase lines) is streamed through
+    to our stderr live — so a hang is attributable to a phase from the
+    driver's captured output — while the tail is also buffered for the
+    structured error record."""
+    import threading
+
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        env=_worker_env(side, scale),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    # each pipe gets exactly ONE reader thread — communicate() would
+    # race the stderr pump for the same fd and steal/garble lines
+    err_tail: list[str] = []
+    out_buf: list[str] = []
+
+    def _pump_err():
+        for line in proc.stderr:
+            sys.stderr.write(f"[{side}] {line}")
+            sys.stderr.flush()
+            err_tail.append(line.rstrip())
+            del err_tail[:-10]
+        proc.stderr.close()
+
+    def _pump_out():
+        out_buf.append(proc.stdout.read())
+        proc.stdout.close()
+
+    threads = [
+        threading.Thread(target=_pump_err, daemon=True),
+        threading.Thread(target=_pump_out, daemon=True),
+    ]
+    for t in threads:
+        t.start()
     try:
-        out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            env=_worker_env(side, scale),
-            capture_output=True,
-            text=True,
-            timeout=timeout,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-        )
+        proc.wait(timeout=timeout)
     except subprocess.TimeoutExpired:
-        return None, f"{side} worker timed out after {timeout}s"
-    lines = out.stdout.strip().splitlines()
-    if out.returncode == 0 and lines:
+        proc.kill()
+        proc.wait()
+        for t in threads:
+            t.join(timeout=5)
+        phase = f" (last: {err_tail[-1]})" if err_tail else ""
+        return None, f"{side} worker timed out after {timeout}s{phase}"
+    for t in threads:
+        t.join(timeout=10)
+    lines = "".join(out_buf).strip().splitlines()
+    if proc.returncode == 0 and lines:
         try:
             return json.loads(lines[-1]), None
         except ValueError:
             pass
-    tail = (out.stderr or out.stdout or "").strip().splitlines()
-    return None, " | ".join(tail[-3:]) if tail else f"rc={out.returncode}"
+    tail = err_tail or lines
+    return None, " | ".join(tail[-3:]) if tail else f"rc={proc.returncode}"
 
 
 def _retryable(err: str | None) -> bool:
     return err is not None and any(tok in err for tok in _RETRYABLE)
+
+
+def measure_tpu(
+    scale: str,
+    run_worker=None,
+    sleep=time.sleep,
+    monotonic=time.monotonic,
+):
+    """TPU measurement with pre-flight + bounded retries.
+
+    Returns ``(result, errors, cpu_clean)``: the successful TPU worker
+    result (or None), the accumulated error strings, and a clean CPU
+    measurement if the "TPU" worker silently ran on the cpu backend.
+    Injectable ``run_worker``/``sleep``/``monotonic`` so the retry logic
+    is unit-testable without subprocesses (tests/test_bench_retry.py).
+    """
+    run_worker = run_worker or _run_worker
+    errors: list[str] = []
+    cpu_clean = None
+    t_start = monotonic()
+    for attempt in range(MAX_TPU_ATTEMPTS):
+        remaining = TOTAL_TPU_BUDGET_S - (monotonic() - t_start)
+        if remaining < 60:
+            errors.append("tpu retry budget exhausted")
+            break
+        # cheap probe first: a dead tunnel fails here in ≤90s instead of
+        # hanging the full 900s workload timeout
+        probe, probe_err = run_worker(
+            "preflight", scale, timeout=min(PREFLIGHT_TIMEOUT_S, remaining)
+        )
+        if probe is None or not probe.get("ok"):
+            err = probe_err or f"preflight returned {probe}"
+            errors.append(f"attempt {attempt + 1}: preflight: {err}")
+            if not _retryable(err) or attempt == MAX_TPU_ATTEMPTS - 1:
+                break
+            sleep(RETRY_BACKOFF_S[min(attempt, len(RETRY_BACKOFF_S) - 1)])
+            continue
+        if probe.get("backend") == "cpu":
+            errors.append(
+                f"attempt {attempt + 1}: tpu worker ran on cpu backend"
+            )
+            break
+
+        remaining = TOTAL_TPU_BUDGET_S - (monotonic() - t_start)
+        result, err = run_worker(
+            "tpu", scale, timeout=min(WORKER_TIMEOUT_S, max(remaining, 60))
+        )
+        if result is not None and result.get("backend") == "cpu":
+            # the TPU plugin failed to register mid-run and JAX fell
+            # back to CPU: not a TPU number, and retrying won't change
+            # it — keep the measurement for the degraded record
+            cpu_clean = result
+            errors.append(
+                f"attempt {attempt + 1}: tpu worker ran on cpu backend"
+            )
+            break
+        if result is not None:
+            return result, errors, cpu_clean
+        errors.append(f"attempt {attempt + 1}: {err}")
+        if not _retryable(err) or attempt == MAX_TPU_ATTEMPTS - 1:
+            break
+        sleep(RETRY_BACKOFF_S[min(attempt, len(RETRY_BACKOFF_S) - 1)])
+    return None, errors, cpu_clean
 
 
 def cpu_baseline_seconds(scale: str) -> float | None:
@@ -220,40 +351,17 @@ def main() -> None:
             import jax
 
             jax.config.update("jax_platforms", "cpu")
+        if side == "preflight":
+            print(json.dumps(run_preflight()))
+            return
         print(json.dumps(run_epoch_bench(scale)))
         return
 
-    # orchestrator: retry the TPU-side worker across transient backend
-    # init failures, then fall back to CPU so the driver always parses
-    # a metric line (round 1 lost its perf record to one UNAVAILABLE).
-    errors: list[str] = []
-    result = None
-    cpu_clean = None  # a worker that cleanly ran on the cpu backend
-    t_start = time.monotonic()
-    for attempt in range(MAX_TPU_ATTEMPTS):
-        remaining = TOTAL_TPU_BUDGET_S - (time.monotonic() - t_start)
-        if remaining < 60:
-            errors.append("tpu retry budget exhausted")
-            break
-        result, err = _run_worker(
-            "tpu", scale, timeout=min(WORKER_TIMEOUT_S, remaining)
-        )
-        if result is not None and result.get("backend") == "cpu":
-            # the TPU plugin failed to register and JAX fell back to
-            # CPU: not a TPU number, and retrying won't change it —
-            # keep the measurement for the degraded record below
-            cpu_clean = result
-            errors.append(
-                f"attempt {attempt + 1}: tpu worker ran on cpu backend"
-            )
-            result = None
-            break
-        if result is not None:
-            break
-        errors.append(f"attempt {attempt + 1}: {err}")
-        if not _retryable(err) or attempt == MAX_TPU_ATTEMPTS - 1:
-            break
-        time.sleep(RETRY_BACKOFF_S[min(attempt, len(RETRY_BACKOFF_S) - 1)])
+    # orchestrator: pre-flight probe + bounded retries across transient
+    # backend failures, then fall back to CPU so the driver always
+    # parses a metric line (round 1 lost its perf record to one
+    # UNAVAILABLE; rounds 1/2 lost theirs to unretried worker hangs).
+    result, errors, cpu_clean = measure_tpu(scale)
 
     metric = "als_epoch_time" + ("_ml20m" if scale == "ml20m" else "")
     if result is not None:

@@ -1,0 +1,142 @@
+"""bench.py retry orchestration — simulated failures, no subprocesses.
+
+Guards the failure mode that erased rounds 1/2's perf records: a hung
+worker ("timed out after Ns") must be retried, a dead tunnel must fail
+fast in the pre-flight probe, and a cpu-fallback worker must not be
+recorded as a TPU number."""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench  # noqa: E402
+
+
+def _ok_probe():
+    return {"ok": True, "backend": "tpu"}, None
+
+
+def _tpu_result():
+    return {"seconds": 0.05, "backend": "tpu", "workload": "w"}, None
+
+
+class _Script:
+    """run_worker stub driven by a list of (side-prefix, response)."""
+
+    def __init__(self, responses):
+        self.responses = list(responses)
+        self.calls = []
+
+    def __call__(self, side, scale, timeout):
+        self.calls.append((side, timeout))
+        assert self.responses, f"unexpected extra call: {side}"
+        return self.responses.pop(0)
+
+
+def test_worker_timeout_is_retried():
+    """The exact round-1/2 killer: first full run hangs, next succeeds."""
+    script = _Script(
+        [
+            _ok_probe(),
+            (None, "tpu worker timed out after 900s"),  # hang
+            _ok_probe(),
+            _tpu_result(),
+        ]
+    )
+    result, errors, cpu_clean = bench.measure_tpu(
+        "default", run_worker=script, sleep=lambda s: None
+    )
+    assert result is not None and result["backend"] == "tpu"
+    assert any("timed out" in e for e in errors)
+    assert cpu_clean is None
+    sides = [s for s, _ in script.calls]
+    assert sides == ["preflight", "tpu", "preflight", "tpu"]
+
+
+def test_dead_tunnel_fails_fast_in_preflight():
+    """A wedged tunnel costs preflight timeouts (≤90s each), never the
+    900s full-workload timeout."""
+    script = _Script(
+        [
+            (None, "preflight worker timed out after 90s"),
+        ]
+        * bench.MAX_TPU_ATTEMPTS
+    )
+    result, errors, _ = bench.measure_tpu(
+        "default", run_worker=script, sleep=lambda s: None
+    )
+    assert result is None
+    assert len(errors) == bench.MAX_TPU_ATTEMPTS
+    # the expensive full worker never launched
+    assert all(side == "preflight" for side, _ in script.calls)
+    assert all(t <= bench.PREFLIGHT_TIMEOUT_S for _, t in script.calls)
+
+
+def test_non_retryable_error_stops_immediately():
+    script = _Script(
+        [
+            _ok_probe(),
+            (None, "ValueError: shapes do not match"),  # a real bug
+        ]
+    )
+    result, errors, _ = bench.measure_tpu(
+        "default", run_worker=script, sleep=lambda s: None
+    )
+    assert result is None
+    assert len(errors) == 1
+    assert len(script.calls) == 2  # no retry burned on a code bug
+
+
+def test_cpu_fallback_detected_in_preflight():
+    """Plugin silently fell back to cpu: stop, don't fake a TPU number."""
+    script = _Script([({"ok": True, "backend": "cpu"}, None)])
+    result, errors, cpu_clean = bench.measure_tpu(
+        "default", run_worker=script, sleep=lambda s: None
+    )
+    assert result is None
+    assert any("cpu backend" in e for e in errors)
+    assert [s for s, _ in script.calls] == ["preflight"]
+
+
+def test_cpu_fallback_midrun_keeps_measurement():
+    script = _Script(
+        [
+            _ok_probe(),
+            ({"seconds": 1.2, "backend": "cpu", "workload": "w"}, None),
+        ]
+    )
+    result, errors, cpu_clean = bench.measure_tpu(
+        "default", run_worker=script, sleep=lambda s: None
+    )
+    assert result is None
+    assert cpu_clean is not None and cpu_clean["seconds"] == 1.2
+
+
+def test_budget_exhaustion_stops_retries():
+    clock = {"t": 0.0}
+
+    def monotonic():
+        return clock["t"]
+
+    def run_worker(side, scale, timeout):
+        clock["t"] += 1000.0  # every call burns past half the budget
+        return None, "connection UNAVAILABLE"
+
+    result, errors, _ = bench.measure_tpu(
+        "default",
+        run_worker=run_worker,
+        sleep=lambda s: None,
+        monotonic=monotonic,
+    )
+    assert result is None
+    assert errors[-1] == "tpu retry budget exhausted"
+
+
+def test_retryable_tokens():
+    assert bench._retryable("x timed out after 900s")
+    assert bench._retryable("backend UNAVAILABLE")
+    assert not bench._retryable("AssertionError: wrong answer")
+    assert not bench._retryable(None)
