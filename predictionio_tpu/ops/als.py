@@ -145,16 +145,23 @@ class Bucketed:
 
     ``slabs`` hold rows with ≤ ``s_max`` blocks (one slot row each,
     phantom rows appended so each slab splits evenly over the mesh).
-    ``heavy`` holds the sub-row slabs of rows heavier than ``s_max``
-    blocks; ``heavy_owner_pos`` maps each sub-row to its owner's
-    position in the concatenated stats layout. ``inv_perm[row]`` is the
-    row's position in that layout (heavy rows own one zero-initialized
-    slot each, after all regular slab rows).
+    ``heavy`` holds the sub-row slab groups of rows heavier than
+    ``s_max`` blocks; ``heavy_owner_pos[g]`` maps each sub-row of group
+    ``g`` to its owner's position in the concatenated stats layout.
+    ``inv_perm[row]`` is the row's position in that layout (heavy rows
+    own one zero-initialized slot each, after all regular slab rows).
+
+    Slabs (regular and heavy) are split so no single slab exceeds
+    ``max_slab_slots`` slots: the per-slab factor gather materializes a
+    ``[R·W, k]`` temp whose lane padding XLA rounds up to 128, so an
+    uncapped slab at MovieLens-20M scale allocates >15 GB of HBM for
+    one gather. Splitting bounds the peak temp; the concatenated stats
+    layout (and therefore ``inv_perm``) is unchanged by the split.
     """
 
     slabs: list[Slab]
-    heavy: Slab | None
-    heavy_owner_pos: np.ndarray | None  # [R_sub] int32
+    heavy: list[Slab]
+    heavy_owner_pos: list[np.ndarray]   # per group: [R_sub] int32
     inv_perm: np.ndarray                # [n_rows_padded] int32
     n_stat_rows: int                    # rows in the concatenated layout
     n_rows: int
@@ -163,9 +170,21 @@ class Bucketed:
     @property
     def padded_nnz(self) -> int:
         total = sum(s.idx.size for s in self.slabs)
-        if self.heavy is not None:
-            total += self.heavy.idx.size
+        total += sum(h.idx.size for h in self.heavy)
         return total
+
+
+def _split_rows(arrays: tuple, rows_per_group: int) -> list[tuple]:
+    """Split row-aligned arrays into groups of ≤ ``rows_per_group`` rows
+    (host-side; slicing preserves global row order, so stats layouts are
+    unaffected)."""
+    n = arrays[0].shape[0]
+    if n <= rows_per_group:
+        return [arrays]
+    return [
+        tuple(a[i:i + rows_per_group] for a in arrays)
+        for i in range(0, n, rows_per_group)
+    ]
 
 
 def build_bucketed(
@@ -176,6 +195,7 @@ def build_bucketed(
     block_len: int = 64,
     row_multiple: int = 1,
     s_max: int = 16,
+    max_slab_slots: int = 2 << 20,
 ) -> Bucketed:
     """Pack COO → degree-bucketed slabs (vectorized host preprocessing).
 
@@ -183,10 +203,16 @@ def build_bucketed(
     ``s ≤ s_max``); a bucket's slab is a dense ``[R_b, s·block_len]``
     array where row ``j`` holds that entity's entire interaction list
     (zero-padded). Rows needing more than ``s_max`` blocks are split
-    into sub-rows of width ``s_max·block_len`` in the ``heavy`` slab.
+    into sub-rows of width ``s_max·block_len`` in the ``heavy`` slabs.
+    No slab exceeds ``max_slab_slots`` (= R·W) slots — the HBM bound on
+    the per-slab factor-gather temp (see :class:`Bucketed`).
     """
     if block_len < 1 or s_max < 1:
         raise ValueError("block_len and s_max must be ≥ 1")
+
+    def rows_per_group(width: int) -> int:
+        per = max(1, max_slab_slots // width) // row_multiple
+        return max(1, per) * row_multiple
     n_rows_padded = max(
         row_multiple, -(-n_rows // row_multiple) * row_multiple
     )
@@ -236,13 +262,16 @@ def build_bucketed(
         slab.idx[lr, pos] = c[sel]
         slab.weights[lr, pos] = v[sel]
         slab.valid[lr, pos] = 1.0
-        slabs.append(slab)
+        for g_idx, g_wt, g_vd in _split_rows(
+            (slab.idx, slab.weights, slab.valid), rows_per_group(width)
+        ):
+            slabs.append(Slab(idx=g_idx, weights=g_wt, valid=g_vd))
         inv_perm[members] = offset + np.arange(len(members))
         offset += rb
 
     heavy_rows = row_ids[is_heavy]
-    heavy = None
-    heavy_owner_pos = None
+    heavy: list[Slab] = []
+    heavy_owner_pos: list[np.ndarray] = []
     if len(heavy_rows):
         # one stats slot per heavy row, after all regular slab rows
         inv_perm[heavy_rows] = offset + np.arange(len(heavy_rows))
@@ -252,7 +281,7 @@ def build_bucketed(
         rb = max(
             row_multiple, -(-n_sub // row_multiple) * row_multiple
         )
-        heavy = Slab(
+        h = Slab(
             idx=np.zeros((rb, width), np.int32),
             weights=np.zeros((rb, width), np.float32),
             valid=np.zeros((rb, width), np.float32),
@@ -264,14 +293,19 @@ def build_bucketed(
         sel = is_heavy[r]
         sub = sub_base[r[sel]] + idx_in_row[sel] // width
         pos = idx_in_row[sel] % width
-        heavy.idx[sub, pos] = c[sel]
-        heavy.weights[sub, pos] = v[sel]
-        heavy.valid[sub, pos] = 1.0
-        heavy_owner_pos = np.zeros(rb, np.int32)
-        heavy_owner_pos[:n_sub] = np.repeat(
+        h.idx[sub, pos] = c[sel]
+        h.weights[sub, pos] = v[sel]
+        h.valid[sub, pos] = 1.0
+        owner = np.zeros(rb, np.int32)
+        owner[:n_sub] = np.repeat(
             inv_perm[heavy_rows], nsub_of
         ).astype(np.int32)
         # phantom sub-rows have zero valid/weights: owner 0 is harmless
+        for g_idx, g_wt, g_vd, g_own in _split_rows(
+            (h.idx, h.weights, h.valid, owner), rows_per_group(width)
+        ):
+            heavy.append(Slab(idx=g_idx, weights=g_wt, valid=g_vd))
+            heavy_owner_pos.append(g_own)
         offset += len(heavy_rows)
 
     return Bucketed(
@@ -373,7 +407,7 @@ def _solve(a, b, cnt, yty, lam, implicit, k, dtype):
 
 
 def _assemble_and_solve(
-    y, slab_arrays, heavy_arrays, heavy_owner, n_heavy_slots,
+    y, slab_arrays, heavy_groups, n_heavy_slots,
     implicit, alpha, lam,
 ):
     """Shared one-direction solve body: slab stats → heavy scatter-add →
@@ -381,6 +415,10 @@ def _assemble_and_solve(
     (GSPMD-constrained) and model-sharded (shard_map) paths — the only
     difference between them is where ``y`` comes from and how the solved
     stats rows are reassembled into factor layout.
+
+    ``heavy_groups`` is a sequence of ``(idx, weights, valid, owner)``
+    sub-row slab groups (possibly several — build_bucketed caps slab
+    size to bound the factor-gather temp).
     """
     k = y.shape[1]
     dtype = y.dtype
@@ -399,12 +437,11 @@ def _assemble_and_solve(
     a = jnp.concatenate(parts_a, axis=0)
     b = jnp.concatenate(parts_b, axis=0)
     cnt = jnp.concatenate(parts_cnt, axis=0)
-    if heavy_arrays:
-        idx, weights, valid = heavy_arrays
+    for (idx, weights, valid, owner) in heavy_groups:
         ha, hb, hcnt = _slab_stats(
             y, idx, weights, valid, implicit, alpha, dtype
         )
-        owner = jnp.asarray(heavy_owner)
+        owner = jnp.asarray(owner)
         # few sub-rows (head of the power law): small scatter-add
         a = a.at[owner].add(ha)
         b = b.at[owner].add(hb)
@@ -436,12 +473,16 @@ def make_bucketed_solver(
         packed.n_stat_rows
         - sum(s.idx.shape[0] for s in packed.slabs)
     )
-    heavy_owner = packed.heavy_owner_pos
+    heavy_owners = packed.heavy_owner_pos
     replicated = ctx.replicated
 
     def solve(y, slab_arrays, heavy_arrays, lam):
+        heavy_groups = [
+            (idx, wt, vd, owner)
+            for (idx, wt, vd), owner in zip(heavy_arrays, heavy_owners)
+        ]
         x_stats = _assemble_and_solve(
-            y, slab_arrays, heavy_arrays, heavy_owner, n_heavy_slots,
+            y, slab_arrays, heavy_groups, n_heavy_slots,
             implicit, alpha, lam,
         )
         x = jnp.take(x_stats, jnp.asarray(inv_perm), axis=0)
@@ -455,10 +496,9 @@ def _device_slabs(ctx: ComputeContext, packed: Bucketed):
     slabs = tuple(
         (put(s.idx), put(s.weights), put(s.valid)) for s in packed.slabs
     )
-    heavy = None
-    if packed.heavy is not None:
-        h = packed.heavy
-        heavy = (put(h.idx), put(h.weights), put(h.valid))
+    heavy = tuple(
+        (put(h.idx), put(h.weights), put(h.valid)) for h in packed.heavy
+    )
     return slabs, heavy
 
 
@@ -570,11 +610,20 @@ def plan_shards(packed: Bucketed, n_shards: int) -> ShardPlan:
     owner_local = None
     h_slots_per = 0
     slot_local: dict[int, tuple[int, int]] = {}
-    heavy = packed.heavy
+    heavy = None
+    if packed.heavy:
+        # regrouping is by owner anyway: merge the slot-capped groups
+        # back into one host-side slab first
+        heavy = Slab(
+            idx=np.concatenate([h.idx for h in packed.heavy]),
+            weights=np.concatenate([h.weights for h in packed.heavy]),
+            valid=np.concatenate([h.valid for h in packed.heavy]),
+        )
+        owner_all = np.concatenate(packed.heavy_owner_pos)
     if heavy is not None:
         real = heavy.valid.any(axis=1)
         real_rows = np.nonzero(real)[0]
-        owners_glob = packed.heavy_owner_pos[real_rows].astype(np.int64)
+        owners_glob = owner_all[real_rows].astype(np.int64)
         slots, slot_counts = np.unique(owners_glob, return_counts=True)
         # greedy balance: heaviest slot first onto the lightest shard
         shard_sub = np.zeros(n_shards, np.int64)
@@ -674,10 +723,9 @@ def _sharded_half(
     slots are device-local stats positions by construction (ShardPlan),
     so the scatter-add needs no collective.
     """
-    heavy_triple = side_heavy[:3] if side_heavy else None
-    heavy_owner = side_heavy[3] if side_heavy else None
+    heavy_groups = [side_heavy] if side_heavy else []
     x_stats = _assemble_and_solve(
-        y_full, side_slabs, heavy_triple, heavy_owner, n_heavy_local,
+        y_full, side_slabs, heavy_groups, n_heavy_local,
         implicit, alpha, lam,
     )
     # device-major reassembly: model (minor) then data (major) matches
@@ -858,6 +906,7 @@ def train_als(
     block_len: int = 64,
     row_chunk: int = 1024,
     s_max: int = 16,
+    max_slab_slots: int = 2 << 20,
     dtype=jnp.float32,
     timer=None,
     checkpoint_dir: str | None = None,
@@ -899,10 +948,12 @@ def train_als(
     user_packed = build_bucketed(
         user_ids, item_ids, values, n_users,
         block_len=block_len, row_multiple=row_multiple, s_max=s_max,
+        max_slab_slots=max_slab_slots,
     )
     item_packed = build_bucketed(
         item_ids, user_ids, values, n_items,
         block_len=block_len, row_multiple=row_multiple, s_max=s_max,
+        max_slab_slots=max_slab_slots,
     )
 
     # init at the logical item count (mesh-size independent), zero padding
