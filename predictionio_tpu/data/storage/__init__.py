@@ -136,19 +136,26 @@ def _register_builtins() -> None:
 
     from predictionio_tpu.data.storage import sql_common
 
+    def _mysql_client(config: dict):
+        from predictionio_tpu.data.storage import mysql
+
+        return mysql.MySQLClient(config)
+
+    _sql_daos = dict(
+        apps=sql_common.SQLApps,
+        access_keys=sql_common.SQLAccessKeys,
+        channels=sql_common.SQLChannels,
+        engine_instances=sql_common.SQLEngineInstances,
+        engine_manifests=sql_common.SQLEngineManifests,
+        evaluation_instances=sql_common.SQLEvaluationInstances,
+        models=sql_common.SQLModels,
+        events=sql_common.SQLEvents,
+    )
     register_backend(
-        "postgres",
-        BackendSpec(
-            client=_postgres_client,
-            apps=sql_common.SQLApps,
-            access_keys=sql_common.SQLAccessKeys,
-            channels=sql_common.SQLChannels,
-            engine_instances=sql_common.SQLEngineInstances,
-            engine_manifests=sql_common.SQLEngineManifests,
-            evaluation_instances=sql_common.SQLEvaluationInstances,
-            models=sql_common.SQLModels,
-            events=sql_common.SQLEvents,
-        ),
+        "postgres", BackendSpec(client=_postgres_client, **_sql_daos)
+    )
+    register_backend(
+        "mysql", BackendSpec(client=_mysql_client, **_sql_daos)
     )
     # native C++ event log (events only, like the reference's hbase
     # backend); registered lazily — the .so builds on first client use

@@ -64,12 +64,16 @@ def from_iso(s: str) -> _dt.datetime:
 class SQLDialect(abc.ABC):
     """Database-specific syntax and driver error classes."""
 
-    #: "?" (sqlite/qmark) or "%s" (postgres/format)
+    #: "?" (sqlite/qmark) or "%s" (postgres/mysql/format)
     placeholder: str = "?"
     #: column definition for an autoincrementing integer primary key
     autoinc_pk: str = "INTEGER PRIMARY KEY AUTOINCREMENT"
     #: binary blob column type
     blob_type: str = "BLOB"
+    #: column type for primary-key / unique / indexed text columns —
+    #: MySQL cannot index a bare TEXT column (needs a sized VARCHAR);
+    #: sqlite/postgres keep TEXT
+    key_text: str = "TEXT"
     #: driver exception types for unique/PK violations
     integrity_errors: tuple = ()
     #: driver exception types for missing tables etc.
@@ -90,6 +94,12 @@ class SQLDialect(abc.ABC):
                        values: Sequence[Any]) -> int:
         """Insert a row whose integer PK is database-assigned; return it."""
         raise NotImplementedError
+
+    def create_index(self, name: str, table: str, cols: str) -> str:
+        """Idempotent index creation. MySQL has no IF NOT EXISTS for
+        CREATE INDEX — its dialect emits the plain statement and
+        ``SQLEvents.init`` swallows the duplicate-index error."""
+        return f"CREATE INDEX IF NOT EXISTS {name} ON {table} ({cols})"
 
 
 class SQLClient(abc.ABC):
@@ -164,43 +174,44 @@ class SQLClient(abc.ABC):
     # -- schema -----------------------------------------------------------
     def metadata_schema_statements(self) -> list[str]:
         d = self.dialect
+        kt = d.key_text
         return [
             f"""CREATE TABLE IF NOT EXISTS apps (
                   id {d.autoinc_pk},
-                  name TEXT UNIQUE NOT NULL,
+                  name {kt} UNIQUE NOT NULL,
                   description TEXT)""",
-            """CREATE TABLE IF NOT EXISTS access_keys (
-                  key TEXT PRIMARY KEY,
+            f"""CREATE TABLE IF NOT EXISTS access_keys (
+                  access_key {kt} PRIMARY KEY,
                   appid INTEGER NOT NULL,
                   events TEXT NOT NULL)""",
             f"""CREATE TABLE IF NOT EXISTS channels (
                   id {d.autoinc_pk},
-                  name TEXT NOT NULL,
+                  name {kt} NOT NULL,
                   appid INTEGER NOT NULL,
                   UNIQUE(name, appid))""",
-            """CREATE TABLE IF NOT EXISTS engine_instances (
-                  id TEXT PRIMARY KEY,
+            f"""CREATE TABLE IF NOT EXISTS engine_instances (
+                  id {kt} PRIMARY KEY,
                   status TEXT, start_time TEXT, end_time TEXT,
                   engine_id TEXT, engine_version TEXT, engine_variant TEXT,
                   engine_factory TEXT, batch TEXT, env TEXT, mesh_conf TEXT,
                   data_source_params TEXT, preparator_params TEXT,
                   algorithms_params TEXT, serving_params TEXT)""",
-            """CREATE TABLE IF NOT EXISTS evaluation_instances (
-                  id TEXT PRIMARY KEY,
+            f"""CREATE TABLE IF NOT EXISTS evaluation_instances (
+                  id {kt} PRIMARY KEY,
                   status TEXT, start_time TEXT, end_time TEXT,
                   evaluation_class TEXT, engine_params_generator_class TEXT,
                   batch TEXT, env TEXT, evaluator_results TEXT,
                   evaluator_results_html TEXT, evaluator_results_json TEXT)""",
-            """CREATE TABLE IF NOT EXISTS engine_manifests (
-                  id TEXT NOT NULL,
-                  version TEXT NOT NULL,
+            f"""CREATE TABLE IF NOT EXISTS engine_manifests (
+                  id {kt} NOT NULL,
+                  version {kt} NOT NULL,
                   name TEXT NOT NULL,
                   description TEXT,
                   files TEXT NOT NULL,
                   engine_factory TEXT NOT NULL,
                   PRIMARY KEY (id, version))""",
             f"""CREATE TABLE IF NOT EXISTS models (
-                  id TEXT PRIMARY KEY,
+                  id {kt} PRIMARY KEY,
                   models {d.blob_type} NOT NULL)""",
         ]
 
@@ -208,6 +219,27 @@ class SQLClient(abc.ABC):
         with self._init_lock:
             for stmt in self.metadata_schema_statements():
                 self.execute(stmt)
+            self._migrate_access_key_column()
+
+    def _migrate_access_key_column(self) -> None:
+        """Databases created before the MySQL dialect landed have
+        ``access_keys.key`` (a MySQL reserved word); rename in place so
+        existing sqlite/postgres stores keep working."""
+        try:
+            self.query("SELECT access_key FROM access_keys LIMIT 1")
+            return  # current schema
+        except Exception:  # noqa: BLE001 - probe only
+            pass
+        try:
+            self.execute(
+                "ALTER TABLE access_keys RENAME COLUMN key TO access_key"
+            )
+        except Exception as exc:  # noqa: BLE001
+            raise RuntimeError(
+                "access_keys table has a legacy 'key' column and "
+                "automatic rename failed; run: ALTER TABLE access_keys "
+                "RENAME COLUMN key TO access_key"
+            ) from exc
 
     def event_table(self, app_id: int, channel_id: int | None) -> str:
         # Reference JDBC table naming: <namespace>_<appId>[_<channelId>]
@@ -216,23 +248,26 @@ class SQLClient(abc.ABC):
         )
 
     def event_schema_statements(self, table: str) -> list[str]:
+        kt = self.dialect.key_text
         return [
             f"""CREATE TABLE IF NOT EXISTS {table} (
-                  id TEXT PRIMARY KEY,
+                  id {kt} PRIMARY KEY,
                   event TEXT NOT NULL,
-                  entity_type TEXT NOT NULL,
-                  entity_id TEXT NOT NULL,
+                  entity_type {kt} NOT NULL,
+                  entity_id {kt} NOT NULL,
                   target_entity_type TEXT,
                   target_entity_id TEXT,
                   properties TEXT NOT NULL,
-                  event_time TEXT NOT NULL,
+                  event_time {kt} NOT NULL,
                   tags TEXT NOT NULL,
                   pr_id TEXT,
                   creation_time TEXT NOT NULL)""",
-            f"CREATE INDEX IF NOT EXISTS {table}_time "
-            f"ON {table} (event_time)",
-            f"CREATE INDEX IF NOT EXISTS {table}_entity "
-            f"ON {table} (entity_type, entity_id)",
+            self.dialect.create_index(
+                f"{table}_time", table, "event_time"
+            ),
+            self.dialect.create_index(
+                f"{table}_entity", table, "entity_type, entity_id"
+            ),
         ]
 
 
@@ -303,7 +338,7 @@ class SQLAccessKeys(AccessKeysBackend):
         key = access_key.key or self.generate_key()
         try:
             self._c.execute(
-                "INSERT INTO access_keys (key, appid, events) "
+                "INSERT INTO access_keys (access_key, appid, events) "
                 "VALUES (?,?,?)",
                 (key, access_key.appid,
                  json.dumps(list(access_key.events))),
@@ -319,7 +354,8 @@ class SQLAccessKeys(AccessKeysBackend):
 
     def get(self, key: str) -> AccessKey | None:
         r = self._c.query_one(
-            "SELECT key, appid, events FROM access_keys WHERE key=?",
+            "SELECT access_key, appid, events FROM access_keys "
+            "WHERE access_key=?",
             (key,),
         )
         return self._row(r) if r else None
@@ -328,7 +364,7 @@ class SQLAccessKeys(AccessKeysBackend):
         return [
             self._row(r)
             for r in self._c.query(
-                "SELECT key, appid, events FROM access_keys"
+                "SELECT access_key, appid, events FROM access_keys"
             )
         ]
 
@@ -336,14 +372,14 @@ class SQLAccessKeys(AccessKeysBackend):
         return [
             self._row(r)
             for r in self._c.query(
-                "SELECT key, appid, events FROM access_keys WHERE appid=?",
+                "SELECT access_key, appid, events FROM access_keys WHERE appid=?",
                 (app_id,),
             )
         ]
 
     def update(self, access_key: AccessKey) -> bool:
         return self._c.execute(
-            "UPDATE access_keys SET appid=?, events=? WHERE key=?",
+            "UPDATE access_keys SET appid=?, events=? WHERE access_key=?",
             (
                 access_key.appid,
                 json.dumps(list(access_key.events)),
@@ -353,7 +389,7 @@ class SQLAccessKeys(AccessKeysBackend):
 
     def delete(self, key: str) -> bool:
         return self._c.execute(
-            "DELETE FROM access_keys WHERE key=?", (key,)
+            "DELETE FROM access_keys WHERE access_key=?", (key,)
         ) > 0
 
 
@@ -644,7 +680,21 @@ class SQLEvents(EventsBackend):
     def init(self, app_id: int, channel_id: int | None = None) -> bool:
         t = self._c.event_table(app_id, channel_id)
         for stmt in self._c.event_schema_statements(t):
-            self._c.execute(stmt)
+            try:
+                self._c.execute(stmt)
+            except Exception as exc:
+                # Only the non-idempotent CREATE INDEX form (MySQL has
+                # no IF NOT EXISTS) may fail on re-init, and only with
+                # the duplicate-key-name error (errno 1061); anything
+                # else — on any statement — is a real problem.
+                upper = stmt.lstrip().upper()
+                duplicate = "1061" in str(exc) or "uplicate" in str(exc)
+                if not (
+                    upper.startswith("CREATE INDEX")
+                    and "IF NOT EXISTS" not in upper
+                    and duplicate
+                ):
+                    raise
         return True
 
     def remove(self, app_id: int, channel_id: int | None = None) -> bool:

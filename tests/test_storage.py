@@ -333,6 +333,36 @@ class TestRegistry:
             storage.get_events()
 
 
+class TestLegacySchemaMigration:
+    def test_access_key_column_renamed_in_place(self, tmp_path):
+        """Databases created before the MySQL dialect had
+        ``access_keys.key``; opening them must migrate, not break."""
+        import sqlite3
+
+        path = str(tmp_path / "legacy.sqlite")
+        conn = sqlite3.connect(path)
+        conn.execute(
+            "CREATE TABLE access_keys ("
+            "key TEXT PRIMARY KEY, appid INTEGER NOT NULL, "
+            "events TEXT NOT NULL)"
+        )
+        conn.execute(
+            "INSERT INTO access_keys VALUES ('legacy-key', 7, '[]')"
+        )
+        conn.commit()
+        conn.close()
+        storage = Storage(
+            env={
+                "PIO_STORAGE_SOURCES_SQL_TYPE": "sqlite",
+                "PIO_STORAGE_SOURCES_SQL_PATH": path,
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQL",
+            }
+        )
+        keys = storage.get_meta_data_access_keys()
+        got = keys.get("legacy-key")
+        assert got is not None and got.appid == 7
+
+
 class TestReviewRegressions:
     """Regression tests for the round-1 code-review findings."""
 
