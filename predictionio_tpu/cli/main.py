@@ -364,18 +364,18 @@ def cmd_deploy(args) -> int:
 
 
 def cmd_undeploy(args) -> int:
-    import urllib.request
+    from predictionio_tpu.serving.config import ServerConfig
+    from predictionio_tpu.serving.engine_server import undeploy_existing
 
-    url = f"http://{args.ip}:{args.port}/stop"
-    try:
-        with urllib.request.urlopen(
-            urllib.request.Request(url, method="POST"), timeout=10
-        ) as resp:
-            print(resp.read().decode())
-    except Exception as e:  # noqa: BLE001
-        print(f"Undeploy failed: {e}", file=sys.stderr)
-        return 1
-    return 0
+    if undeploy_existing(args.ip, args.port, ServerConfig.from_env()):
+        print(f"Undeployed engine server at {args.ip}:{args.port}")
+        return 0
+    print(
+        f"Undeploy failed: no engine server stopped at "
+        f"{args.ip}:{args.port}",
+        file=sys.stderr,
+    )
+    return 1
 
 
 def cmd_eventserver(args) -> int:
@@ -585,6 +585,88 @@ def cmd_launch(args) -> int:
     )
 
 
+def cmd_minipg(args) -> int:
+    """Foreground minipg server (the postgres-wire dev store); usually
+    run daemonized via ``start-all --with-minipg``."""
+    import signal as _signal
+
+    from predictionio_tpu.cli import daemon
+    from predictionio_tpu.data.storage.minipg import MiniPGServer
+
+    path = args.path or os.path.join(daemon.base_dir(), "minipg.db")
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    server = MiniPGServer(
+        path=path,
+        host=args.ip,
+        port=args.port,
+        password=args.password,
+    )
+    port = server.start()
+    print(f"minipg is listening on {args.ip}:{port}")
+    try:
+        _signal.pause()
+    except (KeyboardInterrupt, AttributeError):
+        pass
+    server.stop()
+    return 0
+
+
+def cmd_start_all(args) -> int:
+    """Reference bin/pio-start-all: bring up the serving daemons."""
+    from predictionio_tpu.cli import daemon
+
+    ports = {}
+    if args.eventserver_port:
+        ports["eventserver"] = args.eventserver_port
+    if args.dashboard_port:
+        ports["dashboard"] = args.dashboard_port
+    if args.adminserver_port:
+        ports["adminserver"] = args.adminserver_port
+    if args.minipg_port:
+        ports["minipg"] = args.minipg_port
+    return daemon.start_all(
+        ip=args.ip,
+        ports=ports,
+        # an explicit minipg port is an explicit ask for minipg
+        with_minipg=args.with_minipg or bool(args.minipg_port),
+    )
+
+
+def cmd_stop_all(args) -> int:
+    """Reference bin/pio-stop-all."""
+    from predictionio_tpu.cli import daemon
+
+    return daemon.stop_all()
+
+
+def cmd_daemons(args) -> int:
+    """Daemon liveness report (exit 0 iff all running)."""
+    from predictionio_tpu.cli import daemon
+
+    return daemon.status_all()
+
+
+def cmd_daemon(args) -> int:
+    """Run ANY console verb as a managed background daemon
+    (reference bin/pio-daemon: nohup + pidfile)."""
+    from predictionio_tpu.cli import daemon
+
+    argv = list(args.cmd)
+    if argv and argv[0] == "--":
+        argv = argv[1:]
+    if not argv:
+        print("error: daemon needs a verb to run", file=sys.stderr)
+        return 1
+    name = args.name or f"daemon-{argv[0]}"
+    state, pid = daemon.service_status(name)
+    if state == "running":
+        print(f"{name}: already running (pid {pid})", file=sys.stderr)
+        return 1
+    pid = daemon.spawn_daemon(name, argv)
+    print(f"{name}: started (pid {pid}, log {daemon.logfile(name)})")
+    return 0
+
+
 # -- parser ----------------------------------------------------------------
 
 
@@ -750,6 +832,33 @@ def build_parser() -> argparse.ArgumentParser:
         help="command to run (script.py, module:fn, or full argv after --)",
     )
     p.set_defaults(func=cmd_launch)
+
+    p = sub.add_parser("minipg")
+    p.add_argument("--ip", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=5432)
+    p.add_argument("--path", default="")
+    p.add_argument("--password", default=None)
+    p.set_defaults(func=cmd_minipg)
+
+    p = sub.add_parser("start-all")
+    p.add_argument("--ip", default="0.0.0.0")
+    p.add_argument("--eventserver-port", type=int, default=0)
+    p.add_argument("--dashboard-port", type=int, default=0)
+    p.add_argument("--adminserver-port", type=int, default=0)
+    p.add_argument("--with-minipg", action="store_true")
+    p.add_argument("--minipg-port", type=int, default=0)
+    p.set_defaults(func=cmd_start_all)
+
+    sub.add_parser("stop-all").set_defaults(func=cmd_stop_all)
+    sub.add_parser("daemons").set_defaults(func=cmd_daemons)
+
+    p = sub.add_parser("daemon")
+    p.add_argument("--name", default="")
+    p.add_argument(
+        "cmd", nargs=argparse.REMAINDER,
+        help="console verb + args to daemonize (after --)",
+    )
+    p.set_defaults(func=cmd_daemon)
 
     return parser
 

@@ -3,8 +3,8 @@
 Capability parity with the reference's ServerActor/MasterActor
 (core/.../workflow/CreateServer.scala:266-718), default port 8000:
 
-* ``GET  /``             → status (engine info, request count, latencies —
-  the twirl status page's data as JSON)
+* ``GET  /``             → status: JSON by default, the HTML status page
+  (twirl index.scala.html) when the client prefers ``text/html``
 * ``POST /queries.json`` → the predict hot path (:495-647): parse query →
   ``serving.supplement`` → per-algorithm predict → ``serving.serve`` →
   JSON; optional feedback loop storing a ``predict`` event with a
@@ -22,10 +22,14 @@ code.
 from __future__ import annotations
 
 import datetime as _dt
+import html as _html
 import logging
 import secrets
 import threading
 import time
+import urllib.error
+import urllib.parse
+import urllib.request
 
 from predictionio_tpu.core.engine import Engine, EngineParams
 from predictionio_tpu.core.workflow import load_deployment
@@ -202,22 +206,97 @@ class EngineServer:
         )
 
     # -- routes -----------------------------------------------------------
-    def _status(self, request: Request) -> Response:
+    def _status_data(self) -> dict:
         with self._lock:
+            return {
+                "status": "alive",
+                "engineId": self._engine_id,
+                "engineVersion": self._engine_version,
+                "engineVariant": self._engine_variant,
+                "engineInstanceId": self._instance.id,
+                "trainingStartTime": self._instance.start_time.isoformat(),
+                "trainingEndTime": self._instance.end_time.isoformat(),
+                "startTime": self._start_time.isoformat(),
+                "requestCount": self._request_count,
+                "avgServingSec": round(self._avg_serving_sec, 6),
+                "lastServingSec": round(self._last_serving_sec, 6),
+            }
+
+    def _status(self, request: Request) -> Response:
+        data = self._status_data()
+        accept = request.headers.get("Accept") or ""
+        if "text/html" in accept:
+            # content-negotiated status page (reference twirl template,
+            # core/.../workflow/index.scala.html rendered by ServerActor
+            # on GET /)
             return Response(
-                200,
-                {
-                    "status": "alive",
-                    "engineId": self._engine_id,
-                    "engineVersion": self._engine_version,
-                    "engineVariant": self._engine_variant,
-                    "engineInstanceId": self._instance.id,
-                    "startTime": self._start_time.isoformat(),
-                    "requestCount": self._request_count,
-                    "avgServingSec": round(self._avg_serving_sec, 6),
-                    "lastServingSec": round(self._last_serving_sec, 6),
-                },
+                200, self._status_html(data), content_type="text/html"
             )
+        return Response(200, data)
+
+    def _status_html(self, data: dict) -> str:
+        e = _html.escape
+
+        def table(rows: list[tuple[str, str]]) -> str:
+            return "<table>" + "".join(
+                f"<tr><th>{e(k)}</th><td>{e(v)}</td></tr>"
+                for k, v in rows
+            ) + "</table>"
+
+        def params_rows(named) -> list[tuple[str, str]]:
+            name, params = named
+            return [("Class", name or type(params).__name__),
+                    ("Parameters", repr(params))]
+
+        p = self._params
+        algo_rows: list[tuple[str, str]] = []
+        for i, (name, params) in enumerate(p.algorithms):
+            algo_rows.append((f"Algorithm {i}", name))
+            algo_rows.append((f"Algorithm {i} Parameters", repr(params)))
+        title = (
+            f"{e(self._engine_id)} ({e(self._engine_variant)}) - "
+            "Engine Server"
+        )
+        return f"""<!DOCTYPE html>
+<html lang="en">
+  <head>
+    <title>{title}</title>
+    <style>
+      body {{ font-family: sans-serif; margin: 2em; }}
+      table {{ border-collapse: collapse; margin-bottom: 1.5em; }}
+      th, td {{ border: 1px solid #ccc; padding: 4px 10px;
+               font-family: monospace; text-align: left; }}
+      th {{ background: #f3f3f3; }}
+    </style>
+  </head>
+  <body>
+    <h1>Engine Server</h1>
+    <p>{e(self._engine_id)} {e(self._engine_version)}
+       ({e(self._engine_variant)})</p>
+    <h2>Engine Information</h2>
+    {table([
+        ("Training Start Time", data["trainingStartTime"]),
+        ("Training End Time", data["trainingEndTime"]),
+        ("Variant ID", data["engineVariant"]),
+        ("Instance ID", data["engineInstanceId"]),
+    ])}
+    <h2>Server Information</h2>
+    {table([
+        ("Start Time", data["startTime"]),
+        ("Request Count", str(data["requestCount"])),
+        ("Average Serving Time", f'{data["avgServingSec"]} seconds'),
+        ("Last Serving Time", f'{data["lastServingSec"]} seconds'),
+    ])}
+    <h2>Data Source</h2>
+    {table(params_rows(p.data_source))}
+    <h2>Data Preparator</h2>
+    {table(params_rows(p.preparator))}
+    <h2>Algorithms</h2>
+    {table(algo_rows)}
+    <h2>Serving</h2>
+    {table(params_rows(p.serving))}
+  </body>
+</html>"""
 
     def _queries(self, request: Request) -> Response:
         t0 = time.perf_counter()
@@ -317,22 +396,99 @@ class EngineServer:
         return Response(200, {"message": "stopping"})
 
     # -- lifecycle --------------------------------------------------------
-    def serve(self, host: str = "0.0.0.0", port: int = 8000) -> HTTPServer:
-        # enforce_key=False: TLS still applies, but key auth is
-        # per-route (/stop, /reload) — queries.json stays open
-        self._http = HTTPServer(
-            self.router,
-            host=host,
-            port=port,
-            server_config=self._server_config,
-            enforce_key=False,
-        )
-        return self._http
+    def serve(
+        self,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        bind_retries: int = 3,
+        undeploy_first: bool = True,
+    ) -> HTTPServer:
+        """Bind the REST service: undeploy-before-deploy handshake, then
+        bind with retries (reference MasterActor StartServer →
+        undeploy() → BindServer with retry 3,
+        CreateServer.scala:280-378)."""
+        if undeploy_first and port:
+            undeploy_existing(host, port, self._server_config)
+        last_exc: OSError | None = None
+        for attempt in range(max(1, bind_retries)):
+            try:
+                # enforce_key=False: TLS still applies, but key auth is
+                # per-route (/stop, /reload) — queries.json stays open
+                self._http = HTTPServer(
+                    self.router,
+                    host=host,
+                    port=port,
+                    server_config=self._server_config,
+                    enforce_key=False,
+                )
+                return self._http
+            except OSError as exc:
+                last_exc = exc
+                remaining = bind_retries - attempt - 1
+                if remaining <= 0:
+                    break
+                logger.error(
+                    "Bind to %s:%d failed (%s). Retrying... "
+                    "(%d more trial(s))",
+                    host, port, exc, remaining,
+                )
+                time.sleep(1.0)
+        raise last_exc  # type: ignore[misc]
 
     def close(self) -> None:
         for b in self._batchers:
             b.close()
         self._plugins.close()
+
+
+def undeploy_existing(host: str, port: int, server_config=None) -> bool:
+    """POST /stop to whatever occupies ``host:port`` before binding
+    there (reference MasterActor.undeploy, CreateServer.scala:280-305).
+    Returns True if an old server acknowledged the stop."""
+    probe_host = "127.0.0.1" if host in ("0.0.0.0", "") else host
+    ssl_enabled = bool(getattr(server_config, "ssl_enabled", False))
+    scheme = "https" if ssl_enabled else "http"
+    url = f"{scheme}://{probe_host}:{port}/stop"
+    key = getattr(server_config, "access_key", "") or ""
+    if key:
+        url += "?" + urllib.parse.urlencode({"accessKey": key})
+    tls_ctx = None
+    if ssl_enabled:
+        # the old server typically runs a self-signed cert; this is a
+        # localhost control handshake, not a trust decision
+        import ssl as _ssl
+
+        tls_ctx = _ssl.create_default_context()
+        tls_ctx.check_hostname = False
+        tls_ctx.verify_mode = _ssl.CERT_NONE
+    logger.info(
+        "Undeploying any existing engine instance at %s:%d",
+        probe_host, port,
+    )
+    try:
+        with urllib.request.urlopen(
+            urllib.request.Request(url, method="POST"),
+            timeout=5,
+            context=tls_ctx,
+        ) as resp:
+            if resp.status == 200:
+                # give the old server a moment to release the socket
+                time.sleep(1.0)
+                return True
+            logger.error(
+                "Existing server at %s:%d answered HTTP %d to /stop; "
+                "unable to undeploy",
+                probe_host, port, resp.status,
+            )
+    except urllib.error.HTTPError as exc:
+        logger.error(
+            "Another process is using %s:%d (HTTP %d on /stop). "
+            "Unable to undeploy.",
+            probe_host, port, exc.code,
+        )
+    except OSError:
+        logger.debug("Nothing at %s:%d", probe_host, port)
+    return False
 
 
 def create_engine_server(

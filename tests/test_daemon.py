@@ -1,0 +1,126 @@
+"""Daemon management (start-all / stop-all / daemon verbs) — pidfiles,
+stale detection, real background process lifecycle.
+Reference analogue: bin/pio-start-all, bin/pio-stop-all, bin/pio-daemon."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.cli import daemon
+
+
+@pytest.fixture()
+def piodir(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+    return tmp_path
+
+
+def _dead_pid() -> int:
+    p = subprocess.Popen(["sleep", "0"])
+    p.wait()
+    return p.pid
+
+
+class TestPidfiles:
+    def test_stopped_when_no_pidfile(self, piodir):
+        assert daemon.service_status("eventserver") == ("stopped", None)
+
+    def test_stale_pidfile_detected(self, piodir):
+        os.makedirs(os.path.dirname(daemon.pidfile("eventserver")),
+                    exist_ok=True)
+        dead = _dead_pid()
+        with open(daemon.pidfile("eventserver"), "w") as f:
+            f.write(str(dead))
+        state, pid = daemon.service_status("eventserver")
+        assert state == "stale-pidfile" and pid == dead
+
+    def test_stop_removes_stale_pidfile(self, piodir):
+        os.makedirs(os.path.dirname(daemon.pidfile("dashboard")),
+                    exist_ok=True)
+        with open(daemon.pidfile("dashboard"), "w") as f:
+            f.write(str(_dead_pid()))
+        assert daemon.stop_daemon("dashboard") == "stale pidfile removed"
+        assert daemon.service_status("dashboard") == ("stopped", None)
+
+    def test_stop_not_running(self, piodir):
+        assert daemon.stop_daemon("adminserver") == "not running"
+
+    def test_garbage_pidfile_is_stopped(self, piodir):
+        os.makedirs(os.path.dirname(daemon.pidfile("x")), exist_ok=True)
+        with open(daemon.pidfile("x"), "w") as f:
+            f.write("not-a-pid")
+        assert daemon.service_status("x") == ("stopped", None)
+
+
+class TestLifecycle:
+    """One real daemonized server through the full lifecycle."""
+
+    def test_eventserver_daemon_roundtrip(self, piodir):
+        port = 17901
+        env = {
+            "PIO_STORAGE_SOURCES_SQL_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_SQL_PATH": str(piodir / "pio.sqlite"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQL",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQL",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQL",
+        }
+        pid = daemon.spawn_daemon(
+            "eventserver",
+            ["eventserver", "--ip", "127.0.0.1", "--port", str(port)],
+            env=env,
+        )
+        try:
+            assert daemon.wait_port(
+                "127.0.0.1", port, timeout=60.0, pid=pid
+            ), open(daemon.logfile("eventserver")).read()[-2000:]
+            state, got_pid = daemon.service_status("eventserver")
+            assert state == "running" and got_pid == pid
+            # the daemon actually serves
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/", timeout=10
+            ).read()
+            assert json.loads(body)["status"] == "alive"
+            # log file captured the boot line
+            assert os.path.exists(daemon.logfile("eventserver"))
+        finally:
+            outcome = daemon.stop_daemon("eventserver")
+        assert outcome.startswith("stopped")
+        assert daemon.service_status("eventserver") == ("stopped", None)
+        assert not daemon.pid_alive(pid)
+
+    def test_double_start_refused(self, piodir, monkeypatch):
+        # only manage minipg in this test — the other services would
+        # spawn real servers
+        monkeypatch.setattr(daemon, "SERVICES", {})
+        port = 17902
+        pid = daemon.spawn_daemon(
+            "minipg",
+            ["minipg", "--ip", "127.0.0.1", "--port", str(port)],
+        )
+        try:
+            assert daemon.wait_port(
+                "127.0.0.1", port, timeout=60.0, pid=pid
+            ), open(daemon.logfile("minipg")).read()[-2000:]
+            lines = []
+            daemon.start_all(
+                ip="127.0.0.1",
+                ports={"minipg": port},
+                with_minipg=True,
+                out=lines.append,
+            )
+            assert "minipg: already running" in "\n".join(lines)
+        finally:
+            daemon.stop_daemon("minipg")
+
+
+class TestStatusAll:
+    def test_status_reports_stopped(self, piodir, capsys):
+        lines = []
+        rc = daemon.status_all(out=lines.append)
+        assert rc == 1  # nothing running
+        assert any("eventserver: stopped" in ln for ln in lines)

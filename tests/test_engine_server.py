@@ -154,6 +154,94 @@ class TestEngineServer:
         status, _ = _call(f"{base}/queries.json", "POST", [1, 2, 3])
         assert status == 400
 
+    def test_html_status_page_content_negotiated(self, server):
+        """GET / with Accept: text/html renders the status page
+        (reference twirl index.scala.html); JSON stays the default."""
+        base, _, _ = server
+        req = urllib.request.Request(
+            f"{base}/", headers={"Accept": "text/html"}
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.headers["Content-Type"] == "text/html"
+            page = resp.read().decode()
+        assert "<h1>Engine Server</h1>" in page
+        assert "srv" in page
+        assert "Engine Information" in page
+        assert "Request Count" in page
+        # default (no Accept preference) remains JSON
+        status, body = _call(f"{base}/")
+        assert status == 200 and body["status"] == "alive"
+
+
+class TestBindAndUndeploy:
+    def test_undeploy_before_deploy_stops_old_server(
+        self, ctx, memory_storage
+    ):
+        """Second deploy on the same port posts /stop to the first and
+        takes the port over (reference MasterActor StartServer →
+        undeploy, CreateServer.scala:280-378)."""
+        import time as _time
+
+        run_train(
+            _engine(), _params(), engine_id="srv", ctx=ctx,
+            storage=memory_storage,
+        )
+        first = EngineServer(
+            _engine(), _params(), engine_id="srv",
+            storage=memory_storage, ctx=ctx, warmup=False,
+        )
+        http1 = first.serve(host="127.0.0.1", port=0)
+        http1.start()
+        port = http1.port
+        second = EngineServer(
+            _engine(), _params(), engine_id="srv",
+            storage=memory_storage, ctx=ctx, warmup=False,
+        )
+        # bind_retries gives the old server time to release the socket
+        http2 = second.serve(host="127.0.0.1", port=port)
+        http2.start()
+        try:
+            status, body = _call(f"http://127.0.0.1:{port}/")
+            assert status == 200 and body["status"] == "alive"
+        finally:
+            http2.shutdown()
+            second.close()
+            first.close()
+        _time.sleep(0.1)
+
+    def test_bind_retry_then_give_up(self, ctx, memory_storage, monkeypatch):
+        """A port held by a non-engine process: undeploy fails, bind
+        retries x3, then the original error surfaces."""
+        import socket as _socket
+
+        run_train(
+            _engine(), _params(), engine_id="srv", ctx=ctx,
+            storage=memory_storage,
+        )
+        blocker = _socket.socket()
+        blocker.bind(("127.0.0.1", 0))
+        blocker.listen(1)
+        port = blocker.getsockname()[1]
+        sleeps = []
+        monkeypatch.setattr(
+            "predictionio_tpu.serving.engine_server.time.sleep",
+            sleeps.append,
+        )
+        es = EngineServer(
+            _engine(), _params(), engine_id="srv",
+            storage=memory_storage, ctx=ctx, warmup=False,
+        )
+        try:
+            with pytest.raises(OSError):
+                es.serve(
+                    host="127.0.0.1", port=port, bind_retries=3,
+                    undeploy_first=False,
+                )
+            assert len(sleeps) == 2  # 3 attempts → 2 backoffs
+        finally:
+            es.close()
+            blocker.close()
+
 
 class TestKeyAuthedAdminRoutes:
     """Key auth guards /stop and /reload but never /queries.json
