@@ -4,4 +4,5 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 g++ -O3 -std=c++17 -shared -fPIC -o libpio_eventlog.so eventlog.cc
-echo "built $(pwd)/libpio_eventlog.so"
+g++ -O3 -std=c++17 -shared -fPIC -o libpio_alspack.so alspack.cc
+echo "built $(pwd)/libpio_eventlog.so and libpio_alspack.so"

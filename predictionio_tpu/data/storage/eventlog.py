@@ -22,7 +22,6 @@ import fcntl
 import json
 import os
 import struct
-import subprocess
 import threading
 from typing import Iterator, Sequence
 
@@ -34,14 +33,6 @@ from predictionio_tpu.data.eventframe import Interactions
 from predictionio_tpu.data.storage.base import EventsBackend
 from predictionio_tpu.utils.bimap import BiMap
 
-_NATIVE_DIR = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))
-    ))),
-    "native",
-)
-_LIB_PATH = os.path.join(_NATIVE_DIR, "libpio_eventlog.so")
-
 _lib = None
 _lib_lock = threading.Lock()
 
@@ -51,31 +42,10 @@ def _load_library() -> ctypes.CDLL:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        src = os.path.join(_NATIVE_DIR, "eventlog.cc")
-        if not os.path.exists(src) and not os.path.exists(_LIB_PATH):
-            raise RuntimeError(
-                "native event-log sources not found at "
-                f"{src}; the 'eventlog' backend needs the repo's native/ "
-                "directory (or a prebuilt libpio_eventlog.so)"
-            )
-        stale = os.path.exists(src) and (
-            not os.path.exists(_LIB_PATH)
-            or os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
-        )
-        if stale:
-            try:
-                subprocess.run(
-                    ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                     "-o", _LIB_PATH, src],
-                    check=True,
-                    capture_output=True,
-                    text=True,
-                )
-            except subprocess.CalledProcessError as e:
-                raise RuntimeError(
-                    f"building libpio_eventlog.so failed:\n{e.stderr}"
-                ) from e
-        lib = ctypes.CDLL(_LIB_PATH)
+        from predictionio_tpu.utils.native import load_native_lib
+
+        # shared loader: staleness check, locked atomic compile, dlopen
+        lib = load_native_lib("eventlog")
         c = ctypes
         lib.pio_log_open.restype = c.c_void_p
         lib.pio_log_open.argtypes = [c.c_char_p]

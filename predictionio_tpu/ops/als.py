@@ -174,6 +174,86 @@ class Bucketed:
         return total
 
 
+_ALSPACK_LIB = None
+_ALSPACK_TRIED = False
+
+
+def _load_alspack():
+    """ctypes handle to native/libpio_alspack.so (built on first use);
+    None when the toolchain/sources are unavailable — callers fall back
+    to the numpy path. ``PIO_NO_NATIVE=1`` disables it (tests exercise
+    both paths)."""
+    global _ALSPACK_LIB, _ALSPACK_TRIED
+    if _ALSPACK_TRIED:
+        return _ALSPACK_LIB
+    _ALSPACK_TRIED = True
+    if os.environ.get("PIO_NO_NATIVE", "").strip() in ("1", "true"):
+        return None
+    import ctypes
+
+    from predictionio_tpu.utils.native import load_native_lib
+
+    try:
+        lib = load_native_lib("alspack")
+        c = ctypes
+        lib.pio_alspack_fill.restype = None
+        lib.pio_alspack_fill.argtypes = [
+            c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+            c.POINTER(c.c_float), c.c_int64, c.POINTER(c.c_int64),
+            c.POINTER(c.c_int64), c.POINTER(c.c_int32),
+            c.POINTER(c.c_float), c.POINTER(c.c_float),
+        ]
+        _ALSPACK_LIB = lib
+    except Exception:  # noqa: BLE001 - native is an optimization only
+        logger.debug("native alspack unavailable", exc_info=True)
+        _ALSPACK_LIB = None
+    return _ALSPACK_LIB
+
+
+def _fill_flat(rows, cols, vals, off_of_row, total_flat, deg):
+    """Scatter every nnz into the combined flat slot buffer.
+
+    ``dest(i) = off_of_row[rows[i]] + occurrence(rows[i])`` — rows keep
+    their interactions contiguous in original input order (the same
+    order the stable-argsort formulation produced). Native path: one
+    sequential O(nnz) pass; numpy fallback: stable argsort to derive
+    occurrence indices, then three vectorized scatters.
+    """
+    flat_idx = np.zeros(total_flat, np.int32)
+    flat_w = np.zeros(total_flat, np.float32)
+    flat_vd = np.zeros(total_flat, np.float32)
+    if len(rows) == 0:
+        return flat_idx, flat_w, flat_vd
+    lib = _load_alspack()
+    if lib is not None:
+        import ctypes
+
+        c = ctypes
+        cursor = np.zeros(len(off_of_row), np.int64)
+        off64 = np.ascontiguousarray(off_of_row, np.int64)
+        lib.pio_alspack_fill(
+            rows.ctypes.data_as(c.POINTER(c.c_int32)),
+            cols.ctypes.data_as(c.POINTER(c.c_int32)),
+            vals.ctypes.data_as(c.POINTER(c.c_float)),
+            c.c_int64(len(rows)),
+            off64.ctypes.data_as(c.POINTER(c.c_int64)),
+            cursor.ctypes.data_as(c.POINTER(c.c_int64)),
+            flat_idx.ctypes.data_as(c.POINTER(c.c_int32)),
+            flat_w.ctypes.data_as(c.POINTER(c.c_float)),
+            flat_vd.ctypes.data_as(c.POINTER(c.c_float)),
+        )
+        return flat_idx, flat_w, flat_vd
+    order = np.argsort(rows, kind="stable")
+    r = rows[order]
+    row_start = np.concatenate([[0], np.cumsum(deg)[:-1]])
+    occ = np.arange(len(r)) - row_start[r]
+    dest = off_of_row[r] + occ
+    flat_idx[dest] = cols[order]
+    flat_w[dest] = vals[order]
+    flat_vd[dest] = 1.0
+    return flat_idx, flat_w, flat_vd
+
+
 def _split_rows(arrays: tuple, rows_per_group: int) -> list[tuple]:
     """Split row-aligned arrays into groups of ≤ ``rows_per_group`` rows
     (host-side; slicing preserves global row order, so stats layouts are
@@ -216,14 +296,10 @@ def build_bucketed(
     n_rows_padded = max(
         row_multiple, -(-n_rows // row_multiple) * row_multiple
     )
-    rows = np.asarray(rows, np.int64)
-    order = np.argsort(rows, kind="stable")
-    r = rows[order]
-    c = np.asarray(cols, np.int64)[order]
-    v = np.asarray(vals, np.float32)[order]
-    deg = np.bincount(r, minlength=n_rows_padded).astype(np.int64)
-    row_start = np.concatenate([[0], np.cumsum(deg)[:-1]])
-    idx_in_row = (np.arange(len(r)) - row_start[r]).astype(np.int64)
+    rows = np.ascontiguousarray(rows, np.int32)
+    cols = np.ascontiguousarray(cols, np.int32)
+    vals = np.ascontiguousarray(vals, np.float32)
+    deg = np.bincount(rows, minlength=n_rows_padded).astype(np.int64)
 
     nseg = np.maximum(-(-deg // block_len), 1)
     # bucket size: next power of two ≥ nseg, capped at s_max
@@ -236,73 +312,98 @@ def build_bucketed(
     if not bucket_sizes:
         bucket_sizes = [1]
 
-    slabs: list[Slab] = []
+    # Layout planning runs on n_rows-sized arrays (cheap); the only
+    # O(nnz) work is ONE fill pass into a combined flat buffer whose
+    # slices become the slab views. A row's nnz land contiguously from
+    # its flat offset in original input order — for heavy rows too,
+    # since their sub-rows are consecutive in the heavy region — so the
+    # destination of every nnz is `off[row] + occurrence(row)`, which
+    # the native kernel (native/alspack.cc) computes in a single
+    # sequential pass (the numpy fallback derives occurrence via a
+    # stable argsort).
     inv_perm = np.zeros(n_rows_padded, np.int64)
-    offset = 0
     row_ids = np.arange(n_rows_padded)
-    for s in bucket_sizes:
-        members = row_ids[(s_of_row == s) & ~is_heavy]
-        rb = max(
-            row_multiple,
-            -(-len(members) // row_multiple) * row_multiple,
-        )
-        width = s * block_len
-        slab = Slab(
-            idx=np.zeros((rb, width), np.int32),
-            weights=np.zeros((rb, width), np.float32),
-            valid=np.zeros((rb, width), np.float32),
-        )
-        # nnz of member rows land at (local row, idx_in_row)
-        local_of_row = np.full(n_rows_padded, -1, np.int64)
-        local_of_row[members] = np.arange(len(members))
-        sel = local_of_row[r] >= 0
-        sel &= s_of_row[r] == s
-        lr = local_of_row[r[sel]]
-        pos = idx_in_row[sel]
-        slab.idx[lr, pos] = c[sel]
-        slab.weights[lr, pos] = v[sel]
-        slab.valid[lr, pos] = 1.0
-        for g_idx, g_wt, g_vd in _split_rows(
-            (slab.idx, slab.weights, slab.valid), rows_per_group(width)
-        ):
-            slabs.append(Slab(idx=g_idx, weights=g_wt, valid=g_vd))
-        inv_perm[members] = offset + np.arange(len(members))
-        offset += rb
+    sizes_arr = np.asarray(bucket_sizes, np.int64)
+    widths = sizes_arr * block_len
+    reg = ~is_heavy
+    bucket_of_row = np.searchsorted(sizes_arr, s_of_row)  # valid where reg
+    counts = np.bincount(
+        bucket_of_row[reg], minlength=len(bucket_sizes)
+    )
+    rb_of = np.maximum(
+        row_multiple, -(-counts // row_multiple) * row_multiple
+    )
+    slab_row_base = np.concatenate([[0], np.cumsum(rb_of)[:-1]])
+    flat_base = np.concatenate([[0], np.cumsum(rb_of * widths)[:-1]])
+    # local index of each member row within its bucket (row-id order —
+    # stable sort over the per-row bucket ids preserves ascending ids)
+    reg_rows = row_ids[reg]
+    reg_buckets = bucket_of_row[reg]
+    order = np.argsort(reg_buckets, kind="stable")
+    local = np.empty(len(reg_rows), np.int64)
+    bucket_start = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    local[order] = np.arange(len(reg_rows)) - np.repeat(
+        bucket_start, counts
+    )
+    inv_perm[reg_rows] = slab_row_base[reg_buckets] + local
+    off_of_row = np.zeros(n_rows_padded, np.int64)
+    off_of_row[reg_rows] = (
+        flat_base[reg_buckets] + local * widths[reg_buckets]
+    )
+    regular_flat = int((rb_of * widths).sum())
+    offset = int(rb_of.sum())
 
+    # heavy layout: one stats slot per heavy row after all regular rows;
+    # sub-rows of width s_max·block_len appended after the regular flats
     heavy_rows = row_ids[is_heavy]
+    width_h = s_max * block_len
+    rb_h = 0
+    n_sub = 0
+    nsub_of = None
+    if len(heavy_rows):
+        inv_perm[heavy_rows] = offset + np.arange(len(heavy_rows))
+        nsub_of = -(-deg[heavy_rows] // width_h)
+        n_sub = int(nsub_of.sum())
+        rb_h = max(
+            row_multiple, -(-n_sub // row_multiple) * row_multiple
+        )
+        sub_base = np.concatenate([[0], np.cumsum(nsub_of)[:-1]])
+        off_of_row[heavy_rows] = regular_flat + sub_base * width_h
+
+    total_flat = regular_flat + rb_h * width_h
+    flat_idx, flat_w, flat_vd = _fill_flat(
+        rows, cols, vals, off_of_row, total_flat, deg
+    )
+
+    slabs: list[Slab] = []
+    for b, s in enumerate(bucket_sizes):
+        width = int(widths[b])
+        n_b = int(rb_of[b])
+        start = int(flat_base[b])
+        end = start + n_b * width
+        full = (
+            flat_idx[start:end].reshape(n_b, width),
+            flat_w[start:end].reshape(n_b, width),
+            flat_vd[start:end].reshape(n_b, width),
+        )
+        for g_idx, g_wt, g_vd in _split_rows(full, rows_per_group(width)):
+            slabs.append(Slab(idx=g_idx, weights=g_wt, valid=g_vd))
+
     heavy: list[Slab] = []
     heavy_owner_pos: list[np.ndarray] = []
     if len(heavy_rows):
-        # one stats slot per heavy row, after all regular slab rows
-        inv_perm[heavy_rows] = offset + np.arange(len(heavy_rows))
-        width = s_max * block_len
-        nsub_of = -(-deg[heavy_rows] // width)
-        n_sub = int(nsub_of.sum())
-        rb = max(
-            row_multiple, -(-n_sub // row_multiple) * row_multiple
+        hs = (
+            flat_idx[regular_flat:].reshape(rb_h, width_h),
+            flat_w[regular_flat:].reshape(rb_h, width_h),
+            flat_vd[regular_flat:].reshape(rb_h, width_h),
         )
-        h = Slab(
-            idx=np.zeros((rb, width), np.int32),
-            weights=np.zeros((rb, width), np.float32),
-            valid=np.zeros((rb, width), np.float32),
-        )
-        sub_base = np.zeros(n_rows_padded, np.int64)
-        sub_base[heavy_rows] = np.concatenate(
-            [[0], np.cumsum(nsub_of)[:-1]]
-        )
-        sel = is_heavy[r]
-        sub = sub_base[r[sel]] + idx_in_row[sel] // width
-        pos = idx_in_row[sel] % width
-        h.idx[sub, pos] = c[sel]
-        h.weights[sub, pos] = v[sel]
-        h.valid[sub, pos] = 1.0
-        owner = np.zeros(rb, np.int32)
+        owner = np.zeros(rb_h, np.int32)
         owner[:n_sub] = np.repeat(
             inv_perm[heavy_rows], nsub_of
         ).astype(np.int32)
         # phantom sub-rows have zero valid/weights: owner 0 is harmless
         for g_idx, g_wt, g_vd, g_own in _split_rows(
-            (h.idx, h.weights, h.valid, owner), rows_per_group(width)
+            (*hs, owner), rows_per_group(width_h)
         ):
             heavy.append(Slab(idx=g_idx, weights=g_wt, valid=g_vd))
             heavy_owner_pos.append(g_own)

@@ -1,0 +1,73 @@
+"""Shared loader for the repo's native C++ libraries (``native/*.cc``).
+
+One place owns the locate → staleness-check → compile → dlopen flow so
+the g++ invocation cannot drift between consumers (eventlog storage,
+ALS packing) and ``native/build.sh``. Compilation is concurrency-safe:
+a process-wide lock serializes threads, and g++ writes to a temp file
+that is ``os.replace``d into place, so a parallel process never dlopens
+a half-written .so (it either sees the old library or the new one).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+
+NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    ))),
+    "native",
+)
+
+GXX_CMD = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC"]
+
+_loaded: dict[str, ctypes.CDLL] = {}
+_lock = threading.Lock()
+
+
+def load_native_lib(name: str) -> ctypes.CDLL:
+    """dlopen ``native/libpio_<name>.so``, (re)building it from
+    ``native/<name>.cc`` when the source is newer. Raises RuntimeError
+    with the compiler output when the build fails, or when neither
+    source nor a prebuilt library exists."""
+    with _lock:
+        if name in _loaded:
+            return _loaded[name]
+        src = os.path.join(NATIVE_DIR, f"{name}.cc")
+        lib_path = os.path.join(NATIVE_DIR, f"libpio_{name}.so")
+        have_src = os.path.exists(src)
+        if not have_src and not os.path.exists(lib_path):
+            raise RuntimeError(
+                f"native sources not found at {src}; this feature needs "
+                f"the repo's native/ directory (or a prebuilt "
+                f"lib{name}.so)"
+            )
+        stale = have_src and (
+            not os.path.exists(lib_path)
+            or os.path.getmtime(src) > os.path.getmtime(lib_path)
+        )
+        if stale:
+            fd, tmp = tempfile.mkstemp(
+                prefix=f".lib{name}.", suffix=".so", dir=NATIVE_DIR
+            )
+            os.close(fd)
+            try:
+                subprocess.run(
+                    [*GXX_CMD, "-o", tmp, src],
+                    check=True, capture_output=True, text=True,
+                )
+                os.replace(tmp, lib_path)  # atomic swap
+            except subprocess.CalledProcessError as e:
+                raise RuntimeError(
+                    f"building lib{name}.so failed:\n{e.stderr}"
+                ) from e
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+        lib = ctypes.CDLL(lib_path)
+        _loaded[name] = lib
+        return lib

@@ -169,6 +169,38 @@ class TestSlabSplitting:
         )
 
 
+class TestNativePackParity:
+    """native/alspack.cc fill vs the numpy fallback — identical output
+    for every geometry (heavy rows, padding, slot-cap splits)."""
+
+    def test_native_and_numpy_fill_agree(self, monkeypatch):
+        from predictionio_tpu.ops import als
+
+        if als._load_alspack() is None:
+            pytest.skip("native alspack not built (no toolchain)")
+        rng = np.random.default_rng(9)
+        for _ in range(10):
+            n_rows = int(rng.integers(1, 150))
+            nnz = int(rng.integers(0, 2500))
+            rows = rng.integers(0, n_rows, nnz).astype(np.int32)
+            cols = rng.integers(0, 80, nnz).astype(np.int32)
+            vals = rng.uniform(0.1, 5.0, nnz).astype(np.float32)
+            kw = dict(
+                block_len=4, row_multiple=int(rng.choice([1, 2, 8])),
+                s_max=2, max_slab_slots=int(rng.choice([64, 2 << 20])),
+            )
+            pn = als.build_bucketed(rows, cols, vals, n_rows, **kw)
+            monkeypatch.setattr(als, "_ALSPACK_LIB", None)
+            monkeypatch.setattr(als, "_ALSPACK_TRIED", True)
+            pf = als.build_bucketed(rows, cols, vals, n_rows, **kw)
+            monkeypatch.undo()
+            for a, b in zip(pn.slabs + pn.heavy, pf.slabs + pf.heavy):
+                np.testing.assert_array_equal(a.idx, b.idx)
+                np.testing.assert_array_equal(a.weights, b.weights)
+                np.testing.assert_array_equal(a.valid, b.valid)
+            np.testing.assert_array_equal(pn.inv_perm, pf.inv_perm)
+
+
 class TestSolveCorrectness:
     def test_matches_dense_reference(self, ctx8):
         """One deterministic seed: our mesh solve must match the dense
