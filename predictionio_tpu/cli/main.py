@@ -86,12 +86,19 @@ def cmd_version(args) -> int:
 
 def cmd_status(args) -> int:
     """Reference Console.status:1035-1107: verify storage + compute."""
-    import jax
-
     from predictionio_tpu.data.storage import get_storage
+    from predictionio_tpu.parallel.mesh import (
+        DeviceInitTimeout,
+        devices_with_timeout,
+    )
 
     print(f"PredictionIO-TPU {__version__}")
-    devices = jax.devices()
+    try:
+        devices = devices_with_timeout()
+    except DeviceInitTimeout as e:
+        print(f"[ERROR] Compute: {e}")
+        print("Compute status: FAILED")
+        return 1
     print(
         f"Compute: {len(devices)} {devices[0].platform} device(s): "
         f"{[str(d) for d in devices[:8]]}"
@@ -881,6 +888,13 @@ def main(argv: list[str] | None = None) -> int:
     except CommandError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
+    except Exception as e:
+        from predictionio_tpu.parallel.mesh import DeviceInitTimeout
+
+        if isinstance(e, DeviceInitTimeout):
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        raise
 
 
 if __name__ == "__main__":

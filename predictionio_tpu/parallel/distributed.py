@@ -54,11 +54,13 @@ def initialize(
         process_id=process_id,
     )
     _initialized = True
+    from predictionio_tpu.parallel.mesh import devices_with_timeout
+
     logger.info(
         "jax.distributed initialized: process %d/%d, %d global devices",
         jax.process_index(),
         jax.process_count(),
-        len(jax.devices()),
+        len(devices_with_timeout()),
     )
 
 

@@ -36,6 +36,63 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
 
+class DeviceInitTimeout(RuntimeError):
+    """Backend initialization exceeded PIO_DEVICE_INIT_TIMEOUT_S."""
+
+
+def devices_with_timeout() -> list:
+    """``jax.devices()`` with a hang bound.
+
+    The first call initializes the backend; on a remote-TPU transport a
+    wedged tunnel can block it for tens of minutes with no output. Run
+    the init in a daemon thread and fail fast with an actionable error
+    when it exceeds ``PIO_DEVICE_INIT_TIMEOUT_S`` (0 disables the
+    bound). The orphaned thread finishes (or errors) in the background
+    — acceptable for a process that is about to report failure anyway.
+    (Multi-host coordination has its own bound: jax.distributed's
+    ``initialization_timeout``.)
+    """
+    import os
+    import threading
+
+    raw = os.environ.get("PIO_DEVICE_INIT_TIMEOUT_S", "300")
+    try:
+        timeout = float(raw)
+    except ValueError:
+        logger.warning(
+            "PIO_DEVICE_INIT_TIMEOUT_S=%r is not a number; using 300",
+            raw,
+        )
+        timeout = 300.0
+    if timeout <= 0:
+        return jax.devices()
+    result: list = []
+    error: list = []
+
+    def _init():
+        try:
+            result.extend(jax.devices())
+        except Exception as exc:  # noqa: BLE001 - re-raised below
+            error.append(exc)
+
+    t = threading.Thread(target=_init, name="jax-device-init", daemon=True)
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        raise DeviceInitTimeout(
+            f"device backend did not initialize within {timeout:.0f}s "
+            "(remote TPU transport down?). Set JAX_PLATFORMS=cpu to run "
+            "on the host, or raise PIO_DEVICE_INIT_TIMEOUT_S."
+        )
+    if error:
+        raise error[0]
+    return result
+
+
+# backwards-compatible alias (pre-rename imports)
+_devices_with_timeout = devices_with_timeout
+
+
 def pad_to_multiple(
     arr: np.ndarray, multiple: int, axis: int = 0, fill: Any = 0
 ) -> np.ndarray:
@@ -72,8 +129,15 @@ class ComputeContext:
         first scaling dimension is #entities (SURVEY.md §5). Callers
         (engine variants) may request e.g. ``mesh_shape=(4, 2)`` for
         factor-sharded ALS.
+
+        Backend init is bounded by ``PIO_DEVICE_INIT_TIMEOUT_S``
+        (default 300): a wedged remote-TPU transport otherwise blocks
+        ``jax.devices()`` indefinitely, hanging every console verb with
+        no diagnosis (failure-detection obligation, SURVEY.md §5).
         """
-        devs = list(devices if devices is not None else jax.devices())
+        devs = list(
+            devices if devices is not None else devices_with_timeout()
+        )
         if mesh_shape is None:
             mesh_shape = (len(devs),) + (1,) * (len(axis_names) - 1)
         if int(np.prod(mesh_shape)) != len(devs):

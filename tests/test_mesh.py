@@ -1,0 +1,63 @@
+"""ComputeContext: mesh construction and bounded device init
+(failure-detection obligation, SURVEY.md §5 — a wedged remote-TPU
+transport must fail fast with an actionable error, not hang every
+console verb)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import pytest
+
+from predictionio_tpu.parallel import mesh as mesh_mod
+from predictionio_tpu.parallel.mesh import (
+    ComputeContext,
+    DeviceInitTimeout,
+    devices_with_timeout,
+)
+
+
+class TestDeviceInitTimeout:
+    def test_wedged_backend_raises_fast(self, monkeypatch):
+        release = threading.Event()
+
+        def hang():
+            release.wait(10.0)
+            return []
+
+        monkeypatch.setattr(mesh_mod.jax, "devices", hang)
+        monkeypatch.setenv("PIO_DEVICE_INIT_TIMEOUT_S", "0.3")
+        t0 = time.monotonic()
+        with pytest.raises(DeviceInitTimeout, match="did not initialize"):
+            devices_with_timeout()
+        assert time.monotonic() - t0 < 5.0
+        release.set()
+
+    def test_init_error_propagates(self, monkeypatch):
+        def boom():
+            raise RuntimeError("no backend for you")
+
+        monkeypatch.setattr(mesh_mod.jax, "devices", boom)
+        monkeypatch.setenv("PIO_DEVICE_INIT_TIMEOUT_S", "5")
+        with pytest.raises(RuntimeError, match="no backend for you"):
+            devices_with_timeout()
+
+    def test_zero_disables_bound(self, monkeypatch):
+        monkeypatch.setenv("PIO_DEVICE_INIT_TIMEOUT_S", "0")
+        assert devices_with_timeout() == jax.devices()
+
+    def test_healthy_backend_returns_devices(self):
+        assert len(devices_with_timeout()) == len(jax.devices())
+
+
+class TestMeshShapes:
+    def test_default_mesh_all_data(self):
+        ctx = ComputeContext.create(batch="t")
+        assert ctx.data_parallelism == len(jax.devices())
+        assert ctx.model_parallelism == 1
+
+    def test_bad_shape_rejected(self):
+        with pytest.raises(ValueError, match="does not cover"):
+            ComputeContext.create(mesh_shape=(3, 5))
