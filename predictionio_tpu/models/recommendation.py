@@ -152,6 +152,8 @@ class ALSParams(Params):
     seed: int = 13
     block_len: int = 64
     row_chunk: int = 256
+    #: "" = f32; "bfloat16" halves gather HBM traffic (accum stays f32)
+    compute_dtype: str = ""
     # mid-training checkpoint/resume (ops/als.py); dir empty = disabled
     checkpoint_dir: str = ""
     checkpoint_every: int = 0
@@ -189,6 +191,7 @@ class ALSAlgorithm(Algorithm[RecTrainingData, ALSRecModel, dict, dict]):
             seed=p.seed,
             block_len=p.block_len,
             row_chunk=p.row_chunk,
+            compute_dtype=p.compute_dtype or None,
             timer=self.timer,
             checkpoint_dir=p.checkpoint_dir or None,
             checkpoint_every=p.checkpoint_every,

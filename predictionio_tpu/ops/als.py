@@ -425,8 +425,37 @@ def build_bucketed(
 # --------------------------------------------------------------------------
 
 
-def _slab_stats(y, idx, weights, valid, implicit, alpha, dtype):
+def _resolve_compute(compute_dtype: str | None):
+    """Gather/Gramian compute dtype: None = keep factor dtype (f32).
+
+    ``"bfloat16"``/``"bf16"`` halves the gather temp + HBM traffic (the
+    factor matrix is cast BEFORE the gather) and doubles MXU rate;
+    Gramians still accumulate in f32 (``preferred_element_type``) and
+    the Cholesky solve stays f32. Empty/None falls back to the
+    ``PIO_ALS_COMPUTE_DTYPE`` env knob, then f32. Unknown names fail
+    here — at solver build — with the supported list.
+    """
+    name = (compute_dtype or "").strip().lower()
+    if not name:
+        name = os.environ.get("PIO_ALS_COMPUTE_DTYPE", "").strip().lower()
+    if name in ("", "float32", "f32"):
+        return None
+    if name in ("bfloat16", "bf16"):
+        return jnp.bfloat16
+    if name in ("float16", "f16", "half"):
+        return jnp.float16
+    raise ValueError(
+        f"unsupported ALS compute_dtype {name!r}; supported: "
+        "float32/f32, bfloat16/bf16, float16/f16"
+    )
+
+
+def _slab_stats(y, idx, weights, valid, implicit, alpha, dtype,
+                compute=None):
     """Per-row normal-equation pieces for one dense slab — pure MXU."""
+    # y arrives pre-cast to `compute` (see _assemble_and_solve), so the
+    # gather temp itself is low-precision — that is where the memory and
+    # bandwidth live
     yg = y[idx]  # [R, W, k] gather (unique rows per device slice)
     mask = valid  # a real 0-valued explicit rating still counts
     if implicit:
@@ -435,6 +464,9 @@ def _slab_stats(y, idx, weights, valid, implicit, alpha, dtype):
     else:
         aw = mask
         bw = weights * mask
+    if compute is not None:
+        aw = aw.astype(compute)
+        bw = bw.astype(compute)
     a = jnp.einsum(
         "rlk,rl,rlm->rkm", yg, aw, yg, preferred_element_type=dtype
     )
@@ -509,7 +541,7 @@ def _solve(a, b, cnt, yty, lam, implicit, k, dtype):
 
 def _assemble_and_solve(
     y, slab_arrays, heavy_groups, n_heavy_slots,
-    implicit, alpha, lam,
+    implicit, alpha, lam, compute=None,
 ):
     """Shared one-direction solve body: slab stats → heavy scatter-add →
     batched normal-equation solve. Used by both the replicated
@@ -523,10 +555,18 @@ def _assemble_and_solve(
     """
     k = y.shape[1]
     dtype = y.dtype
+    if compute is not None:
+        # cast ONCE, before any gather: every slab's [R, W, k] gather
+        # temp (and its read traffic) is then low-precision. Stats
+        # always ACCUMULATE in f32 — y may already arrive cast (the
+        # sharded path casts before its all-gather), so the accumulator
+        # dtype must not be inferred from it.
+        dtype = jnp.float32
+        y = y.astype(compute)
     parts_a, parts_b, parts_cnt = [], [], []
     for (idx, weights, valid) in slab_arrays:
         a, b, cnt = _slab_stats(
-            y, idx, weights, valid, implicit, alpha, dtype
+            y, idx, weights, valid, implicit, alpha, dtype, compute
         )
         parts_a.append(a)
         parts_b.append(b)
@@ -540,7 +580,7 @@ def _assemble_and_solve(
     cnt = jnp.concatenate(parts_cnt, axis=0)
     for (idx, weights, valid, owner) in heavy_groups:
         ha, hb, hcnt = _slab_stats(
-            y, idx, weights, valid, implicit, alpha, dtype
+            y, idx, weights, valid, implicit, alpha, dtype, compute
         )
         owner = jnp.asarray(owner)
         # few sub-rows (head of the power law): small scatter-add
@@ -560,6 +600,7 @@ def make_bucketed_solver(
     packed: Bucketed,
     implicit: bool,
     alpha: float,
+    compute_dtype: str | None = None,
 ):
     """Build the one-direction solver body for a fixed geometry.
 
@@ -576,6 +617,7 @@ def make_bucketed_solver(
     )
     heavy_owners = packed.heavy_owner_pos
     replicated = ctx.replicated
+    compute = _resolve_compute(compute_dtype)
 
     def solve(y, slab_arrays, heavy_arrays, lam):
         heavy_groups = [
@@ -584,7 +626,7 @@ def make_bucketed_solver(
         ]
         x_stats = _assemble_and_solve(
             y, slab_arrays, heavy_groups, n_heavy_slots,
-            implicit, alpha, lam,
+            implicit, alpha, lam, compute,
         )
         x = jnp.take(x_stats, jnp.asarray(inv_perm), axis=0)
         return jax.lax.with_sharding_constraint(x, replicated)
@@ -608,6 +650,7 @@ def make_solve_side(
     packed: Bucketed,
     implicit: bool,
     alpha: float,
+    compute_dtype: str | None = None,
 ):
     """Jitted single-direction solver over a pre-staged geometry.
 
@@ -615,7 +658,7 @@ def make_solve_side(
     path and the benchmark; :func:`make_train_step` fuses both
     directions and whole epochs for the production path.
     """
-    body = make_bucketed_solver(ctx, packed, implicit, alpha)
+    body = make_bucketed_solver(ctx, packed, implicit, alpha, compute_dtype)
     return jax.jit(body)
 
 
@@ -625,6 +668,7 @@ def make_train_step(
     item_packed: Bucketed,
     implicit: bool,
     alpha: float,
+    compute_dtype: str | None = None,
 ):
     """Fused multi-epoch trainer: one dispatch runs ``n_iters`` epochs.
 
@@ -633,8 +677,12 @@ def make_train_step(
     through a ``fori_loop``, amortizing host↔device dispatch latency
     (material on tunneled TPU platforms) across the whole run.
     """
-    solve_u = make_bucketed_solver(ctx, user_packed, implicit, alpha)
-    solve_i = make_bucketed_solver(ctx, item_packed, implicit, alpha)
+    solve_u = make_bucketed_solver(
+        ctx, user_packed, implicit, alpha, compute_dtype
+    )
+    solve_i = make_bucketed_solver(
+        ctx, item_packed, implicit, alpha, compute_dtype
+    )
 
     @partial(jax.jit, static_argnames=("n_iters",))
     def run(x, y, u_slabs, u_heavy, i_slabs, i_heavy, lam, n_iters):
@@ -814,7 +862,7 @@ def stage_sharded(
 
 def _sharded_half(
     y_full, side_slabs, side_heavy, inv_local, n_heavy_local,
-    implicit, alpha, lam,
+    implicit, alpha, lam, compute=None,
 ):
     """One solve direction, written per-device (shard_map body).
 
@@ -827,7 +875,7 @@ def _sharded_half(
     heavy_groups = [side_heavy] if side_heavy else []
     x_stats = _assemble_and_solve(
         y_full, side_slabs, heavy_groups, n_heavy_local,
-        implicit, alpha, lam,
+        implicit, alpha, lam, compute,
     )
     # device-major reassembly: model (minor) then data (major) matches
     # the P((data, model)) row split of the slabs
@@ -851,6 +899,7 @@ def make_sharded_train_step(
     i_side: ShardedSide,
     implicit: bool,
     alpha: float,
+    compute_dtype: str | None = None,
 ):
     """Fused multi-epoch trainer with model-sharded factor matrices.
 
@@ -863,6 +912,7 @@ def make_sharded_train_step(
     i_slab_specs, i_heavy_specs = _sharded_specs(i_side)
     u_nh = u_side.n_heavy_slots_local
     i_nh = i_side.n_heavy_slots_local
+    compute = _resolve_compute(compute_dtype)
 
     @partial(jax.jit, static_argnames=("n_iters",))
     def run(x, y, lam, n_iters):
@@ -871,18 +921,20 @@ def make_sharded_train_step(
             def it(_, carry):
                 xl, yl = carry
                 y_full = lax.all_gather(
-                    yl, MODEL_AXIS, axis=0, tiled=True
+                    yl.astype(compute) if compute is not None else yl,
+                    MODEL_AXIS, axis=0, tiled=True,
                 )
                 xl = _sharded_half(
                     y_full, u_slabs, u_heavy, u_inv, u_nh,
-                    implicit, alpha, lam_,
+                    implicit, alpha, lam_, compute,
                 )
                 x_full = lax.all_gather(
-                    xl, MODEL_AXIS, axis=0, tiled=True
+                    xl.astype(compute) if compute is not None else xl,
+                    MODEL_AXIS, axis=0, tiled=True,
                 )
                 yl = _sharded_half(
                     x_full, i_slabs, i_heavy, i_inv, i_nh,
-                    implicit, alpha, lam_,
+                    implicit, alpha, lam_, compute,
                 )
                 return xl, yl
 
@@ -909,19 +961,25 @@ def make_sharded_train_step(
 
 
 def make_sharded_half_step(
-    ctx: ComputeContext, side: ShardedSide, implicit: bool, alpha: float
+    ctx: ComputeContext, side: ShardedSide, implicit: bool, alpha: float,
+    compute_dtype: str | None = None,
 ):
     """Single-direction sharded solve: ``(y, lam) → x`` (both P(model))."""
     mesh = ctx.mesh
     slab_specs, heavy_specs = _sharded_specs(side)
     nh = side.n_heavy_slots_local
+    compute = _resolve_compute(compute_dtype)
 
     @jax.jit
     def solve_once(y, lam):
         def body(y_loc, slabs, heavy, inv, lam_):
-            y_full = lax.all_gather(y_loc, MODEL_AXIS, axis=0, tiled=True)
+            y_full = lax.all_gather(
+                y_loc.astype(compute) if compute is not None else y_loc,
+                MODEL_AXIS, axis=0, tiled=True,
+            )
             return _sharded_half(
-                y_full, slabs, heavy, inv, nh, implicit, alpha, lam_
+                y_full, slabs, heavy, inv, nh, implicit, alpha, lam_,
+                compute,
             )
 
         f = jax.shard_map(
@@ -1008,6 +1066,7 @@ def train_als(
     row_chunk: int = 1024,
     s_max: int = 16,
     max_slab_slots: int = 2 << 20,
+    compute_dtype: str | None = None,
     dtype=jnp.float32,
     timer=None,
     checkpoint_dir: str | None = None,
@@ -1027,6 +1086,11 @@ def train_als(
     iterations (atomic npz) and ``resume=True`` continues from the
     latest checkpoint after a restart. ``row_chunk`` is retained for
     call compatibility (the bucketed layout needs no chunked scan).
+
+    ``compute_dtype`` ("bfloat16") runs the factor gather + Gramian
+    einsums in bf16 — half the HBM traffic of the bandwidth-bound stage
+    and double MXU rate; accumulation and the Cholesky solve stay f32
+    (also settable via ``PIO_ALS_COMPUTE_DTYPE``).
 
     ``factor_sharding`` selects the factor-matrix layout: "replicated"
     keeps both factor matrices replicated per device (1D data meshes);
@@ -1105,17 +1169,23 @@ def train_als(
         i_side = stage_sharded(
             ctx, item_packed, plan_shards(item_packed, ctx.n_devices)
         )
-        solve_u_half = make_sharded_half_step(ctx, u_side, implicit, alpha)
-        solve_i_half = make_sharded_half_step(ctx, i_side, implicit, alpha)
-        _run = make_sharded_train_step(ctx, u_side, i_side, implicit, alpha)
+        solve_u_half = make_sharded_half_step(
+            ctx, u_side, implicit, alpha, compute_dtype
+        )
+        solve_i_half = make_sharded_half_step(
+            ctx, i_side, implicit, alpha, compute_dtype
+        )
+        _run = make_sharded_train_step(
+            ctx, u_side, i_side, implicit, alpha, compute_dtype
+        )
 
         def step(x, y, n):
             return _run(x, y, lam, n_iters=n)
     else:
         u_slabs, u_heavy = _device_slabs(ctx, user_packed)
         i_slabs, i_heavy = _device_slabs(ctx, item_packed)
-        _su = make_solve_side(ctx, user_packed, implicit, alpha)
-        _si = make_solve_side(ctx, item_packed, implicit, alpha)
+        _su = make_solve_side(ctx, user_packed, implicit, alpha, compute_dtype)
+        _si = make_solve_side(ctx, item_packed, implicit, alpha, compute_dtype)
 
         def solve_u_half(y, lam_):
             return _su(y, u_slabs, u_heavy, lam_)
@@ -1123,7 +1193,9 @@ def train_als(
         def solve_i_half(x, lam_):
             return _si(x, i_slabs, i_heavy, lam_)
 
-        _run = make_train_step(ctx, user_packed, item_packed, implicit, alpha)
+        _run = make_train_step(
+            ctx, user_packed, item_packed, implicit, alpha, compute_dtype
+        )
 
         def step(x, y, n):
             return _run(

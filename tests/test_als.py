@@ -201,6 +201,41 @@ class TestNativePackParity:
             np.testing.assert_array_equal(pn.inv_perm, pf.inv_perm)
 
 
+class TestComputeDtype:
+    """bf16 gather/Gramian mode: reduced-precision stats, f32 accum +
+    solve — reconstructions must stay close to the f32 run."""
+
+    def test_bf16_factors_close_to_f32(self, ctx1):
+        rng = np.random.default_rng(6)
+        n_users, n_items, nnz = 40, 30, 600
+        rows = rng.integers(0, n_users, nnz).astype(np.int32)
+        cols = rng.integers(0, n_items, nnz).astype(np.int32)
+        vals = rng.uniform(0.5, 4.0, nnz).astype(np.float32)
+        kwargs = dict(
+            n_users=n_users, n_items=n_items, rank=4, iterations=3,
+            reg=0.1, block_len=8,
+        )
+        f32 = train_als(ctx1, rows, cols, vals, **kwargs)
+        bf16 = train_als(
+            ctx1, rows, cols, vals, compute_dtype="bfloat16", **kwargs
+        )
+        assert np.isfinite(bf16.user_factors).all()
+        # bf16 mantissa is 8 bits: expect agreement to ~1e-2 relative
+        err = np.abs(bf16.user_factors - f32.user_factors)
+        scale = np.abs(f32.user_factors).max()
+        assert err.max() / max(scale, 1e-6) < 0.05
+
+    def test_env_knob_resolves(self, monkeypatch):
+        from predictionio_tpu.ops.als import _resolve_compute
+
+        assert _resolve_compute(None) is None
+        assert _resolve_compute("float32") is None
+        assert _resolve_compute("bfloat16") == jnp.bfloat16
+        monkeypatch.setenv("PIO_ALS_COMPUTE_DTYPE", "bfloat16")
+        assert _resolve_compute(None) == jnp.bfloat16
+        assert _resolve_compute("float32") is None
+
+
 class TestSolveCorrectness:
     def test_matches_dense_reference(self, ctx8):
         """One deterministic seed: our mesh solve must match the dense
