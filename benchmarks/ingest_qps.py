@@ -1,0 +1,211 @@
+"""Event-ingest throughput benchmark.
+
+Measures the event-collection tier against BASELINE.md's event-server
+role (the reference's spray + HBase ingest path):
+
+* ``--mode backend`` — direct storage-backend insert throughput
+  (single + batch), no HTTP: the storage ceiling.
+* ``--mode http`` (default) — end-to-end ``POST /batch/events.json``
+  (50-event batches, the reference's request cap) through the real
+  event server with access-key auth: the service number.
+
+Run: ``python benchmarks/ingest_qps.py [--mode http|backend]
+[--backend sqlite|eventlog|memory] [--seconds 10] [--clients 8]``
+Prints one JSON line: {"metric": "ingest_eps", ...}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import sys
+import tempfile
+import threading
+import time
+
+
+def make_storage(backend: str, tmp: str):
+    from predictionio_tpu.data.storage import (
+        AccessKey,
+        App,
+        Storage,
+        set_storage,
+    )
+
+    env = {
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    }
+    if backend == "memory":
+        env["PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE"] = "MEM"
+    elif backend == "sqlite":
+        env.update({
+            "PIO_STORAGE_SOURCES_SQL_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_SQL_PATH": f"{tmp}/ingest.sqlite",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQL",
+        })
+    elif backend == "eventlog":
+        env.update({
+            "PIO_STORAGE_SOURCES_ELOG_TYPE": "eventlog",
+            "PIO_STORAGE_SOURCES_ELOG_PATH": f"{tmp}/elog",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "ELOG",
+        })
+    else:
+        raise SystemExit(f"unknown backend {backend}")
+    storage = Storage(env=env)
+    set_storage(storage)
+    app_id = storage.get_meta_data_apps().insert(
+        App(id=0, name="ingestapp")
+    )
+    key = storage.get_meta_data_access_keys().insert(
+        AccessKey(key="", appid=app_id, events=())
+    )
+    storage.get_events().init(app_id)
+    return storage, app_id, key
+
+
+def _event_dict(i: int) -> dict:
+    return {
+        "event": "rate",
+        "entityType": "user",
+        "entityId": f"u{i % 5000}",
+        "targetEntityType": "item",
+        "targetEntityId": f"i{i % 800}",
+        "properties": {"rating": float(i % 5 + 1)},
+    }
+
+
+def bench_backend(storage, app_id: int, seconds: float) -> dict:
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.event import Event
+
+    events = storage.get_events()
+
+    def mk(i):
+        d = _event_dict(i)
+        return Event(
+            event=d["event"], entity_type=d["entityType"],
+            entity_id=d["entityId"],
+            target_entity_type=d["targetEntityType"],
+            target_entity_id=d["targetEntityId"],
+            properties=DataMap(d["properties"]),
+        )
+
+    # single-event inserts
+    n, i = 0, 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds / 2:
+        events.insert(mk(i), app_id)
+        i += 1
+        n += 1
+    single_eps = n / (time.perf_counter() - t0)
+    # 50-event batches (the API cap)
+    n = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds / 2:
+        events.insert_batch([mk(i + j) for j in range(50)], app_id)
+        i += 50
+        n += 50
+    batch_eps = n / (time.perf_counter() - t0)
+    return {"single_eps": round(single_eps, 1),
+            "batch_eps": round(batch_eps, 1)}
+
+
+def bench_http(
+    storage, key: str, seconds: float, clients: int, port: int
+) -> dict:
+    from predictionio_tpu.serving.event_server import create_event_server
+
+    http_srv = create_event_server(host="127.0.0.1", port=port)
+    http_srv.start()
+    port = http_srv.port
+    counts = [0] * clients
+    errors = [0] * clients
+    stop_at = time.perf_counter() + seconds
+
+    def worker(w: int):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        i = w * 1_000_000
+        while time.perf_counter() < stop_at:
+            batch = [_event_dict(i + j) for j in range(50)]
+            i += 50
+            try:
+                conn.request(
+                    "POST", f"/batch/events.json?accessKey={key}",
+                    json.dumps(batch),
+                    {"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                body = resp.read()
+                if resp.status == 200:
+                    counts[w] += sum(
+                        1 for r in json.loads(body) if r.get("status") == 201
+                    )
+                else:
+                    errors[w] += 1
+            except Exception:
+                errors[w] += 1
+                conn.close()
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", port, timeout=30
+                )
+        conn.close()
+
+    threads = [
+        threading.Thread(target=worker, args=(w,)) for w in range(clients)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    http_srv.shutdown()
+    return {
+        "eps": round(sum(counts) / elapsed, 1),
+        "errors": sum(errors),
+        "clients": clients,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=["http", "backend"], default="http")
+    ap.add_argument(
+        "--backend", choices=["memory", "sqlite", "eventlog"],
+        default="eventlog",
+    )
+    ap.add_argument("--seconds", type=float, default=10.0)
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args()
+
+    with tempfile.TemporaryDirectory(prefix="pio-ingest-") as tmp:
+        storage, app_id, key = make_storage(args.backend, tmp)
+        if args.mode == "backend":
+            r = bench_backend(storage, app_id, args.seconds)
+            print(json.dumps({
+                "metric": "ingest_eps_backend",
+                "value": r["batch_eps"],
+                "unit": "events/s",
+                "backend": args.backend,
+                "extra": r,
+            }))
+        else:
+            r = bench_http(
+                storage, key, args.seconds, args.clients, args.port
+            )
+            print(json.dumps({
+                "metric": "ingest_eps_http",
+                "value": r["eps"],
+                "unit": "events/s",
+                "backend": args.backend,
+                "extra": r,
+            }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

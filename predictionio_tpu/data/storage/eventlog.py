@@ -296,42 +296,74 @@ class EventLogEvents(EventsBackend):
             self._logs.clear()
 
     # -- writes -----------------------------------------------------------
-    def insert(
-        self, event: Event, app_id: int, channel_id: int | None = None
-    ) -> str:
-        log = self._log(app_id, channel_id)
-        stamped = event.with_id(event.event_id)
-        blob = json.dumps(
+    @staticmethod
+    def _make_blob(stamped: Event) -> bytes:
+        """Serialize the varlen payload OUTSIDE the write lock — JSON
+        encoding of large property maps must not extend the critical
+        section shared by all writer threads/processes."""
+        return json.dumps(
             {
                 "properties": stamped.properties.to_dict(),
                 "tags": list(stamped.tags),
                 "prId": stamped.pr_id,
             }
         ).encode()
+
+    @staticmethod
+    def _append_one(log, stamped: Event, blob: bytes) -> int:
+        """Intern + append one event; caller holds ``log.write_lock``."""
+        ev = log.intern(stamped.event)
+        ety = log.intern(stamped.entity_type)
+        eid = log.intern(stamped.entity_id)
+        tty = (
+            log.intern(stamped.target_entity_type)
+            if stamped.target_entity_type is not None
+            else -1
+        )
+        tid = (
+            log.intern(stamped.target_entity_id)
+            if stamped.target_entity_id is not None
+            else -1
+        )
+        rid = stamped.event_id.encode()
+        return log.lib.pio_append(
+            log.handle, 1,
+            stamped.event_time.timestamp(),
+            stamped.creation_time.timestamp(),
+            ev, ety, eid, tty, tid, rid, len(rid), blob, len(blob),
+        )
+
+    def insert(
+        self, event: Event, app_id: int, channel_id: int | None = None
+    ) -> str:
+        log = self._log(app_id, channel_id)
+        stamped = event.with_id(event.event_id)
+        blob = self._make_blob(stamped)
         with log.write_lock():
-            ev = log.intern(stamped.event)
-            ety = log.intern(stamped.entity_type)
-            eid = log.intern(stamped.entity_id)
-            tty = (
-                log.intern(stamped.target_entity_type)
-                if stamped.target_entity_type is not None
-                else -1
-            )
-            tid = (
-                log.intern(stamped.target_entity_id)
-                if stamped.target_entity_id is not None
-                else -1
-            )
-            rid = stamped.event_id.encode()
-            rc = log.lib.pio_append(
-                log.handle, 1,
-                stamped.event_time.timestamp(),
-                stamped.creation_time.timestamp(),
-                ev, ety, eid, tty, tid, rid, len(rid), blob, len(blob),
-            )
+            rc = self._append_one(log, stamped, blob)
         if rc != 0:
             raise OSError("event log append failed")
         return stamped.event_id
+
+    def insert_batch(
+        self,
+        events: Sequence[Event],
+        app_id: int,
+        channel_id: int | None = None,
+    ) -> list[str]:
+        """One write_lock (thread lock + flock + dict resync) for the
+        whole batch — the per-event locking of the default implementation
+        dominated batch-ingest throughput."""
+        if not events:
+            return []
+        log = self._log(app_id, channel_id)
+        stamped = [e.with_id(e.event_id) for e in events]
+        blobs = [self._make_blob(e) for e in stamped]
+        with log.write_lock():
+            for ev_obj, blob in zip(stamped, blobs):
+                if self._append_one(log, ev_obj, blob) != 0:
+                    raise OSError("event log append failed")
+        return [e.event_id for e in stamped]
 
     def delete(
         self, event_id: str, app_id: int, channel_id: int | None = None

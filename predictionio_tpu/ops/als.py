@@ -442,11 +442,12 @@ def _resolve_compute(compute_dtype: str | None):
         return None
     if name in ("bfloat16", "bf16"):
         return jnp.bfloat16
-    if name in ("float16", "f16", "half"):
-        return jnp.float16
+    # no float16: its 65504 max overflows implicit-mode confidence
+    # weights (alpha × counts) and _solve would silently zero the
+    # affected rows; bf16 has the f32 exponent range and is immune
     raise ValueError(
         f"unsupported ALS compute_dtype {name!r}; supported: "
-        "float32/f32, bfloat16/bf16, float16/f16"
+        "float32/f32, bfloat16/bf16"
     )
 
 
