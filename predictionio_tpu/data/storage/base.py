@@ -39,6 +39,17 @@ class StorageError(RuntimeError):
 # Reference-spelled alias
 StorageClientException = StorageError
 
+
+class PartialBatchError(StorageError):
+    """A batch insert failed partway; ``inserted_ids`` are the events
+    durably stored BEFORE the failure (append-only backends cannot roll
+    them back). Callers report per-event success so client retries can
+    resend only the unsaved suffix."""
+
+    def __init__(self, message: str, inserted_ids: list[str]):
+        super().__init__(message)
+        self.inserted_ids = inserted_ids
+
 # --------------------------------------------------------------------------
 # Metadata records
 # --------------------------------------------------------------------------

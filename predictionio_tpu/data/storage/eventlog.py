@@ -30,7 +30,10 @@ import numpy as np
 from predictionio_tpu.data.datamap import DataMap
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.eventframe import Interactions
-from predictionio_tpu.data.storage.base import EventsBackend
+from predictionio_tpu.data.storage.base import (
+    EventsBackend,
+    PartialBatchError,
+)
 from predictionio_tpu.utils.bimap import BiMap
 
 _lib = None
@@ -359,11 +362,17 @@ class EventLogEvents(EventsBackend):
         log = self._log(app_id, channel_id)
         stamped = [e.with_id(e.event_id) for e in events]
         blobs = [self._make_blob(e) for e in stamped]
+        done: list[str] = []
         with log.write_lock():
             for ev_obj, blob in zip(stamped, blobs):
                 if self._append_one(log, ev_obj, blob) != 0:
-                    raise OSError("event log append failed")
-        return [e.event_id for e in stamped]
+                    # append-only log: the prefix is durable — report
+                    # exactly what landed so clients retry only the rest
+                    raise PartialBatchError(
+                        "event log append failed mid-batch", done
+                    )
+                done.append(ev_obj.event_id)
+        return done
 
     def delete(
         self, event_id: str, app_id: int, channel_id: int | None = None

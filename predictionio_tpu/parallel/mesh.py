@@ -55,6 +55,21 @@ def devices_with_timeout() -> list:
     import os
     import threading
 
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if env_platforms:
+        # a site plugin may have re-pinned jax_platforms after jax
+        # parsed the environment; the user's explicit choice wins
+        # (otherwise JAX_PLATFORMS=cpu still dials a remote TPU)
+        try:
+            jax.config.update("jax_platforms", env_platforms)
+        except Exception:  # noqa: BLE001 - backend already initialized
+            logger.warning(
+                "JAX_PLATFORMS=%s could not be re-asserted (backend "
+                "already initialized on another platform); the env var "
+                "is NOT in effect for this process",
+                env_platforms,
+            )
+
     raw = os.environ.get("PIO_DEVICE_INIT_TIMEOUT_S", "300")
     try:
         timeout = float(raw)

@@ -258,18 +258,29 @@ class EventServer:
                 ids = self._storage.get_events().insert_batch(
                     [e for _, e, _ in accepted], app_id, channel_id
                 )
-            except Exception:  # noqa: BLE001 - per-item contract
+            except Exception as exc:  # noqa: BLE001 - per-item contract
                 # storage failed mid-batch: keep the per-event status
                 # list (rejections already computed) instead of blowing
-                # up the whole response with a bare 500
+                # up the whole response with a bare 500. Backends that
+                # report the durable prefix (PartialBatchError) let
+                # clients retry only the unsaved suffix.
                 logger.exception("batch insert failed")
-                for slot, _, _ in accepted:
-                    results[slot] = {
-                        "status": 500,
-                        "message": "storage error; event may not be saved",
-                    }
-                    if self._stats:
-                        self._stats.update(app_id, 500)
+                saved = list(getattr(exc, "inserted_ids", ()))
+                for i, (slot, event, _) in enumerate(accepted):
+                    if i < len(saved):
+                        results[slot] = {
+                            "status": 201, "eventId": saved[i]
+                        }
+                        if self._stats:
+                            self._stats.update(app_id, 201, event)
+                    else:
+                        results[slot] = {
+                            "status": 500,
+                            "message":
+                                "storage error; event was not saved",
+                        }
+                        if self._stats:
+                            self._stats.update(app_id, 500)
                 return Response(200, results)
             for (slot, event, event_json), event_id in zip(accepted, ids):
                 results[slot] = {"status": 201, "eventId": event_id}

@@ -144,6 +144,34 @@ class TestEventAPI:
         assert status == 200
         assert [r["status"] for r in body] == [201, 400, 201, 201]
 
+    def test_batch_partial_storage_failure(self, server, monkeypatch):
+        """Mid-batch storage failure: slots keep per-event statuses —
+        the durable prefix reports 201, the unsaved suffix 500 — so
+        clients can retry only what was lost."""
+        from predictionio_tpu.data.storage.base import PartialBatchError
+
+        base, key, _ = server
+
+        def explode(self, events, app_id, channel_id=None):
+            raise PartialBatchError("disk full", ["id-0", "id-1"])
+
+        import predictionio_tpu.data.storage as storage_mod
+
+        events_backend = storage_mod.get_storage().get_events()
+        monkeypatch.setattr(
+            type(events_backend), "insert_batch", explode
+        )
+        payload = [_event("view", f"p{i}") for i in range(4)]
+        payload.insert(2, {"event": "$bad", "entityType": "u",
+                           "entityId": "x"})
+        status, body = _call(
+            f"{base}/batch/events.json?accessKey={key}", "POST", payload
+        )
+        assert status == 200
+        assert [r["status"] for r in body] == [201, 201, 400, 500, 500]
+        assert body[0]["eventId"] == "id-0"
+        assert "not saved" in body[3]["message"]
+
     def test_batch_limit_50(self, server):
         base, key, _ = server
         status, body = _call(
