@@ -1,0 +1,52 @@
+"""Evaluation / tuning for the recommendation template.
+
+Run:  pio-tpu eval examples.recommendation.evaluation:evaluation
+(or copy next to your engine and adjust the grid). Mirrors the
+reference templates' ``Evaluation.scala``: a metric plus a candidate
+parameter grid; ``pio-tpu eval`` ranks the candidates and records an
+evaluation instance (one-liner/HTML/JSON, visible on the dashboard).
+"""
+
+from predictionio_tpu.core.engine import EngineParams
+from predictionio_tpu.core.evaluation import AverageMetric, Evaluation
+from predictionio_tpu.models.recommendation import (
+    ALSParams,
+    RecDataSourceParams,
+    RecPreparatorParams,
+    recommendation_engine,
+)
+
+
+class PrecisionAtK(AverageMetric):
+    """Fraction of the top-k recommendations that are held-out actuals."""
+
+    def __init__(self, k: int = 10):
+        self.k = k
+
+    def calculate_point(self, eval_info, query, prediction, actual):
+        top = [
+            s["item"] for s in prediction.get("itemScores", [])[: self.k]
+        ]
+        if not top:
+            return 0.0
+        return len(set(top) & set(actual)) / float(self.k)
+
+
+def evaluation(app_name: str = "MyRecApp") -> Evaluation:
+    grid = [
+        EngineParams(
+            data_source=(
+                "", RecDataSourceParams(app_name=app_name, eval_k=3)
+            ),
+            preparator=("", RecPreparatorParams()),
+            algorithms=[
+                ("als", ALSParams(rank=rank, num_iterations=5))
+            ],
+        )
+        for rank in (8, 16, 32)
+    ]
+    return Evaluation(
+        engine=recommendation_engine(),
+        metric=PrecisionAtK(k=10),
+        engine_params_list=grid,
+    )

@@ -59,16 +59,24 @@ def devices_with_timeout() -> list:
     if env_platforms:
         # a site plugin may have re-pinned jax_platforms after jax
         # parsed the environment; the user's explicit choice wins
-        # (otherwise JAX_PLATFORMS=cpu still dials a remote TPU)
+        # (otherwise JAX_PLATFORMS=cpu still dials a remote TPU).
+        # updating the config after backends initialized silently
+        # no-ops, so detect that state explicitly and say so.
         try:
-            jax.config.update("jax_platforms", env_platforms)
-        except Exception:  # noqa: BLE001 - backend already initialized
+            from jax._src import xla_bridge as _xb
+
+            already = _xb.backends_are_initialized()
+        except Exception:  # noqa: BLE001 - private API moved
+            already = False
+        if already:
             logger.warning(
-                "JAX_PLATFORMS=%s could not be re-asserted (backend "
-                "already initialized on another platform); the env var "
-                "is NOT in effect for this process",
+                "JAX_PLATFORMS=%s cannot take effect: a backend is "
+                "already initialized in this process (a site plugin or "
+                "earlier import selected the platform first)",
                 env_platforms,
             )
+        else:
+            jax.config.update("jax_platforms", env_platforms)
 
     raw = os.environ.get("PIO_DEVICE_INIT_TIMEOUT_S", "300")
     try:

@@ -28,6 +28,7 @@ from typing import Callable
 
 from predictionio_tpu.data.event import Event, EventValidationError
 from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.data.storage.base import PartialBatchError
 from predictionio_tpu.serving.http import (
     HTTPError,
     HTTPServer,
@@ -261,23 +262,32 @@ class EventServer:
             except Exception as exc:  # noqa: BLE001 - per-item contract
                 # storage failed mid-batch: keep the per-event status
                 # list (rejections already computed) instead of blowing
-                # up the whole response with a bare 500. Backends that
-                # report the durable prefix (PartialBatchError) let
-                # clients retry only the unsaved suffix.
+                # up the whole response with a bare 500. Only
+                # PartialBatchError guarantees which prefix is durable;
+                # other failures leave saved-ness unknown, and the
+                # message must say so (a false "not saved" invites
+                # duplicating retries).
                 logger.exception("batch insert failed")
-                saved = list(getattr(exc, "inserted_ids", ()))
-                for i, (slot, event, _) in enumerate(accepted):
+                if isinstance(exc, PartialBatchError):
+                    saved = list(exc.inserted_ids)
+                    fail_msg = "storage error; event was not saved"
+                else:
+                    saved = []
+                    fail_msg = "storage error; event may not be saved"
+                for i, (slot, event, event_json) in enumerate(accepted):
                     if i < len(saved):
                         results[slot] = {
                             "status": 201, "eventId": saved[i]
                         }
                         if self._stats:
                             self._stats.update(app_id, 201, event)
+                        if event_json is not None:
+                            self._plugins.sniff_input(
+                                event_json, app_id, channel_id
+                            )
                     else:
                         results[slot] = {
-                            "status": 500,
-                            "message":
-                                "storage error; event was not saved",
+                            "status": 500, "message": fail_msg,
                         }
                         if self._stats:
                             self._stats.update(app_id, 500)
