@@ -451,13 +451,36 @@ def _resolve_compute(compute_dtype: str | None):
     )
 
 
+def _resolve_gather_layout() -> str:
+    """Layout of the factor-gather temp (``PIO_ALS_GATHER_LAYOUT``),
+    resolved + validated ONCE at solver build (like _resolve_compute):
+
+    * ``kminor`` (default) — gather to ``[R, W, k]``. Simple, but the
+      minor dim is the rank: XLA lane-pads k=32 to 128, 4× the HBM
+      footprint and traffic of the epoch's biggest temp.
+    * ``kmajor`` — gather to ``[k, R, W]``: the minor dim is the slot
+      width, unpadded whenever ``s·block_len`` is a multiple of 128
+      (true for every bucket with s ≥ 2 at the default block_len=64;
+      the s=1 bucket stays lane-padded). Same math, same results —
+      which wins is measured per hardware.
+    """
+    name = os.environ.get(
+        "PIO_ALS_GATHER_LAYOUT", "kminor"
+    ).strip().lower()
+    if name not in ("kminor", "kmajor"):
+        raise ValueError(
+            f"unsupported PIO_ALS_GATHER_LAYOUT {name!r}; "
+            "supported: kminor, kmajor"
+        )
+    return name
+
+
 def _slab_stats(y, idx, weights, valid, implicit, alpha, dtype,
-                compute=None):
+                compute=None, gather_layout="kminor"):
     """Per-row normal-equation pieces for one dense slab — pure MXU."""
     # y arrives pre-cast to `compute` (see _assemble_and_solve), so the
     # gather temp itself is low-precision — that is where the memory and
     # bandwidth live
-    yg = y[idx]  # [R, W, k] gather (unique rows per device slice)
     mask = valid  # a real 0-valued explicit rating still counts
     if implicit:
         aw = alpha * weights * mask          # C − I (zero on padding)
@@ -468,10 +491,23 @@ def _slab_stats(y, idx, weights, valid, implicit, alpha, dtype,
     if compute is not None:
         aw = aw.astype(compute)
         bw = bw.astype(compute)
-    a = jnp.einsum(
-        "rlk,rl,rlm->rkm", yg, aw, yg, preferred_element_type=dtype
-    )
-    b = jnp.einsum("rlk,rl->rk", yg, bw, preferred_element_type=dtype)
+    if gather_layout == "kmajor":
+        ygT = jnp.take(y.T, idx, axis=1)  # [k, R, W] — unpadded minor W
+        a = jnp.einsum(
+            "krl,rl,mrl->rkm", ygT, aw, ygT,
+            preferred_element_type=dtype,
+        )
+        b = jnp.einsum(
+            "krl,rl->rk", ygT, bw, preferred_element_type=dtype
+        )
+    else:
+        yg = y[idx]  # [R, W, k] gather (unique rows per device slice)
+        a = jnp.einsum(
+            "rlk,rl,rlm->rkm", yg, aw, yg, preferred_element_type=dtype
+        )
+        b = jnp.einsum(
+            "rlk,rl->rk", yg, bw, preferred_element_type=dtype
+        )
     cnt = mask.sum(axis=1)
     return a, b, cnt
 
@@ -542,7 +578,7 @@ def _solve(a, b, cnt, yty, lam, implicit, k, dtype):
 
 def _assemble_and_solve(
     y, slab_arrays, heavy_groups, n_heavy_slots,
-    implicit, alpha, lam, compute=None,
+    implicit, alpha, lam, compute=None, gather_layout="kminor",
 ):
     """Shared one-direction solve body: slab stats → heavy scatter-add →
     batched normal-equation solve. Used by both the replicated
@@ -567,7 +603,8 @@ def _assemble_and_solve(
     parts_a, parts_b, parts_cnt = [], [], []
     for (idx, weights, valid) in slab_arrays:
         a, b, cnt = _slab_stats(
-            y, idx, weights, valid, implicit, alpha, dtype, compute
+            y, idx, weights, valid, implicit, alpha, dtype, compute,
+            gather_layout,
         )
         parts_a.append(a)
         parts_b.append(b)
@@ -581,7 +618,8 @@ def _assemble_and_solve(
     cnt = jnp.concatenate(parts_cnt, axis=0)
     for (idx, weights, valid, owner) in heavy_groups:
         ha, hb, hcnt = _slab_stats(
-            y, idx, weights, valid, implicit, alpha, dtype, compute
+            y, idx, weights, valid, implicit, alpha, dtype, compute,
+            gather_layout,
         )
         owner = jnp.asarray(owner)
         # few sub-rows (head of the power law): small scatter-add
@@ -619,6 +657,7 @@ def make_bucketed_solver(
     heavy_owners = packed.heavy_owner_pos
     replicated = ctx.replicated
     compute = _resolve_compute(compute_dtype)
+    gather_layout = _resolve_gather_layout()
 
     def solve(y, slab_arrays, heavy_arrays, lam):
         heavy_groups = [
@@ -627,7 +666,7 @@ def make_bucketed_solver(
         ]
         x_stats = _assemble_and_solve(
             y, slab_arrays, heavy_groups, n_heavy_slots,
-            implicit, alpha, lam, compute,
+            implicit, alpha, lam, compute, gather_layout,
         )
         x = jnp.take(x_stats, jnp.asarray(inv_perm), axis=0)
         return jax.lax.with_sharding_constraint(x, replicated)
@@ -863,7 +902,7 @@ def stage_sharded(
 
 def _sharded_half(
     y_full, side_slabs, side_heavy, inv_local, n_heavy_local,
-    implicit, alpha, lam, compute=None,
+    implicit, alpha, lam, compute=None, gather_layout="kminor",
 ):
     """One solve direction, written per-device (shard_map body).
 
@@ -876,7 +915,7 @@ def _sharded_half(
     heavy_groups = [side_heavy] if side_heavy else []
     x_stats = _assemble_and_solve(
         y_full, side_slabs, heavy_groups, n_heavy_local,
-        implicit, alpha, lam, compute,
+        implicit, alpha, lam, compute, gather_layout,
     )
     # device-major reassembly: model (minor) then data (major) matches
     # the P((data, model)) row split of the slabs
@@ -914,6 +953,7 @@ def make_sharded_train_step(
     u_nh = u_side.n_heavy_slots_local
     i_nh = i_side.n_heavy_slots_local
     compute = _resolve_compute(compute_dtype)
+    gather_layout = _resolve_gather_layout()
 
     @partial(jax.jit, static_argnames=("n_iters",))
     def run(x, y, lam, n_iters):
@@ -927,7 +967,7 @@ def make_sharded_train_step(
                 )
                 xl = _sharded_half(
                     y_full, u_slabs, u_heavy, u_inv, u_nh,
-                    implicit, alpha, lam_, compute,
+                    implicit, alpha, lam_, compute, gather_layout,
                 )
                 x_full = lax.all_gather(
                     xl.astype(compute) if compute is not None else xl,
@@ -935,7 +975,7 @@ def make_sharded_train_step(
                 )
                 yl = _sharded_half(
                     x_full, i_slabs, i_heavy, i_inv, i_nh,
-                    implicit, alpha, lam_, compute,
+                    implicit, alpha, lam_, compute, gather_layout,
                 )
                 return xl, yl
 
@@ -970,6 +1010,7 @@ def make_sharded_half_step(
     slab_specs, heavy_specs = _sharded_specs(side)
     nh = side.n_heavy_slots_local
     compute = _resolve_compute(compute_dtype)
+    gather_layout = _resolve_gather_layout()
 
     @jax.jit
     def solve_once(y, lam):
@@ -980,7 +1021,7 @@ def make_sharded_half_step(
             )
             return _sharded_half(
                 y_full, slabs, heavy, inv, nh, implicit, alpha, lam_,
-                compute,
+                compute, gather_layout,
             )
 
         f = jax.shard_map(

@@ -225,6 +225,24 @@ class TestComputeDtype:
         scale = np.abs(f32.user_factors).max()
         assert err.max() / max(scale, 1e-6) < 0.05
 
+    def test_kmajor_gather_layout_identical(self, ctx1, monkeypatch):
+        """The kmajor gather formulation (unpadded [k, R, W] temp) must
+        produce the same factors as the default layout."""
+        rng = np.random.default_rng(8)
+        rows = rng.integers(0, 40, 600).astype(np.int32)
+        cols = rng.integers(0, 30, 600).astype(np.int32)
+        vals = rng.uniform(0.5, 4.0, 600).astype(np.float32)
+        kwargs = dict(
+            n_users=40, n_items=30, rank=4, iterations=3, reg=0.1,
+            block_len=8,
+        )
+        base = train_als(ctx1, rows, cols, vals, **kwargs)
+        monkeypatch.setenv("PIO_ALS_GATHER_LAYOUT", "kmajor")
+        km = train_als(ctx1, rows, cols, vals, **kwargs)
+        np.testing.assert_allclose(
+            km.user_factors, base.user_factors, rtol=1e-4, atol=1e-6
+        )
+
     def test_env_knob_resolves(self, monkeypatch):
         from predictionio_tpu.ops.als import _resolve_compute
 
