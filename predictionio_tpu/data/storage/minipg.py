@@ -58,6 +58,59 @@ _SCHEMA_SUBS = (
 _BYTEA_LITERAL = re.compile(r"'\\x([0-9a-fA-F]*)'::bytea")
 
 
+def split_statements(sql: str) -> list[str]:
+    """Split a simple-protocol Query into its ``;``-separated
+    statements (clients batch executemany rows into one multi-statement
+    Query), respecting single-quoted literals, double-quoted
+    identifiers, ``--`` line comments, and ``/* */`` block comments."""
+    out: list[str] = []
+    buf: list[str] = []
+    mode = ""  # "", "'", '"', "--", "/*"
+    i, n = 0, len(sql)
+    while i < n:
+        ch = sql[i]
+        two = sql[i:i + 2]
+        if mode in ("'", '"'):
+            buf.append(ch)
+            if ch == mode:
+                if two == mode * 2:  # doubled quote stays inside
+                    buf.append(ch)
+                    i += 1
+                else:
+                    mode = ""
+        elif mode == "--":
+            buf.append(ch)
+            if ch == "\n":
+                mode = ""
+        elif mode == "/*":
+            buf.append(ch)
+            if two == "*/":
+                buf.append("/")
+                i += 1
+                mode = ""
+        elif ch in ("'", '"'):
+            mode = ch
+            buf.append(ch)
+        elif two == "--":
+            mode = "--"
+            buf.append(ch)
+        elif two == "/*":
+            mode = "/*"
+            buf.append(ch)
+        elif ch == ";":
+            stmt = "".join(buf).strip()
+            if stmt:
+                out.append(stmt)
+            buf = []
+        else:
+            buf.append(ch)
+        i += 1
+    stmt = "".join(buf).strip()
+    if stmt:
+        out.append(stmt)
+    return out
+
+
 def translate_sql(sql: str) -> str:
     """Postgres-dialect SQL → sqlite SQL."""
     # literals first: the BYTEA type substitution would eat '::bytea' casts
@@ -108,8 +161,17 @@ class _Handler(socketserver.BaseRequestHandler):
 
     server: "_TCP"
 
+    def setup(self):
+        # many small protocol messages per query: without NODELAY,
+        # Nagle + delayed ACK adds ~40ms stalls per round trip
+        self.request.setsockopt(
+            socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+        )
+        self._out: list[bytes] = []
+
     # -- framing -----------------------------------------------------------
     def _read_exact(self, n: int) -> bytes:
+        self._flush()  # client waits on our output before sending more
         buf = b""
         while len(buf) < n:
             chunk = self.request.recv(n - len(buf))
@@ -128,9 +190,16 @@ class _Handler(socketserver.BaseRequestHandler):
         return header[:1], self._read_exact(length - 4)
 
     def _send(self, type_byte: bytes, payload: bytes = b"") -> None:
-        self.request.sendall(
+        # buffered: one syscall per protocol turn (flushed before every
+        # blocking read), not one per message
+        self._out.append(
             type_byte + struct.pack("!I", len(payload) + 4) + payload
         )
+
+    def _flush(self) -> None:
+        if self._out:
+            self.request.sendall(b"".join(self._out))
+            self._out.clear()
 
     def _send_error(self, sqlstate: str, msg: str) -> None:
         self._send(
@@ -225,19 +294,22 @@ class _Handler(socketserver.BaseRequestHandler):
         return True
 
     # -- query execution ---------------------------------------------------
-    def _run_query(self, conn: sqlite3.Connection, sql: str) -> None:
+    def _run_query(self, conn: sqlite3.Connection, sql: str) -> bool:
+        """Execute ONE statement; returns False when it errored (a
+        multi-statement Query stops at the first failure, like the
+        reference server)."""
         stripped = sql.strip().rstrip(";").strip()
         word = stripped.split(None, 1)[0].upper() if stripped else ""
         if not stripped:
             self._send(b"I")  # EmptyQueryResponse
-            return
+            return True
         if self._failed_tx and word not in ("ROLLBACK", "COMMIT", "ABORT"):
             self._send_error(
                 "25P02",
                 "current transaction is aborted, commands ignored "
                 "until end of transaction block",
             )
-            return
+            return False
         try:
             cur = conn.execute(translate_sql(stripped))
             rows = cur.fetchall() if cur.description else None
@@ -245,7 +317,7 @@ class _Handler(socketserver.BaseRequestHandler):
             if self._in_tx:
                 self._failed_tx = True
             self._send_error(_sqlstate_for(exc), str(exc))
-            return
+            return False
         if word in ("BEGIN",):
             self._in_tx, self._failed_tx = True, False
         elif word in ("COMMIT", "ROLLBACK", "ABORT", "END"):
@@ -278,6 +350,37 @@ class _Handler(socketserver.BaseRequestHandler):
             n = max(cur.rowcount, 0)
             tag = f"INSERT 0 {n}" if word == "INSERT" else f"{word} {n}"
         self._send(b"C", tag.encode("ascii") + b"\x00")
+        return True
+
+    _TX_WORDS = ("BEGIN", "COMMIT", "ROLLBACK", "ABORT", "END")
+
+    def _run_multi(self, conn: sqlite3.Connection, sql: str) -> None:
+        """One Query message: possibly several statements. Outside an
+        explicit transaction, a multi-statement Query is atomic (the
+        reference wraps the whole simple-protocol Query in an implicit
+        transaction); statements stop at the first failure."""
+        stmts = split_statements(sql) or [""]
+        implicit = (
+            len(stmts) > 1
+            and not self._in_tx
+            and not any(
+                s.split(None, 1)[0].upper() in self._TX_WORDS
+                for s in stmts if s
+            )
+        )
+        if implicit:
+            conn.execute("BEGIN")
+        ok = True
+        for stmt in stmts:
+            if not self._run_query(conn, stmt):
+                ok = False
+                break
+        if implicit:
+            try:
+                conn.execute("COMMIT" if ok else "ROLLBACK")
+            except sqlite3.Error:
+                pass
+            self._failed_tx = False  # implicit tx ends with the Query
 
     def handle(self) -> None:
         try:
@@ -310,7 +413,7 @@ class _Handler(socketserver.BaseRequestHandler):
                     if mtype == b"X":
                         return
                     if mtype == b"Q":
-                        self._run_query(
+                        self._run_multi(
                             conn, payload.rstrip(b"\x00").decode("utf-8")
                         )
                         self._ready(
@@ -335,6 +438,11 @@ class _Handler(socketserver.BaseRequestHandler):
             pass
         except Exception:  # noqa: BLE001 - server loop must not die
             logger.exception("minipg session failed")
+        finally:
+            try:
+                self._flush()  # error responses on terminal paths
+            except OSError:
+                pass
 
 
 class _TCP(socketserver.ThreadingTCPServer):

@@ -333,7 +333,13 @@ class Connection:
 
     # -- query execution ---------------------------------------------------
     def _query(self, sql: str) -> tuple[list, list, int]:
-        """Run one simple-protocol query; returns (columns, rows, rowcount)."""
+        """Run one simple-protocol query; returns (columns, rows, rowcount).
+
+        The query string may contain several ``;``-separated statements
+        (the simple protocol runs them in one round trip — how
+        ``executemany`` amortizes network latency); ``rowcount`` is then
+        the SUM of the per-statement affected-row counts.
+        """
         if self._closed:
             raise InterfaceError("connection is closed")
         self._wire.send(b"Q", sql.encode("utf-8") + b"\x00")
@@ -370,7 +376,10 @@ class Connection:
             elif mtype == b"C":  # CommandComplete: e.g. "INSERT 0 3"
                 tag = payload.rstrip(b"\x00").decode("ascii")
                 tail = tag.rsplit(" ", 1)[-1]
-                rowcount = int(tail) if tail.isdigit() else -1
+                if tail.isdigit():
+                    rowcount = (
+                        int(tail) if rowcount < 0 else rowcount + int(tail)
+                    )
             elif mtype == b"E":
                 error = _parse_error(payload)
             elif mtype == b"Z":
@@ -432,14 +441,33 @@ class Cursor:
         self._rows, self._idx, self.rowcount = rows, 0, rowcount
         return self
 
+    #: statements per round trip in executemany (bounds message size)
+    EXECUTEMANY_CHUNK = 200
+
     def executemany(
         self, sql: str, seq_of_params: Iterable[Sequence[Any]]
     ) -> "Cursor":
+        """Interpolate every row and ship them in ``;``-joined groups —
+        one network round trip per EXECUTEMANY_CHUNK statements instead
+        of one per row (the simple protocol runs a multi-statement
+        Query atomically within the surrounding transaction)."""
+        import itertools
+
+        stmt_iter = (
+            interpolate(sql, tuple(params)) for params in seq_of_params
+        )
         total = 0
-        for params in seq_of_params:
-            self.execute(sql, params)
-            if self.rowcount > 0:
-                total += self.rowcount
+        while True:
+            chunk = list(
+                itertools.islice(stmt_iter, self.EXECUTEMANY_CHUNK)
+            )
+            if not chunk:
+                break
+            _cols, _rows, count = self._conn._exec_tx(";".join(chunk))
+            if count > 0:
+                total += count
+        self.description = None
+        self._rows, self._idx = [], 0
         self.rowcount = total
         return self
 

@@ -146,6 +146,64 @@ class TestPgwireQuoting:
         )
 
 
+class TestSplitStatements:
+    def test_semicolons_in_literals_preserved(self):
+        from predictionio_tpu.data.storage.minipg import split_statements
+
+        stmts = split_statements(
+            "INSERT INTO t VALUES ('a;b');INSERT INTO t VALUES "
+            "('it''s;ok'); SELECT 1"
+        )
+        assert stmts == [
+            "INSERT INTO t VALUES ('a;b')",
+            "INSERT INTO t VALUES ('it''s;ok')",
+            "SELECT 1",
+        ]
+
+    def test_trailing_and_empty(self):
+        from predictionio_tpu.data.storage.minipg import split_statements
+
+        assert split_statements("SELECT 1;;") == ["SELECT 1"]
+        assert split_statements("  ") == []
+
+    def test_comments_and_quoted_identifiers(self):
+        from predictionio_tpu.data.storage.minipg import split_statements
+
+        assert split_statements('SELECT 1 AS "a;b"') == [
+            'SELECT 1 AS "a;b"'
+        ]
+        assert split_statements("SELECT 1 -- tag;note") == [
+            "SELECT 1 -- tag;note"
+        ]
+        assert split_statements(
+            "SELECT 1 /* x;y */;SELECT 2"
+        ) == ["SELECT 1 /* x;y */", "SELECT 2"]
+        assert split_statements(
+            "SELECT 1 -- c;\nSELECT 2"
+        ) == ["SELECT 1 -- c;\nSELECT 2"]
+
+    def test_implicit_multistatement_atomicity(self, tmp_path):
+        """Multi-statement Query outside BEGIN is atomic (the reference
+        wraps the whole simple Query in an implicit transaction)."""
+        with MiniPGServer(path=str(tmp_path / "a.db")) as srv:
+            conn = pgwire.connect(
+                host="127.0.0.1", port=srv.port, database="p", user="u"
+            )
+            cur = conn.cursor()
+            cur.execute("CREATE TABLE s (id INTEGER PRIMARY KEY)")
+            conn.commit()
+            # bypass the lazy-BEGIN: send the multi-statement Query raw
+            with pytest.raises(pgwire.IntegrityError):
+                conn._query(
+                    "INSERT INTO s VALUES (1);"
+                    "INSERT INTO s VALUES (1);"
+                    "INSERT INTO s VALUES (2)"
+                )
+            cur.execute("SELECT COUNT(*) FROM s")
+            assert cur.fetchone() == (0,)  # nothing partially applied
+            conn.close()
+
+
 class TestTranslateSQL:
     def test_schema_types(self):
         out = translate_sql(
@@ -233,6 +291,44 @@ class TestWireBehavior:
         cur.execute("INSERT INTO b VALUES (%s)", (blob,))
         cur.execute("SELECT v FROM b")
         assert cur.fetchone() == (blob,)
+
+    def test_executemany_single_round_trip(self, conn, monkeypatch):
+        """executemany ships ;-joined statement groups — one Query
+        message per chunk, not one per row."""
+        cur = conn.cursor()
+        cur.execute("CREATE TABLE m (a INTEGER, b TEXT)")
+        conn.commit()
+        sent = []
+        real = type(conn._wire).send
+
+        def spy(wire, type_byte, payload):
+            if type_byte == b"Q":
+                sent.append(payload)
+            return real(wire, type_byte, payload)
+
+        monkeypatch.setattr(type(conn._wire), "send", spy)
+        rows = [(i, f"semi;colon'{i}'") for i in range(25)]
+        cur.executemany("INSERT INTO m VALUES (%s,%s)", rows)
+        assert cur.rowcount == 25
+        # BEGIN + one batched Query (25 < EXECUTEMANY_CHUNK)
+        inserts = [p for p in sent if b"INSERT" in p]
+        assert len(inserts) == 1
+        monkeypatch.undo()
+        cur.execute("SELECT COUNT(*), MIN(b) FROM m")
+        count, first = cur.fetchone()
+        assert count == 25 and first == "semi;colon'0'"
+
+    def test_multi_statement_error_stops_batch(self, conn):
+        cur = conn.cursor()
+        cur.execute("CREATE TABLE s (id INTEGER PRIMARY KEY)")
+        conn.commit()
+        with pytest.raises(pgwire.IntegrityError):
+            cur.executemany(
+                "INSERT INTO s VALUES (%s)", [(1,), (1,), (2,)]
+            )
+        conn.rollback()
+        cur.execute("SELECT COUNT(*) FROM s")
+        assert cur.fetchone() == (0,)  # rolled back with the tx
 
     def test_null_and_rowcount(self, conn):
         cur = conn.cursor()
