@@ -12,7 +12,9 @@ so ``start-all`` manages our three long-running HTTP services:
 * admin server  (default :7071)
 
 plus, optionally, minipg when ``--with-minipg`` is given (the networked
-dev store for multi-host topologies).
+dev store for multi-host topologies) and the store server when
+``--with-storeserver`` is given (metadata + model blobs over HTTP — the
+reference's elasticsearch/HDFS role).
 
 Layout (under ``PIO_FS_BASEDIR``, default ``~/.piotpu``)::
 
@@ -185,6 +187,7 @@ def start_all(
     ip: str = "0.0.0.0",
     ports: dict[str, int] | None = None,
     with_minipg: bool = False,
+    with_storeserver: bool = False,
     out=print,
 ) -> int:
     """Bring up every service; refuses to double-start (the reference
@@ -192,6 +195,8 @@ def start_all(
     ports = ports or {}
     failures = 0
     names = list(SERVICES)
+    if with_storeserver:
+        names.insert(0, "storeserver")
     if with_minipg:
         names.insert(0, "minipg")
     for name in names:
@@ -208,6 +213,9 @@ def start_all(
         if name == "minipg":
             port = ports.get(name, 5432)
             argv = ["minipg", "--ip", ip, "--port", str(port)]
+        elif name == "storeserver":
+            port = ports.get(name, 7072)
+            argv = ["storeserver", "--ip", ip, "--port", str(port)]
         else:
             verb, default_port, extra = SERVICES[name]
             port = ports.get(name, default_port)
@@ -227,7 +235,7 @@ def start_all(
 
 
 def stop_all(out=print) -> int:
-    names = list(SERVICES) + ["minipg"]
+    names = list(SERVICES) + ["minipg", "storeserver"]
     for name in names:
         out(f"{name}: {stop_daemon(name)}")
     return 0
@@ -236,10 +244,10 @@ def stop_all(out=print) -> int:
 def status_all(out=print) -> int:
     """One line per service; exit 0 iff everything is running."""
     all_up = True
-    names = list(SERVICES) + ["minipg"]
+    names = list(SERVICES) + ["minipg", "storeserver"]
     for name in names:
         state, pid = service_status(name)
-        if state == "stopped" and name == "minipg":
+        if state == "stopped" and name in ("minipg", "storeserver"):
             continue  # optional service: shown only when up or crashed
         suffix = f" (pid {pid})" if pid else ""
         out(f"{name}: {state}{suffix}")

@@ -14,6 +14,7 @@ same entry point once per host with ``PIO_*`` coordination env set
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -423,6 +424,29 @@ def cmd_adminserver(args) -> int:
     return 0
 
 
+def cmd_storeserver(args) -> int:
+    """Networked metadata + model store service (the reference's
+    elasticsearch/HDFS role); clients point repositories at it with
+    ``PIO_STORAGE_SOURCES_<NAME>_TYPE=httpstore`` + ``_URL``."""
+    from predictionio_tpu.serving.config import ServerConfig
+    from predictionio_tpu.serving.store_server import create_store_server
+
+    config = ServerConfig.from_env()
+    if args.access_key:
+        config = dataclasses.replace(
+            config, key_auth_enforced=True, access_key=args.access_key
+        )
+    http = create_store_server(
+        host=args.ip, port=args.port, server_config=config
+    )
+    print(f"Store server is listening on {args.ip}:{http.port}")
+    try:
+        http.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def cmd_export(args) -> int:
     """Events → JSON lines (reference export/EventsToFile.scala:40-104)."""
     from predictionio_tpu.data.store import EventStore
@@ -631,11 +655,16 @@ def cmd_start_all(args) -> int:
         ports["adminserver"] = args.adminserver_port
     if args.minipg_port:
         ports["minipg"] = args.minipg_port
+    if args.storeserver_port:
+        ports["storeserver"] = args.storeserver_port
     return daemon.start_all(
         ip=args.ip,
         ports=ports,
-        # an explicit minipg port is an explicit ask for minipg
+        # an explicit port is an explicit ask for the optional service
         with_minipg=args.with_minipg or bool(args.minipg_port),
+        with_storeserver=(
+            args.with_storeserver or bool(args.storeserver_port)
+        ),
     )
 
 
@@ -847,6 +876,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--password", default=None)
     p.set_defaults(func=cmd_minipg)
 
+    p = sub.add_parser("storeserver")
+    p.add_argument("--ip", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=7072)
+    p.add_argument(
+        "--access-key", dest="access_key", default="",
+        help="require this key on every request (Bearer/accessKey)",
+    )
+    p.set_defaults(func=cmd_storeserver)
+
     p = sub.add_parser("start-all")
     p.add_argument("--ip", default="0.0.0.0")
     p.add_argument("--eventserver-port", type=int, default=0)
@@ -854,6 +892,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--adminserver-port", type=int, default=0)
     p.add_argument("--with-minipg", action="store_true")
     p.add_argument("--minipg-port", type=int, default=0)
+    p.add_argument("--with-storeserver", action="store_true")
+    p.add_argument("--storeserver-port", type=int, default=0)
     p.set_defaults(func=cmd_start_all)
 
     sub.add_parser("stop-all").set_defaults(func=cmd_stop_all)

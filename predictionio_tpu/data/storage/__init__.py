@@ -157,6 +157,35 @@ def _register_builtins() -> None:
     register_backend(
         "mysql", BackendSpec(client=_mysql_client, **_sql_daos)
     )
+    # networked store server (metadata + models, like the reference's
+    # elasticsearch + hdfs backend family); events stay with a local or
+    # postgres source — the same split the reference runs in production
+    def _httpstore_client(config: dict):
+        from predictionio_tpu.data.storage import httpstore
+
+        return httpstore.HTTPStoreClient(config)
+
+    def _http_dao(name: str):
+        def factory(client):
+            from predictionio_tpu.data.storage import httpstore
+
+            return getattr(httpstore, name)(client)
+
+        return factory
+
+    register_backend(
+        "httpstore",
+        BackendSpec(
+            client=_httpstore_client,
+            apps=_http_dao("HTTPApps"),
+            access_keys=_http_dao("HTTPAccessKeys"),
+            channels=_http_dao("HTTPChannels"),
+            engine_instances=_http_dao("HTTPEngineInstances"),
+            engine_manifests=_http_dao("HTTPEngineManifests"),
+            evaluation_instances=_http_dao("HTTPEvaluationInstances"),
+            models=_http_dao("HTTPModels"),
+        ),
+    )
     # native C++ event log (events only, like the reference's hbase
     # backend); registered lazily — the .so builds on first client use
     from predictionio_tpu.data.storage import eventlog

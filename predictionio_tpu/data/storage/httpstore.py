@@ -1,0 +1,693 @@
+"""HTTP store backend — the second *external* backend family.
+
+Plays the role the reference's elasticsearch + hdfs backends play
+(metadata documents: ``data/src/main/scala/org/apache/predictionio/data/
+storage/elasticsearch/ESApps.scala:1`` and the six sibling DAOs; model
+blobs: ``.../hdfs/HDFSModels.scala:1``): a storage *service* reached
+over the network, so the metadata and model repositories can live on a
+different host than the trainer, event server, and engine servers —
+the multi-host TPU topology's control plane.
+
+The service side is :class:`predictionio_tpu.serving.store_server
+.StoreServer` (``pio-tpu storeserver``), which persists through any
+*local* backend (sqlite + localfs by default). This module is the
+client: DAO implementations that speak the JSON/HTTP protocol, plus the
+record↔JSON codecs shared with the server so the wire shape has a
+single definition.
+
+Config keys (``PIO_STORAGE_SOURCES_<NAME>_*``):
+
+* ``URL``  — base URL, e.g. ``http://10.0.0.5:7072`` (required)
+* ``KEY``  — access key when the server was started with one
+* ``TIMEOUT`` — per-request socket timeout in seconds (default 10)
+* ``CACERT`` — CA bundle (PEM path) to trust for ``https`` URLs — the
+  self-signed-cert workflow the serving tier documents
+* ``VERIFY`` — set to ``false`` to skip https certificate verification
+  (dev only)
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import http.client
+import json
+import ssl
+import threading
+import urllib.parse
+from typing import Any
+
+from predictionio_tpu.data.storage.base import (
+    AccessKey,
+    AccessKeysBackend,
+    App,
+    AppsBackend,
+    Channel,
+    ChannelsBackend,
+    EngineInstance,
+    EngineInstancesBackend,
+    EngineManifest,
+    EngineManifestsBackend,
+    EvaluationInstance,
+    EvaluationInstancesBackend,
+    Model,
+    ModelsBackend,
+    StorageError,
+)
+from predictionio_tpu.data.storage.sql_common import from_iso, iso
+
+# --------------------------------------------------------------------------
+# record ↔ JSON codecs (single wire-shape definition, used by both sides)
+# --------------------------------------------------------------------------
+
+
+def app_to_json(a: App) -> dict:
+    return {"id": a.id, "name": a.name, "description": a.description}
+
+
+def app_from_json(d: dict) -> App:
+    return App(id=d["id"], name=d["name"], description=d.get("description"))
+
+
+def access_key_to_json(k: AccessKey) -> dict:
+    return {"key": k.key, "appid": k.appid, "events": list(k.events)}
+
+
+def access_key_from_json(d: dict) -> AccessKey:
+    return AccessKey(
+        key=d["key"], appid=d["appid"], events=tuple(d.get("events", ()))
+    )
+
+
+def channel_to_json(c: Channel) -> dict:
+    return {"id": c.id, "name": c.name, "appid": c.appid}
+
+
+def channel_from_json(d: dict) -> Channel:
+    return Channel(id=d["id"], name=d["name"], appid=d["appid"])
+
+
+def manifest_to_json(m: EngineManifest) -> dict:
+    return {
+        "id": m.id,
+        "version": m.version,
+        "name": m.name,
+        "description": m.description,
+        "files": list(m.files),
+        "engine_factory": m.engine_factory,
+    }
+
+
+def manifest_from_json(d: dict) -> EngineManifest:
+    return EngineManifest(
+        id=d["id"],
+        version=d["version"],
+        name=d["name"],
+        description=d.get("description"),
+        files=tuple(d.get("files", ())),
+        engine_factory=d.get("engine_factory", ""),
+    )
+
+
+def engine_instance_to_json(e: EngineInstance) -> dict:
+    return {
+        "id": e.id,
+        "status": e.status,
+        "start_time": iso(e.start_time),
+        "end_time": iso(e.end_time),
+        "engine_id": e.engine_id,
+        "engine_version": e.engine_version,
+        "engine_variant": e.engine_variant,
+        "engine_factory": e.engine_factory,
+        "batch": e.batch,
+        "env": dict(e.env),
+        "mesh_conf": dict(e.mesh_conf),
+        "data_source_params": e.data_source_params,
+        "preparator_params": e.preparator_params,
+        "algorithms_params": e.algorithms_params,
+        "serving_params": e.serving_params,
+    }
+
+
+def engine_instance_from_json(d: dict) -> EngineInstance:
+    return EngineInstance(
+        id=d["id"],
+        status=d["status"],
+        start_time=from_iso(d["start_time"]),
+        end_time=from_iso(d["end_time"]),
+        engine_id=d["engine_id"],
+        engine_version=d["engine_version"],
+        engine_variant=d["engine_variant"],
+        engine_factory=d["engine_factory"],
+        batch=d.get("batch", ""),
+        env=dict(d.get("env", {})),
+        mesh_conf=dict(d.get("mesh_conf", {})),
+        data_source_params=d.get("data_source_params", "{}"),
+        preparator_params=d.get("preparator_params", "{}"),
+        algorithms_params=d.get("algorithms_params", "[]"),
+        serving_params=d.get("serving_params", "{}"),
+    )
+
+
+def evaluation_instance_to_json(e: EvaluationInstance) -> dict:
+    return {
+        "id": e.id,
+        "status": e.status,
+        "start_time": iso(e.start_time),
+        "end_time": iso(e.end_time),
+        "evaluation_class": e.evaluation_class,
+        "engine_params_generator_class": e.engine_params_generator_class,
+        "batch": e.batch,
+        "env": dict(e.env),
+        "evaluator_results": e.evaluator_results,
+        "evaluator_results_html": e.evaluator_results_html,
+        "evaluator_results_json": e.evaluator_results_json,
+    }
+
+
+def evaluation_instance_from_json(d: dict) -> EvaluationInstance:
+    return EvaluationInstance(
+        id=d["id"],
+        status=d["status"],
+        start_time=from_iso(d["start_time"]),
+        end_time=from_iso(d["end_time"]),
+        evaluation_class=d.get("evaluation_class", ""),
+        engine_params_generator_class=d.get(
+            "engine_params_generator_class", ""
+        ),
+        batch=d.get("batch", ""),
+        env=dict(d.get("env", {})),
+        evaluator_results=d.get("evaluator_results", ""),
+        evaluator_results_html=d.get("evaluator_results_html", ""),
+        evaluator_results_json=d.get("evaluator_results_json", ""),
+    )
+
+
+def _q(raw) -> str:
+    """Percent-encode one path segment (ids may contain '/', '%', …);
+    the server unquotes symmetrically."""
+    return urllib.parse.quote(str(raw), safe="")
+
+
+# --------------------------------------------------------------------------
+# HTTP client
+# --------------------------------------------------------------------------
+
+
+class HTTPStoreClient:
+    """Keep-alive JSON/HTTP client for one store server.
+
+    One pooled connection per thread (serving and training code hit the
+    DAOs from multiple threads); a request on a connection the server
+    has since closed is retried once on a fresh socket.
+    """
+
+    def __init__(self, config: dict):
+        url = config.get("URL")
+        if not url:
+            raise StorageError(
+                "httpstore source needs PIO_STORAGE_SOURCES_<NAME>_URL "
+                "(e.g. http://host:7072)"
+            )
+        parsed = urllib.parse.urlsplit(url)
+        if parsed.scheme not in ("http", "https") or not parsed.hostname:
+            raise StorageError(f"httpstore URL not understood: {url!r}")
+        self._scheme = parsed.scheme
+        self._host = parsed.hostname
+        self._port = parsed.port or (443 if parsed.scheme == "https" else 80)
+        self._key = config.get("KEY")
+        try:
+            self._timeout = float(config.get("TIMEOUT", 10))
+        except ValueError as e:
+            raise StorageError(
+                f"httpstore TIMEOUT not a number: {config.get('TIMEOUT')!r}"
+            ) from e
+        self._ssl_context = None
+        if self._scheme == "https":
+            cacert = config.get("CACERT")
+            try:
+                self._ssl_context = ssl.create_default_context(
+                    cafile=cacert or None
+                )
+            except (OSError, ssl.SSLError) as e:
+                raise StorageError(
+                    f"httpstore CACERT {cacert!r} unusable: {e}"
+                ) from e
+            if str(config.get("VERIFY", "true")).lower() in (
+                "false", "0", "no",
+            ):
+                self._ssl_context.check_hostname = False
+                self._ssl_context.verify_mode = ssl.CERT_NONE
+        self._local = threading.local()
+
+    def _connection(self) -> tuple[http.client.HTTPConnection, bool]:
+        """Returns (connection, reused) — ``reused`` means the socket
+        carried an earlier request and may have been idled-out by the
+        server since."""
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            return conn, True
+        if self._scheme == "https":
+            conn = http.client.HTTPSConnection(
+                self._host,
+                self._port,
+                timeout=self._timeout,
+                context=self._ssl_context,
+            )
+        else:
+            conn = http.client.HTTPConnection(
+                self._host, self._port, timeout=self._timeout
+            )
+        self._local.conn = conn
+        return conn, False
+
+    def _drop_connection(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            self._local.conn = None
+
+    def request(
+        self,
+        method: str,
+        path: str,
+        *,
+        params: dict[str, Any] | None = None,
+        json_body: Any = None,
+        raw_body: bytes | None = None,
+    ) -> tuple[int, bytes]:
+        """One HTTP round trip; returns (status, body bytes)."""
+        if params:
+            qs = urllib.parse.urlencode(
+                {k: v for k, v in params.items() if v is not None}
+            )
+            if qs:
+                path = f"{path}?{qs}"
+        headers = {}
+        if self._key:
+            headers["Authorization"] = f"Bearer {self._key}"
+        if json_body is not None:
+            body = json.dumps(json_body).encode("utf-8")
+            headers["Content-Type"] = "application/json"
+        elif raw_body is not None:
+            body = raw_body
+            headers["Content-Type"] = "application/octet-stream"
+        else:
+            body = None
+        for attempt in (0, 1):
+            conn, reused = self._connection()
+            sent = False
+            try:
+                conn.request(method, path, body=body, headers=headers)
+                sent = True
+                resp = conn.getresponse()
+                data = resp.read()
+            except (OSError, http.client.HTTPException) as e:
+                self._drop_connection()
+                # Retry exactly once, and only when the server cannot
+                # have acted on the request: a send-phase failure on a
+                # reused socket (the stale keep-alive race — the request
+                # never arrived whole), or RemoteDisconnected on a
+                # reused socket (the server closed the idle connection
+                # without emitting any response bytes). Anything after a
+                # completed send on a fresh connection — a read timeout,
+                # a mid-response reset — is ambiguous: a non-idempotent
+                # insert may already be committed, so surface the error
+                # instead of silently duplicating it.
+                stale = reused and (
+                    not sent
+                    or isinstance(e, http.client.RemoteDisconnected)
+                )
+                if attempt == 0 and stale:
+                    continue
+                raise StorageError(
+                    f"store server {self._host}:{self._port} unreachable: "
+                    f"{e}"
+                ) from e
+            if resp.status in (401, 403):
+                raise StorageError(
+                    "store server rejected the access key "
+                    f"(HTTP {resp.status})"
+                )
+            if resp.status >= 500:
+                raise StorageError(
+                    f"store server error HTTP {resp.status}: "
+                    f"{data[:200].decode('utf-8', 'replace')}"
+                )
+            return resp.status, data
+        raise AssertionError("unreachable")
+
+    def json(
+        self,
+        method: str,
+        path: str,
+        *,
+        params: dict[str, Any] | None = None,
+        json_body: Any = None,
+        not_found_ok: bool = False,
+    ) -> Any:
+        status, data = self.request(
+            method, path, params=params, json_body=json_body
+        )
+        if status == 404 and not_found_ok:
+            return None
+        if not 200 <= status < 300:
+            raise StorageError(
+                f"store server: {method} {path} -> HTTP {status}: "
+                f"{data[:200].decode('utf-8', 'replace')}"
+            )
+        return json.loads(data) if data else None
+
+    def close(self) -> None:
+        self._drop_connection()
+
+
+# --------------------------------------------------------------------------
+# DAO implementations
+# --------------------------------------------------------------------------
+
+
+class HTTPApps(AppsBackend):
+    def __init__(self, client: HTTPStoreClient):
+        self._c = client
+
+    def insert(self, app: App) -> int | None:
+        out = self._c.json("POST", "/meta/apps", json_body=app_to_json(app))
+        return out.get("id")
+
+    def get(self, app_id: int) -> App | None:
+        d = self._c.json("GET", f"/meta/apps/{_q(app_id)}", not_found_ok=True)
+        return app_from_json(d) if d else None
+
+    def get_by_name(self, name: str) -> App | None:
+        if not name:
+            # a blank-valued query param would be dropped server-side
+            # (parse_qs), turning this into get_all; no app can have an
+            # empty name, so answer locally like every other backend
+            return None
+        out = self._c.json("GET", "/meta/apps", params={"name": name})
+        return app_from_json(out[0]) if out else None
+
+    def get_all(self) -> list[App]:
+        return [app_from_json(d) for d in self._c.json("GET", "/meta/apps")]
+
+    def update(self, app: App) -> bool:
+        out = self._c.json(
+            "PUT", f"/meta/apps/{_q(app.id)}", json_body=app_to_json(app)
+        )
+        return bool(out.get("ok"))
+
+    def delete(self, app_id: int) -> bool:
+        out = self._c.json("DELETE", f"/meta/apps/{_q(app_id)}")
+        return bool(out.get("ok"))
+
+
+class HTTPAccessKeys(AccessKeysBackend):
+    def __init__(self, client: HTTPStoreClient):
+        self._c = client
+
+    def insert(self, access_key: AccessKey) -> str | None:
+        out = self._c.json(
+            "POST",
+            "/meta/access_keys",
+            json_body=access_key_to_json(access_key),
+        )
+        return out.get("id")
+
+    def get(self, key: str) -> AccessKey | None:
+        d = self._c.json(
+            "GET", f"/meta/access_keys/{_q(key)}", not_found_ok=True
+        )
+        return access_key_from_json(d) if d else None
+
+    def get_all(self) -> list[AccessKey]:
+        return [
+            access_key_from_json(d)
+            for d in self._c.json("GET", "/meta/access_keys")
+        ]
+
+    def get_by_app_id(self, app_id: int) -> list[AccessKey]:
+        return [
+            access_key_from_json(d)
+            for d in self._c.json(
+                "GET", "/meta/access_keys", params={"app_id": app_id}
+            )
+        ]
+
+    def update(self, access_key: AccessKey) -> bool:
+        out = self._c.json(
+            "PUT",
+            f"/meta/access_keys/{_q(access_key.key)}",
+            json_body=access_key_to_json(access_key),
+        )
+        return bool(out.get("ok"))
+
+    def delete(self, key: str) -> bool:
+        out = self._c.json("DELETE", f"/meta/access_keys/{_q(key)}")
+        return bool(out.get("ok"))
+
+
+class HTTPChannels(ChannelsBackend):
+    def __init__(self, client: HTTPStoreClient):
+        self._c = client
+
+    def insert(self, channel: Channel) -> int | None:
+        out = self._c.json(
+            "POST", "/meta/channels", json_body=channel_to_json(channel)
+        )
+        return out.get("id")
+
+    def get(self, channel_id: int) -> Channel | None:
+        d = self._c.json(
+            "GET", f"/meta/channels/{_q(channel_id)}", not_found_ok=True
+        )
+        return channel_from_json(d) if d else None
+
+    def get_by_app_id(self, app_id: int) -> list[Channel]:
+        return [
+            channel_from_json(d)
+            for d in self._c.json(
+                "GET", "/meta/channels", params={"app_id": app_id}
+            )
+        ]
+
+    def delete(self, channel_id: int) -> bool:
+        out = self._c.json("DELETE", f"/meta/channels/{_q(channel_id)}")
+        return bool(out.get("ok"))
+
+
+class HTTPEngineManifests(EngineManifestsBackend):
+    def __init__(self, client: HTTPStoreClient):
+        self._c = client
+
+    def insert(self, manifest: EngineManifest) -> None:
+        self._c.json(
+            "POST",
+            "/meta/engine_manifests",
+            json_body=manifest_to_json(manifest),
+        )
+
+    def get(self, manifest_id: str, version: str) -> EngineManifest | None:
+        d = self._c.json(
+            "GET",
+            f"/meta/engine_manifests/{_q(manifest_id)}/{_q(version)}",
+            not_found_ok=True,
+        )
+        return manifest_from_json(d) if d else None
+
+    def get_all(self) -> list[EngineManifest]:
+        return [
+            manifest_from_json(d)
+            for d in self._c.json("GET", "/meta/engine_manifests")
+        ]
+
+    def update(self, manifest: EngineManifest, upsert: bool = False) -> None:
+        status, data = self._c.request(
+            "PUT",
+            f"/meta/engine_manifests/{_q(manifest.id)}/{_q(manifest.version)}",
+            params={"upsert": int(upsert)},
+            json_body=manifest_to_json(manifest),
+        )
+        if status == 404:
+            # the server maps the backend's KeyError (non-upsert update
+            # of a missing manifest) to 404; restore the contract
+            raise KeyError(
+                f"engine manifest ({manifest.id}, {manifest.version}) "
+                "not found"
+            )
+        if not 200 <= status < 300:
+            raise StorageError(
+                f"store server: manifest update -> HTTP {status}: "
+                f"{data[:200].decode('utf-8', 'replace')}"
+            )
+
+    def delete(self, manifest_id: str, version: str) -> bool:
+        out = self._c.json(
+            "DELETE", f"/meta/engine_manifests/{_q(manifest_id)}/{_q(version)}"
+        )
+        return bool(out.get("ok"))
+
+
+class HTTPEngineInstances(EngineInstancesBackend):
+    def __init__(self, client: HTTPStoreClient):
+        self._c = client
+
+    def insert(self, instance: EngineInstance) -> str:
+        out = self._c.json(
+            "POST",
+            "/meta/engine_instances",
+            json_body=engine_instance_to_json(instance),
+        )
+        return out["id"]
+
+    def get(self, instance_id: str) -> EngineInstance | None:
+        d = self._c.json(
+            "GET", f"/meta/engine_instances/{_q(instance_id)}", not_found_ok=True
+        )
+        return engine_instance_from_json(d) if d else None
+
+    def get_all(self) -> list[EngineInstance]:
+        return [
+            engine_instance_from_json(d)
+            for d in self._c.json("GET", "/meta/engine_instances")
+        ]
+
+    def _completed(
+        self,
+        engine_id: str,
+        engine_version: str,
+        engine_variant: str,
+        latest: bool,
+    ):
+        return self._c.json(
+            "GET",
+            "/meta/engine_instances",
+            params={
+                "engine_id": engine_id,
+                "engine_version": engine_version,
+                "engine_variant": engine_variant,
+                "completed": 1,
+                "latest": int(latest),
+            },
+        )
+
+    def get_latest_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> EngineInstance | None:
+        out = self._completed(
+            engine_id, engine_version, engine_variant, latest=True
+        )
+        return engine_instance_from_json(out[0]) if out else None
+
+    def get_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> list[EngineInstance]:
+        return [
+            engine_instance_from_json(d)
+            for d in self._completed(
+                engine_id, engine_version, engine_variant, latest=False
+            )
+        ]
+
+    def update(self, instance: EngineInstance) -> bool:
+        out = self._c.json(
+            "PUT",
+            f"/meta/engine_instances/{_q(instance.id)}",
+            json_body=engine_instance_to_json(instance),
+        )
+        return bool(out.get("ok"))
+
+    def delete(self, instance_id: str) -> bool:
+        out = self._c.json(
+            "DELETE", f"/meta/engine_instances/{_q(instance_id)}"
+        )
+        return bool(out.get("ok"))
+
+
+class HTTPEvaluationInstances(EvaluationInstancesBackend):
+    def __init__(self, client: HTTPStoreClient):
+        self._c = client
+
+    def insert(self, instance: EvaluationInstance) -> str:
+        out = self._c.json(
+            "POST",
+            "/meta/evaluation_instances",
+            json_body=evaluation_instance_to_json(instance),
+        )
+        return out["id"]
+
+    def get(self, instance_id: str) -> EvaluationInstance | None:
+        d = self._c.json(
+            "GET",
+            f"/meta/evaluation_instances/{_q(instance_id)}",
+            not_found_ok=True,
+        )
+        return evaluation_instance_from_json(d) if d else None
+
+    def get_all(self) -> list[EvaluationInstance]:
+        return [
+            evaluation_instance_from_json(d)
+            for d in self._c.json("GET", "/meta/evaluation_instances")
+        ]
+
+    def get_completed(self) -> list[EvaluationInstance]:
+        return [
+            evaluation_instance_from_json(d)
+            for d in self._c.json(
+                "GET", "/meta/evaluation_instances", params={"completed": 1}
+            )
+        ]
+
+    def update(self, instance: EvaluationInstance) -> bool:
+        out = self._c.json(
+            "PUT",
+            f"/meta/evaluation_instances/{_q(instance.id)}",
+            json_body=evaluation_instance_to_json(instance),
+        )
+        return bool(out.get("ok"))
+
+    def delete(self, instance_id: str) -> bool:
+        out = self._c.json(
+            "DELETE", f"/meta/evaluation_instances/{_q(instance_id)}"
+        )
+        return bool(out.get("ok"))
+
+
+class HTTPModels(ModelsBackend):
+    """Model blob store over HTTP (reference HDFSModels.scala:30-64:
+    one opaque file per model id)."""
+
+    def __init__(self, client: HTTPStoreClient):
+        self._c = client
+
+    def insert(self, model: Model) -> None:
+        status, data = self._c.request(
+            "PUT",
+            f"/models/{_q(model.id)}",
+            raw_body=model.models,
+        )
+        if not 200 <= status < 300:
+            raise StorageError(
+                f"store server: model put -> HTTP {status}: "
+                f"{data[:200].decode('utf-8', 'replace')}"
+            )
+
+    def get(self, model_id: str) -> Model | None:
+        status, data = self._c.request(
+            "GET", f"/models/{_q(model_id)}"
+        )
+        if status == 404:
+            return None
+        if not 200 <= status < 300:
+            raise StorageError(
+                f"store server: model get -> HTTP {status}"
+            )
+        return Model(id=model_id, models=data)
+
+    def delete(self, model_id: str) -> bool:
+        out = self._c.json(
+            "DELETE", f"/models/{_q(model_id)}"
+        )
+        return bool(out.get("ok"))

@@ -1,0 +1,315 @@
+"""Store server — network service for the metadata + model repositories.
+
+The reference reaches external metadata/model stores through server
+processes it does not ship (elasticsearch for the seven metadata DAOs,
+``data/.../storage/elasticsearch/ESApps.scala:1``; an HDFS namenode for
+model blobs, ``.../hdfs/HDFSModels.scala:1``). This framework ships the
+service itself: ``pio-tpu storeserver`` exposes any locally-configured
+backend (sqlite + localfs by default) over JSON/HTTP so every other
+process — trainer, event server, engine servers, dashboard — can point
+its METADATA/MODELDATA repositories at one host via the ``httpstore``
+backend type (:mod:`predictionio_tpu.data.storage.httpstore`, which
+also defines the wire codecs used here).
+
+Routes::
+
+    GET    /                                    liveness + backing info
+    POST   /meta/<kind>                         insert    -> {"id": ...}
+    GET    /meta/<kind>                         list (query-param filters)
+    GET    /meta/<kind>/<id>                    get       -> record | 404
+    PUT    /meta/<kind>/<id>                    update    -> {"ok": bool}
+    DELETE /meta/<kind>/<id>                    delete    -> {"ok": bool}
+    GET/PUT/DELETE /meta/engine_manifests/<id>/<version>   (2-part key)
+    PUT    /models/<id>                         blob upload (octet-stream)
+    GET    /models/<id>                         blob | 404
+    DELETE /models/<id>                         -> {"ok": bool}
+
+Auth: optional — start with an access key (``--access-key`` or
+``PIO_SERVER_ACCESS_KEY``) and every request must carry it
+(``Authorization: Bearer <key>`` or ``?accessKey=``), the same
+:class:`~predictionio_tpu.serving.config.ServerConfig` contract the
+dashboard uses.
+"""
+
+from __future__ import annotations
+
+import urllib.parse
+
+from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.data.storage.base import Model, StorageError
+from predictionio_tpu.data.storage.httpstore import (
+    access_key_from_json,
+    access_key_to_json,
+    app_from_json,
+    app_to_json,
+    channel_from_json,
+    channel_to_json,
+    engine_instance_from_json,
+    engine_instance_to_json,
+    evaluation_instance_from_json,
+    evaluation_instance_to_json,
+    manifest_from_json,
+    manifest_to_json,
+)
+from predictionio_tpu.serving.config import ServerConfig
+from predictionio_tpu.serving.http import (
+    HTTPError,
+    HTTPServer,
+    Request,
+    Response,
+    Router,
+)
+
+
+class StoreServer:
+    """Key auth and TLS are server-level concerns: ``create_store_server``
+    hands the :class:`ServerConfig` to :class:`HTTPServer`, which
+    enforces the key on every route before dispatch."""
+
+    def __init__(self, storage: Storage | None = None):
+        self._storage = storage or get_storage()
+        self.router = Router()
+        r = self.router
+        r.route("GET", "/", self._status)
+        r.route("GET", "/meta/engine_manifests/<id>/<version>",
+                self._manifest_get)
+        r.route("PUT", "/meta/engine_manifests/<id>/<version>",
+                self._manifest_update)
+        r.route("DELETE", "/meta/engine_manifests/<id>/<version>",
+                self._manifest_delete)
+        for method, pattern, handler in (
+            ("POST", "/meta/<kind>", self._insert),
+            ("GET", "/meta/<kind>", self._list),
+            ("GET", "/meta/<kind>/<id>", self._get),
+            ("PUT", "/meta/<kind>/<id>", self._update),
+            ("DELETE", "/meta/<kind>/<id>", self._delete),
+        ):
+            r.route(method, pattern, handler)
+        r.route("PUT", "/models/<id>", self._model_put)
+        r.route("GET", "/models/<id>", self._model_get)
+        r.route("DELETE", "/models/<id>", self._model_delete)
+
+    # -- plumbing ---------------------------------------------------------
+
+    def _kind(self, request: Request):
+        """Resolve <kind> → (dao, to_json, from_json, id-parser)."""
+        kind = request.path_params["kind"]
+        s = self._storage
+        table = {
+            "apps": (
+                s.get_meta_data_apps, app_to_json, app_from_json, int
+            ),
+            "access_keys": (
+                s.get_meta_data_access_keys,
+                access_key_to_json,
+                access_key_from_json,
+                str,
+            ),
+            "channels": (
+                s.get_meta_data_channels,
+                channel_to_json,
+                channel_from_json,
+                int,
+            ),
+            "engine_instances": (
+                s.get_meta_data_engine_instances,
+                engine_instance_to_json,
+                engine_instance_from_json,
+                str,
+            ),
+            "evaluation_instances": (
+                s.get_meta_data_evaluation_instances,
+                evaluation_instance_to_json,
+                evaluation_instance_from_json,
+                str,
+            ),
+            "engine_manifests": (
+                s.get_meta_data_engine_manifests,
+                manifest_to_json,
+                manifest_from_json,
+                str,
+            ),
+        }
+        if kind not in table:
+            raise HTTPError(404, f"unknown metadata kind {kind!r}")
+        getter, to_json, from_json, id_parse = table[kind]
+        try:
+            dao = getter()
+        except StorageError as e:
+            raise HTTPError(500, str(e)) from e
+        return kind, dao, to_json, from_json, id_parse
+
+    @staticmethod
+    def _parse_id(id_parse, raw: str):
+        try:
+            return id_parse(urllib.parse.unquote(raw))
+        except ValueError as e:
+            raise HTTPError(400, f"bad id {raw!r}") from e
+
+    @staticmethod
+    def _reject_manifest_single_key(kind: str) -> None:
+        """Engine manifests are keyed by (id, version); the single-id
+        routes would call their DAO with the wrong arity."""
+        if kind == "engine_manifests":
+            raise HTTPError(
+                400,
+                "engine_manifests is keyed by (id, version); use "
+                "/meta/engine_manifests/<id>/<version>",
+            )
+
+    # -- routes -----------------------------------------------------------
+
+    def _status(self, request: Request) -> Response:
+        return Response(200, {"status": "alive", "service": "storeserver"})
+
+    def _insert(self, request: Request) -> Response:
+        kind, dao, _to_json, from_json, _ = self._kind(request)
+        body = request.json()
+        if not isinstance(body, dict):
+            raise HTTPError(400, "record JSON object required")
+        try:
+            record = from_json(body)
+        except (KeyError, TypeError, ValueError) as e:
+            raise HTTPError(400, f"bad {kind} record: {e}") from e
+        out = dao.insert(record)
+        # insert contracts differ by DAO: apps/channels → id|None on
+        # conflict; access_keys → key|None; instances → id; manifests →
+        # None (keyed by the record itself). Normalize to {"id": ...}.
+        return Response(201, {"id": out})
+
+    def _list(self, request: Request) -> Response:
+        kind, dao, to_json, _f, _ = self._kind(request)
+        q = request.query
+        if kind == "apps" and "name" in q:
+            app = dao.get_by_name(q["name"])
+            return Response(200, [to_json(app)] if app else [])
+        if kind in ("access_keys", "channels") and "app_id" in q:
+            try:
+                app_id = int(q["app_id"])
+            except ValueError as e:
+                raise HTTPError(400, "app_id must be an int") from e
+            return Response(
+                200, [to_json(r) for r in dao.get_by_app_id(app_id)]
+            )
+        if kind == "engine_instances" and q.get("completed"):
+            key = (
+                q.get("engine_id", ""),
+                q.get("engine_version", ""),
+                q.get("engine_variant", ""),
+            )
+            if q.get("latest") not in (None, "0"):
+                latest = dao.get_latest_completed(*key)
+                return Response(200, [to_json(latest)] if latest else [])
+            return Response(
+                200, [to_json(r) for r in dao.get_completed(*key)]
+            )
+        if kind == "evaluation_instances" and q.get("completed"):
+            return Response(200, [to_json(r) for r in dao.get_completed()])
+        return Response(200, [to_json(r) for r in dao.get_all()])
+
+    def _get(self, request: Request) -> Response:
+        kind, dao, to_json, _f, id_parse = self._kind(request)
+        self._reject_manifest_single_key(kind)
+        record = dao.get(self._parse_id(id_parse, request.path_params["id"]))
+        if record is None:
+            raise HTTPError(404, "not found")
+        return Response(200, to_json(record))
+
+    def _update(self, request: Request) -> Response:
+        kind, dao, _t, from_json, _ = self._kind(request)
+        self._reject_manifest_single_key(kind)
+        body = request.json()
+        if not isinstance(body, dict):
+            raise HTTPError(400, "record JSON object required")
+        try:
+            record = from_json(body)
+        except (KeyError, TypeError, ValueError) as e:
+            raise HTTPError(400, f"bad {kind} record: {e}") from e
+        return Response(200, {"ok": bool(dao.update(record))})
+
+    def _delete(self, request: Request) -> Response:
+        kind, dao, _t, _f, id_parse = self._kind(request)
+        self._reject_manifest_single_key(kind)
+        ok = dao.delete(self._parse_id(id_parse, request.path_params["id"]))
+        return Response(200, {"ok": bool(ok)})
+
+    # -- engine manifests (two-part key) ----------------------------------
+
+    def _manifests(self):
+        try:
+            return self._storage.get_meta_data_engine_manifests()
+        except StorageError as e:
+            raise HTTPError(500, str(e)) from e
+
+    def _manifest_get(self, request: Request) -> Response:
+        m = self._manifests().get(
+            urllib.parse.unquote(request.path_params["id"]),
+            urllib.parse.unquote(request.path_params["version"]),
+        )
+        if m is None:
+            raise HTTPError(404, "not found")
+        return Response(200, manifest_to_json(m))
+
+    def _manifest_update(self, request: Request) -> Response:
+        body = request.json()
+        if not isinstance(body, dict):
+            raise HTTPError(400, "record JSON object required")
+        try:
+            manifest = manifest_from_json(body)
+        except (KeyError, TypeError, ValueError) as e:
+            raise HTTPError(400, f"bad manifest record: {e}") from e
+        upsert = request.query.get("upsert") not in (None, "0")
+        try:
+            self._manifests().update(manifest, upsert=upsert)
+        except KeyError as e:
+            # non-upsert update of a missing manifest: a contract error
+            # the client re-raises as KeyError
+            raise HTTPError(404, str(e)) from e
+        return Response(200, {"ok": True})
+
+    def _manifest_delete(self, request: Request) -> Response:
+        ok = self._manifests().delete(
+            urllib.parse.unquote(request.path_params["id"]),
+            urllib.parse.unquote(request.path_params["version"]),
+        )
+        return Response(200, {"ok": bool(ok)})
+
+    # -- model blobs ------------------------------------------------------
+
+    def _models(self):
+        try:
+            return self._storage.get_model_data_models()
+        except StorageError as e:
+            raise HTTPError(500, str(e)) from e
+
+    def _model_put(self, request: Request) -> Response:
+        model_id = urllib.parse.unquote(request.path_params["id"])
+        self._models().insert(Model(id=model_id, models=request.body))
+        return Response(201, {"id": model_id})
+
+    def _model_get(self, request: Request) -> Response:
+        model_id = urllib.parse.unquote(request.path_params["id"])
+        model = self._models().get(model_id)
+        if model is None:
+            raise HTTPError(404, "not found")
+        return Response(
+            200, model.models, content_type="application/octet-stream"
+        )
+
+    def _model_delete(self, request: Request) -> Response:
+        model_id = urllib.parse.unquote(request.path_params["id"])
+        return Response(200, {"ok": bool(self._models().delete(model_id))})
+
+
+def create_store_server(
+    host: str = "0.0.0.0",
+    port: int = 7072,
+    storage: Storage | None = None,
+    server_config: ServerConfig | None = None,
+) -> HTTPServer:
+    return HTTPServer(
+        StoreServer(storage).router,
+        host=host,
+        port=port,
+        server_config=server_config,
+    )

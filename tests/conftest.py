@@ -106,6 +106,44 @@ def postgres_storage(tmp_path):
 
 
 @pytest.fixture()
+def httpstore_storage(tmp_path):
+    """The store-server backend family end to end over a real TCP
+    socket: metadata + models through the ``httpstore`` client → JSON/
+    HTTP → StoreServer → sqlite/localfs (the reference's elasticsearch +
+    hdfs topology, ESApps.scala:1 / HDFSModels.scala:1). Events stay on
+    a memory source — the service doesn't serve events, exactly like
+    the reference's ES metadata backend."""
+    from predictionio_tpu.serving.store_server import create_store_server
+
+    backing = Storage(
+        env={
+            "PIO_STORAGE_SOURCES_SQL_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_SQL_PATH": str(tmp_path / "store.sqlite"),
+            "PIO_STORAGE_SOURCES_FS_TYPE": "localfs",
+            "PIO_STORAGE_SOURCES_FS_PATH": str(tmp_path / "models"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQL",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQL",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS",
+        }
+    )
+    server = create_store_server(host="127.0.0.1", port=0, storage=backing)
+    server.start()
+    storage = Storage(
+        env={
+            "PIO_STORAGE_SOURCES_STORE_TYPE": "httpstore",
+            "PIO_STORAGE_SOURCES_STORE_URL":
+                f"http://127.0.0.1:{server.port}",
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "STORE",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "STORE",
+        }
+    )
+    yield storage
+    server.shutdown()
+
+
+@pytest.fixture()
 def sqlite_storage(tmp_path):
     """SQLite-backed storage in a temp dir."""
     storage = Storage(

@@ -1,0 +1,358 @@
+"""Store-server backend family specifics beyond the shared contract
+suite (which runs against it via the ``httpstore`` param in
+test_storage.py): true out-of-process operation, key auth, failure
+mapping, and registry resolution — the reference's external-backend
+behaviors (ESApps.scala:1, HDFSModels.scala:1, service-gated in
+.travis.yml:30-55; here the service is ours, so nothing is gated)."""
+
+import os
+import re
+import subprocess
+import sys
+import pytest
+
+from predictionio_tpu.data.storage import (
+    App,
+    Model,
+    Storage,
+    StorageError,
+)
+
+
+def _client_storage(port: int, key: str | None = None) -> Storage:
+    env = {
+        "PIO_STORAGE_SOURCES_STORE_TYPE": "httpstore",
+        "PIO_STORAGE_SOURCES_STORE_URL": f"http://127.0.0.1:{port}",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "STORE",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "STORE",
+    }
+    if key:
+        env["PIO_STORAGE_SOURCES_STORE_KEY"] = key
+    return Storage(env=env)
+
+
+class TestOutOfProcess:
+    """The seam the reference proves with live ES/HBase services: the
+    store really leaves the process — separate interpreter, real TCP."""
+
+    def test_console_storeserver_roundtrip(self, tmp_path):
+        env = dict(os.environ)
+        env["PIO_FS_BASEDIR"] = str(tmp_path)
+        # the child needs no devices; keep its jax import cheap
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "predictionio_tpu.cli.main",
+                "storeserver",
+                "--ip",
+                "127.0.0.1",
+                "--port",
+                "0",
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        try:
+            line = proc.stdout.readline()
+            m = re.search(r"listening on 127\.0\.0\.1:(\d+)", line)
+            assert m, f"unexpected banner: {line!r}"
+            port = int(m.group(1))
+            storage = _client_storage(port)
+            apps = storage.get_meta_data_apps()
+            app_id = apps.insert(App(id=0, name="xproc"))
+            assert apps.get(app_id).name == "xproc"
+            models = storage.get_model_data_models()
+            blob = bytes(range(256)) * 17  # binary-safe, odd length
+            models.insert(Model(id="m/with slash", models=blob))
+            assert models.get("m/with slash").models == blob
+            # the server process persisted it (sqlite default wiring
+            # under PIO_FS_BASEDIR), not this process
+            assert (tmp_path / "pio.sqlite").exists()
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+    def test_server_down_maps_to_storage_error(self):
+        storage = _client_storage(1)  # nothing listens on port 1
+        with pytest.raises(StorageError, match="unreachable"):
+            storage.get_meta_data_apps().get_all()
+
+
+class TestKeyAuth:
+    @pytest.fixture()
+    def server(self, memory_storage):
+        from predictionio_tpu.serving.config import ServerConfig
+        from predictionio_tpu.serving.store_server import (
+            create_store_server,
+        )
+
+        http = create_store_server(
+            host="127.0.0.1",
+            port=0,
+            storage=memory_storage,
+            server_config=ServerConfig(
+                key_auth_enforced=True, access_key="sekrit"
+            ),
+        )
+        http.start()
+        yield http
+        http.shutdown()
+
+    def test_rejects_without_key(self, server):
+        storage = _client_storage(server.port)
+        with pytest.raises(StorageError, match="access key"):
+            storage.get_meta_data_apps().get_all()
+
+    def test_accepts_bearer_key(self, server):
+        storage = _client_storage(server.port, key="sekrit")
+        apps = storage.get_meta_data_apps()
+        app_id = apps.insert(App(id=0, name="authed"))
+        assert apps.get(app_id).name == "authed"
+
+
+class TestProtocol:
+    @pytest.fixture()
+    def pair(self, memory_storage):
+        from predictionio_tpu.serving.store_server import (
+            create_store_server,
+        )
+
+        http = create_store_server(
+            host="127.0.0.1", port=0, storage=memory_storage
+        )
+        http.start()
+        yield _client_storage(http.port), memory_storage, http.port
+        http.shutdown()
+
+    def test_registry_resolves_all_metadata_daos(self, pair):
+        client, _, _ = pair
+        for name in (
+            "get_meta_data_apps",
+            "get_meta_data_access_keys",
+            "get_meta_data_channels",
+            "get_meta_data_engine_instances",
+            "get_meta_data_engine_manifests",
+            "get_meta_data_evaluation_instances",
+            "get_model_data_models",
+        ):
+            assert getattr(client, name)() is not None
+
+    def test_bad_record_is_client_error(self, pair):
+        _, _, port = pair
+        from predictionio_tpu.data.storage.httpstore import HTTPStoreClient
+
+        raw = HTTPStoreClient({"URL": f"http://127.0.0.1:{port}"})
+        status, _ = raw.request(
+            "POST", "/meta/apps", json_body={"nope": 1}
+        )
+        assert status == 400
+
+    def test_writes_visible_to_direct_backend(self, pair):
+        """Client writes land in the backing store — two processes
+        sharing one store server see each other's metadata (the
+        multi-host control-plane property)."""
+        client, backing, _ = pair
+        app_id = client.get_meta_data_apps().insert(App(id=0, name="shared"))
+        assert backing.get_meta_data_apps().get(app_id).name == "shared"
+
+    def test_unknown_kind_404(self, pair):
+        _, _, port = pair
+        from predictionio_tpu.data.storage.httpstore import HTTPStoreClient
+
+        raw = HTTPStoreClient({"URL": f"http://127.0.0.1:{port}"})
+        status, _ = raw.request("GET", "/meta/frobnicators")
+        assert status == 404
+
+    def test_keepalive_survives_server_connection_close(self, pair):
+        """A pooled connection the server already closed is retried on
+        a fresh socket, not surfaced as an error."""
+        client, _, _ = pair
+        apps = client.get_meta_data_apps()
+        apps.get_all()
+        # reach into the pooled connection and sabotage it
+        dao_client = client._client("STORE")
+        conn, reused = dao_client._connection()
+        assert reused
+        conn.sock.close()
+        assert apps.get_all() == []
+
+    def test_special_character_ids_roundtrip(self, pair):
+        """Ids with '/', '%', spaces survive the URL path (percent-
+        encoded client-side, unquoted server-side)."""
+        from predictionio_tpu.data.storage import (
+            AccessKey,
+            EngineManifest,
+        )
+
+        client, _, _ = pair
+        keys = client.get_meta_data_access_keys()
+        for weird in ("a%41b", "with/slash", "sp ace?x#y"):
+            assert keys.insert(AccessKey(key=weird, appid=1)) == weird
+            assert keys.get(weird).key == weird
+            assert keys.delete(weird) is True
+        manifests = client.get_meta_data_engine_manifests()
+        m = EngineManifest(id="my/engine", version="1.0+tpu", name="n")
+        manifests.insert(m)
+        assert manifests.get("my/engine", "1.0+tpu") == m
+
+    def test_manifest_single_id_route_rejected(self, pair):
+        """engine_manifests is (id, version)-keyed; the single-id routes
+        must 400 rather than crash the DAO with the wrong arity."""
+        _, _, port = pair
+        from predictionio_tpu.data.storage.httpstore import HTTPStoreClient
+
+        raw = HTTPStoreClient({"URL": f"http://127.0.0.1:{port}"})
+        for method in ("GET", "DELETE"):
+            status, body = raw.request(method, "/meta/engine_manifests/x")
+            assert status == 400, (method, status, body)
+
+    def test_no_retry_after_completed_send_on_fresh_connection(self):
+        """A response-phase failure on a fresh connection must surface,
+        not silently re-send a possibly-committed insert."""
+        import socket
+        import threading
+
+        from predictionio_tpu.data.storage.httpstore import HTTPStoreClient
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        accepted = []
+
+        def _accept():
+            while True:
+                try:
+                    conn, _ = srv.accept()
+                except OSError:
+                    return
+                accepted.append(conn)
+                # read the request, then hang up with no response
+                conn.settimeout(5)
+                try:
+                    conn.recv(65536)
+                finally:
+                    conn.close()
+
+        t = threading.Thread(target=_accept, daemon=True)
+        t.start()
+        try:
+            raw = HTTPStoreClient(
+                {"URL": f"http://127.0.0.1:{port}", "TIMEOUT": 5}
+            )
+            with pytest.raises(StorageError, match="unreachable"):
+                raw.request("POST", "/meta/apps", json_body={"x": 1})
+            # exactly one connection: the POST was not re-sent
+            assert len(accepted) == 1
+        finally:
+            srv.close()
+
+
+class TestConfigValidation:
+    def test_missing_url_raises(self):
+        from predictionio_tpu.data.storage.httpstore import HTTPStoreClient
+
+        with pytest.raises(StorageError, match="URL"):
+            HTTPStoreClient({})
+
+    def test_bad_url_raises(self):
+        from predictionio_tpu.data.storage.httpstore import HTTPStoreClient
+
+        with pytest.raises(StorageError, match="not understood"):
+            HTTPStoreClient({"URL": "ftp://x"})
+
+
+class TestTLS:
+    def test_https_with_self_signed_ca(self, memory_storage, tmp_path):
+        """The documented TLS path works end to end: server with a
+        self-signed cert, client trusting it via CACERT."""
+        import subprocess
+
+        cert = tmp_path / "cert.pem"
+        key = tmp_path / "key.pem"
+        subprocess.run(
+            [
+                "openssl", "req", "-x509", "-newkey", "rsa:2048",
+                "-keyout", str(key), "-out", str(cert), "-days", "1",
+                "-nodes", "-subj", "/CN=127.0.0.1",
+                "-addext", "subjectAltName=IP:127.0.0.1",
+            ],
+            check=True,
+            capture_output=True,
+        )
+        from predictionio_tpu.serving.config import ServerConfig
+        from predictionio_tpu.serving.store_server import (
+            create_store_server,
+        )
+
+        http = create_store_server(
+            host="127.0.0.1",
+            port=0,
+            storage=memory_storage,
+            server_config=ServerConfig(
+                ssl_enabled=True,
+                ssl_certfile=str(cert),
+                ssl_keyfile=str(key),
+            ),
+        )
+        http.start()
+        try:
+            storage = Storage(
+                env={
+                    "PIO_STORAGE_SOURCES_STORE_TYPE": "httpstore",
+                    "PIO_STORAGE_SOURCES_STORE_URL":
+                        f"https://127.0.0.1:{http.port}",
+                    "PIO_STORAGE_SOURCES_STORE_CACERT": str(cert),
+                    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "STORE",
+                }
+            )
+            apps = storage.get_meta_data_apps()
+            app_id = apps.insert(App(id=0, name="tls"))
+            assert apps.get(app_id).name == "tls"
+            # without the CA the default verifying context must refuse
+            untrusted = Storage(
+                env={
+                    "PIO_STORAGE_SOURCES_STORE_TYPE": "httpstore",
+                    "PIO_STORAGE_SOURCES_STORE_URL":
+                        f"https://127.0.0.1:{http.port}",
+                    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "STORE",
+                }
+            )
+            with pytest.raises(StorageError, match="unreachable"):
+                untrusted.get_meta_data_apps().get_all()
+            # VERIFY=false opts out (dev only)
+            insecure = Storage(
+                env={
+                    "PIO_STORAGE_SOURCES_STORE_TYPE": "httpstore",
+                    "PIO_STORAGE_SOURCES_STORE_URL":
+                        f"https://127.0.0.1:{http.port}",
+                    "PIO_STORAGE_SOURCES_STORE_VERIFY": "false",
+                    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "STORE",
+                }
+            )
+            assert insecure.get_meta_data_apps().get_by_name("tls")
+        finally:
+            http.shutdown()
+
+
+class TestBlankFilters:
+    def test_get_by_name_blank_returns_none(self, memory_storage):
+        from predictionio_tpu.serving.store_server import (
+            create_store_server,
+        )
+
+        http = create_store_server(
+            host="127.0.0.1", port=0, storage=memory_storage
+        )
+        http.start()
+        try:
+            client = _client_storage(http.port)
+            apps = client.get_meta_data_apps()
+            apps.insert(App(id=0, name="real"))
+            assert apps.get_by_name("") is None
+        finally:
+            http.shutdown()

@@ -26,16 +26,19 @@ def _t(seconds: int) -> dt.datetime:
     )
 
 
-@pytest.fixture(params=["memory", "sqlite", "eventlog", "postgres"])
+@pytest.fixture(
+    params=["memory", "sqlite", "eventlog", "postgres", "httpstore"]
+)
 def storage(
     request, memory_storage, sqlite_storage, eventlog_storage,
-    postgres_storage,
+    postgres_storage, httpstore_storage,
 ):
     return {
         "memory": memory_storage,
         "sqlite": sqlite_storage,
         "eventlog": eventlog_storage,
         "postgres": postgres_storage,
+        "httpstore": httpstore_storage,
     }[request.param]
 
 
