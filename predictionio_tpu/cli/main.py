@@ -361,6 +361,8 @@ def cmd_deploy(args) -> int:
         ctx=_mesh_ctx(args),
         feedback=args.feedback,
         feedback_app_id=feedback_app_id,
+        log_url=args.log_url or None,
+        log_prefix=args.log_prefix,
     )
     http = server.serve(host=args.ip, port=args.port)
     print(f"Engine server is listening on {args.ip}:{http.port}")
@@ -447,22 +449,38 @@ def cmd_storeserver(args) -> int:
     return 0
 
 
+def _file_format(explicit: str, path: str) -> str:
+    """Export/import format: the flag wins, else the file extension
+    (reference Console.scala:604-618 takes --format json|parquet)."""
+    if explicit:
+        return explicit
+    return "npz" if path.endswith(".npz") else "json"
+
+
 def cmd_export(args) -> int:
-    """Events → JSON lines (reference export/EventsToFile.scala:40-104)."""
+    """Events → JSON lines or columnar npz (reference
+    export/EventsToFile.scala:40-104, formats json|parquet)."""
     from predictionio_tpu.data.store import EventStore
 
     store = EventStore()
-    n = 0
-    with open(args.output, "w") as f:
-        for event in store.find(args.app_name, channel_name=args.channel):
-            f.write(json.dumps(event.to_json_dict()) + "\n")
-            n += 1
+    found = store.find(args.app_name, channel_name=args.channel)
+    if _file_format(args.format, args.output) == "npz":
+        from predictionio_tpu.data.eventfile import write_events_npz
+
+        n = write_events_npz(found, args.output)
+    else:
+        n = 0
+        with open(args.output, "w") as f:
+            for event in found:
+                f.write(json.dumps(event.to_json_dict()) + "\n")
+                n += 1
     print(f"Exported {n} events to {args.output}.")
     return 0
 
 
 def cmd_import(args) -> int:
-    """JSON lines → events (reference imprt/FileToEvents.scala:41-103)."""
+    """JSON lines or columnar npz → events (reference
+    imprt/FileToEvents.scala:41-103)."""
     from predictionio_tpu.data.event import Event
     from predictionio_tpu.data.store import EventStore
     from predictionio_tpu.data.storage import get_storage
@@ -472,14 +490,23 @@ def cmd_import(args) -> int:
     events_backend = get_storage().get_events()
     events_backend.init(app_id, channel_id)
 
-    def parse(f):
-        for line in f:
-            line = line.strip()
-            if line:
-                yield Event.from_json_dict(json.loads(line))
+    if _file_format(args.format, args.input) == "npz":
+        from predictionio_tpu.data.eventfile import read_events_npz
 
-    with open(args.input) as f:
-        n = _batched_insert(parse(f), events_backend, app_id, channel_id)
+        n = _batched_insert(
+            read_events_npz(args.input), events_backend, app_id, channel_id
+        )
+    else:
+        def parse(f):
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield Event.from_json_dict(json.loads(line))
+
+        with open(args.input) as f:
+            n = _batched_insert(
+                parse(f), events_backend, app_id, channel_id
+            )
     print(f"Imported {n} events.")
     return 0
 
@@ -800,6 +827,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--feedback", action="store_true")
     p.add_argument("--event-server-app", dest="event_server_app")
+    p.add_argument(
+        "--log-url", dest="log_url", default="",
+        help="POST serving errors to this collector URL",
+    )
+    p.add_argument(
+        "--log-prefix", dest="log_prefix", default="",
+        help="prefix for remote error-log messages",
+    )
     p.set_defaults(func=cmd_deploy)
 
     p = sub.add_parser("undeploy")
@@ -827,12 +862,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--appname", dest="app_name", required=True)
     p.add_argument("--channel")
     p.add_argument("--output", required=True)
+    p.add_argument(
+        "--format", choices=["json", "npz"], default="",
+        help="default: by extension (.npz = columnar, else JSON lines)",
+    )
     p.set_defaults(func=cmd_export)
 
     p = sub.add_parser("import")
     p.add_argument("--appname", dest="app_name", required=True)
     p.add_argument("--channel")
     p.add_argument("--input", required=True)
+    p.add_argument(
+        "--format", choices=["json", "npz"], default="",
+        help="default: by extension (.npz = columnar, else JSON lines)",
+    )
     p.set_defaults(func=cmd_import)
 
     p = sub.add_parser("template")

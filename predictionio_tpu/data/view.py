@@ -43,10 +43,10 @@ def _base_dir() -> str:
 
 def frame_to_npz(frame: EventFrame, path: str) -> None:
     """Persist an EventFrame as a columnar npz (atomic rename)."""
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    tmp = f"{path}.tmp.{os.getpid()}"
-    np.savez_compressed(
-        tmp,
+    from predictionio_tpu.utils.npzio import atomic_savez
+
+    atomic_savez(
+        path,
         event=frame.event,
         entity_type=frame.entity_type,
         entity_id=frame.entity_id,
@@ -57,8 +57,6 @@ def frame_to_npz(frame: EventFrame, path: str) -> None:
             [json.dumps(p) for p in frame.properties], dtype=np.str_
         ),
     )
-    # np.savez appends .npz to the tmp name
-    os.replace(f"{tmp}.npz", path)
 
 
 def frame_from_npz(path: str) -> EventFrame:

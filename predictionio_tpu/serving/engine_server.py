@@ -23,7 +23,9 @@ from __future__ import annotations
 
 import datetime as _dt
 import html as _html
+import json
 import logging
+import queue
 import secrets
 import threading
 import time
@@ -73,6 +75,8 @@ class EngineServer:
         plugins: PluginContext | None = None,
         server_config=None,
         warmup: bool = True,
+        log_url: str | None = None,
+        log_prefix: str = "",
     ):
         self._engine = engine
         self._params = params
@@ -91,6 +95,21 @@ class EngineServer:
         self._predict_timeout_s = predict_timeout_s
         self._plugins = plugins or PluginContext()
         self._warmup = warmup
+        if log_url:
+            parsed = urllib.parse.urlsplit(log_url)
+            if parsed.scheme not in ("http", "https") or not parsed.netloc:
+                # fail at deploy, not per failing query
+                raise ValueError(
+                    f"--log-url {log_url!r} is not an http(s) URL"
+                )
+        self._log_url = log_url
+        self._log_prefix = log_prefix
+        # bounded handoff to ONE sender thread: a slow/dead collector
+        # under overload must never grow threads or block serving
+        self._log_queue: queue.Queue | None = (
+            queue.Queue(maxsize=64) if log_url else None
+        )
+        self._log_sender: threading.Thread | None = None
         if server_config is None:
             from predictionio_tpu.serving.config import ServerConfig
 
@@ -299,6 +318,65 @@ class EngineServer:
 </html>"""
 
     def _queries(self, request: Request) -> Response:
+        try:
+            return self._queries_inner(request)
+        except Exception as exc:
+            # remote error log (reference CreateServer.scala:446-457,
+            # --log-url/--log-prefix): ship serving failures to a
+            # collector, asynchronously, before the HTTP error goes out.
+            # Overload sheds (503) are excluded — logging each shed
+            # would amplify the very condition shedding protects against
+            shed = isinstance(exc, HTTPError) and exc.status == 503
+            if self._log_queue is not None and not shed:
+                self._post_remote_log(exc, request)
+            raise
+
+    def _post_remote_log(self, exc: Exception, request: Request) -> None:
+        """Enqueue an error report; the single sender thread POSTs it.
+        Nothing here may raise — the original serving error must reach
+        the client untouched."""
+        try:
+            payload = json.dumps(
+                {
+                    "message":
+                        f"{self._log_prefix}{type(exc).__name__}: {exc}",
+                    "engineInstance": {
+                        "engineId": self._engine_id,
+                        "engineVersion": self._engine_version,
+                        "engineVariant": self._engine_variant,
+                    },
+                    "query": request.body.decode("utf-8", "replace"),
+                }
+            ).encode("utf-8")
+            self._log_queue.put_nowait(payload)
+        except queue.Full:
+            logger.debug("remote error log queue full; report dropped")
+        except Exception as enc_exc:  # noqa: BLE001 - must not mask exc
+            logger.debug("remote error log encode failed: %s", enc_exc)
+            return
+        if self._log_sender is None or not self._log_sender.is_alive():
+            self._log_sender = threading.Thread(
+                target=self._drain_log_queue,
+                name="remote-error-log",
+                daemon=True,
+            )
+            self._log_sender.start()
+
+    def _drain_log_queue(self) -> None:
+        while True:
+            payload = self._log_queue.get()
+            try:
+                req = urllib.request.Request(
+                    self._log_url,
+                    data=payload,
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                urllib.request.urlopen(req, timeout=5).read()
+            except Exception as send_exc:  # noqa: BLE001 - best effort
+                logger.debug("remote error log failed: %s", send_exc)
+
+    def _queries_inner(self, request: Request) -> Response:
         t0 = time.perf_counter()
         query = request.json()
         if not isinstance(query, dict):

@@ -655,3 +655,21 @@ class TestResumeEdgeCases:
         )
         np.testing.assert_allclose(f.user_factors, 7.0)
         np.testing.assert_allclose(f.item_factors, 8.0)
+
+
+class TestGatherLayoutDefault:
+    def test_auto_resolves_by_backend(self, monkeypatch):
+        from predictionio_tpu.ops.als import _resolve_gather_layout
+
+        monkeypatch.delenv("PIO_ALS_GATHER_LAYOUT", raising=False)
+        # tests pin the cpu backend -> auto means kminor here
+        assert _resolve_gather_layout() == "kminor"
+        monkeypatch.setenv("PIO_ALS_GATHER_LAYOUT", "auto")
+        assert _resolve_gather_layout() == "kminor"
+        monkeypatch.setenv("PIO_ALS_GATHER_LAYOUT", "kmajor")
+        assert _resolve_gather_layout() == "kmajor"
+        monkeypatch.setenv("PIO_ALS_GATHER_LAYOUT", "bogus")
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="bogus"):
+            _resolve_gather_layout()

@@ -272,6 +272,45 @@ class TestBuildTrainExportImport:
         app = memory_storage.get_meta_data_apps().get_by_name("copyapp")
         assert len(list(memory_storage.get_events().find(app.id))) == 30
 
+    def test_export_import_npz_roundtrip(
+        self, cli, memory_storage, tmp_path
+    ):
+        """Columnar format (the reference's parquet analogue,
+        EventsToFile.scala:40-104): full-fidelity export → import."""
+        self._seed(cli, memory_storage)
+        out_file = tmp_path / "events.npz"
+        code, out, _ = cli(
+            "export", "--appname", "clfapp", "--output", str(out_file)
+        )
+        assert code == 0 and "Exported 30" in out
+        cli("app", "new", "npzapp")
+        code, out, _ = cli(
+            "import", "--appname", "npzapp", "--input", str(out_file)
+        )
+        assert code == 0 and "Imported 30" in out
+        src = memory_storage.get_meta_data_apps().get_by_name("clfapp")
+        dst = memory_storage.get_meta_data_apps().get_by_name("npzapp")
+        events = memory_storage.get_events()
+        orig = list(events.find(src.id))
+        copy = list(events.find(dst.id))
+        # exact fidelity: every field except the backend-assigned id
+        strip = lambda e: (  # noqa: E731
+            e.event, e.entity_type, e.entity_id, e.target_entity_type,
+            e.target_entity_id, e.properties.to_dict(), e.event_time,
+            e.tags, e.pr_id, e.creation_time,
+        )
+        assert sorted(map(strip, copy)) == sorted(map(strip, orig))
+
+    def test_eventfile_rejects_foreign_npz(self, tmp_path):
+        import numpy as _np
+
+        from predictionio_tpu.data.eventfile import read_events_npz
+
+        bad = tmp_path / "other.npz"
+        _np.savez(bad, x=_np.arange(3))
+        with pytest.raises(ValueError, match="not an event export"):
+            list(read_events_npz(str(bad)))
+
 
 def _call(url, method="GET", body=None):
     data = json.dumps(body).encode() if body is not None else None

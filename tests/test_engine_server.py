@@ -461,3 +461,80 @@ class TestLoadShedding:
             release.set()
             http.shutdown()
             es.close()
+
+
+class TestRemoteErrorLog:
+    """--log-url (reference CreateServer.scala:446-457): serving
+    failures POST a structured report to a remote collector."""
+
+    def test_error_posts_to_log_url(self, ctx, memory_storage):
+        import http.server
+        import time
+
+        received = []
+        done = threading.Event()
+
+        class Sink(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                received.append(
+                    (self.path, self.rfile.read(length))
+                )
+                self.send_response(200)
+                self.end_headers()
+                done.set()
+
+            def log_message(self, *a):
+                pass
+
+        sink = http.server.HTTPServer(("127.0.0.1", 0), Sink)
+        threading.Thread(target=sink.serve_forever, daemon=True).start()
+        run_train(
+            _engine(), _params(), engine_id="logsrv", ctx=ctx,
+            storage=memory_storage,
+        )
+        es = EngineServer(
+            _engine(),
+            _params(),
+            engine_id="logsrv",
+            storage=memory_storage,
+            ctx=ctx,
+            log_url=f"http://127.0.0.1:{sink.server_port}/collect",
+            log_prefix="pio-",
+        )
+        http_srv = es.serve(host="127.0.0.1", port=0)
+        http_srv.start()
+        try:
+            base = f"http://127.0.0.1:{http_srv.port}"
+            # a non-object query fails validation inside the handler
+            status, _ = _call(f"{base}/queries.json", "POST", [1, 2])
+            assert status == 400
+            assert done.wait(5), "no report reached the collector"
+            path, payload = received[0]
+            assert path == "/collect"
+            report = json.loads(payload)
+            assert report["message"].startswith("pio-")
+            assert report["engineInstance"]["engineId"] == "logsrv"
+            assert json.loads(report["query"]) == [1, 2]
+            # a good query must NOT log
+            done.clear()
+            status, _ = _call(f"{base}/queries.json", "POST", {"x": 1})
+            assert status == 200
+            time.sleep(0.3)
+            assert len(received) == 1
+        finally:
+            http_srv.shutdown()
+            es.close()
+            sink.shutdown()
+
+    def test_bad_log_url_fails_at_deploy(self, ctx, memory_storage):
+        run_train(
+            _engine(), _params(), engine_id="badlog", ctx=ctx,
+            storage=memory_storage,
+        )
+        with pytest.raises(ValueError, match="log-url"):
+            EngineServer(
+                _engine(), _params(), engine_id="badlog",
+                storage=memory_storage, ctx=ctx,
+                log_url="collector.internal/log",  # missing scheme
+            )
