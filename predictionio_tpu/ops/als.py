@@ -275,7 +275,7 @@ def build_bucketed(
     block_len: int = 64,
     row_multiple: int = 1,
     s_max: int = 16,
-    max_slab_slots: int = 2 << 20,
+    max_slab_slots: int = 0,
 ) -> Bucketed:
     """Pack COO → degree-bucketed slabs (vectorized host preprocessing).
 
@@ -289,6 +289,7 @@ def build_bucketed(
     """
     if block_len < 1 or s_max < 1:
         raise ValueError("block_len and s_max must be ≥ 1")
+    max_slab_slots = _resolve_max_slab_slots(max_slab_slots)
 
     def rows_per_group(width: int) -> int:
         per = max(1, max_slab_slots // width) // row_multiple
@@ -449,6 +450,34 @@ def _resolve_compute(compute_dtype: str | None):
         f"unsupported ALS compute_dtype {name!r}; supported: "
         "float32/f32, bfloat16/bf16"
     )
+
+
+#: default HBM bound on the per-slab factor-gather temp (in R·W slots)
+DEFAULT_MAX_SLAB_SLOTS = 2 << 20
+
+
+def _resolve_max_slab_slots(value: int) -> int:
+    """Slab-size cap: explicit value wins, then the
+    ``PIO_ALS_MAX_SLAB_SLOTS`` env knob, then the default. The default
+    was sized for the kminor gather temp (slots × 128 lanes-padded ×4 B
+    = 1 GB/slab at 2M slots); under the kmajor layout the same HBM
+    admits ~4× the slots — a knob worth A/B-ing at 20M-nnz scale."""
+    if value:
+        return value
+    raw = os.environ.get("PIO_ALS_MAX_SLAB_SLOTS", "").strip()
+    if raw:
+        try:
+            parsed = int(raw)
+        except ValueError as e:
+            raise ValueError(
+                f"PIO_ALS_MAX_SLAB_SLOTS {raw!r} is not an integer"
+            ) from e
+        if parsed <= 0:
+            raise ValueError(
+                f"PIO_ALS_MAX_SLAB_SLOTS must be positive, got {parsed}"
+            )
+        return parsed
+    return DEFAULT_MAX_SLAB_SLOTS
 
 
 def _resolve_gather_layout() -> str:
@@ -1112,7 +1141,7 @@ def train_als(
     block_len: int = 64,
     row_chunk: int = 1024,
     s_max: int = 16,
-    max_slab_slots: int = 2 << 20,
+    max_slab_slots: int = 0,
     compute_dtype: str | None = None,
     dtype=jnp.float32,
     timer=None,

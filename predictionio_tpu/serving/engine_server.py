@@ -104,12 +104,18 @@ class EngineServer:
                 )
         self._log_url = log_url
         self._log_prefix = log_prefix
-        # bounded handoff to ONE sender thread: a slow/dead collector
-        # under overload must never grow threads or block serving
-        self._log_queue: queue.Queue | None = (
-            queue.Queue(maxsize=64) if log_url else None
-        )
-        self._log_sender: threading.Thread | None = None
+        # bounded handoff to ONE sender thread (started here, not per
+        # failure — avoids a check-then-act race): a slow/dead
+        # collector under overload must never grow threads or block
+        # serving. close() stops it with a None sentinel.
+        self._log_queue: queue.Queue | None = None
+        if log_url:
+            self._log_queue = queue.Queue(maxsize=64)
+            threading.Thread(
+                target=self._drain_log_queue,
+                name="remote-error-log",
+                daemon=True,
+            ).start()
         if server_config is None:
             from predictionio_tpu.serving.config import ServerConfig
 
@@ -331,11 +337,16 @@ class EngineServer:
                 self._post_remote_log(exc, request)
             raise
 
+    #: reports carry at most this much of the failing query body —
+    #: the 64-slot queue must bound bytes, not just entries
+    _LOG_QUERY_LIMIT = 4096
+
     def _post_remote_log(self, exc: Exception, request: Request) -> None:
         """Enqueue an error report; the single sender thread POSTs it.
         Nothing here may raise — the original serving error must reach
         the client untouched."""
         try:
+            body = request.body[: self._LOG_QUERY_LIMIT]
             payload = json.dumps(
                 {
                     "message":
@@ -345,7 +356,9 @@ class EngineServer:
                         "engineVersion": self._engine_version,
                         "engineVariant": self._engine_variant,
                     },
-                    "query": request.body.decode("utf-8", "replace"),
+                    "query": body.decode("utf-8", "replace"),
+                    "queryTruncated":
+                        len(request.body) > self._LOG_QUERY_LIMIT,
                 }
             ).encode("utf-8")
             self._log_queue.put_nowait(payload)
@@ -353,18 +366,12 @@ class EngineServer:
             logger.debug("remote error log queue full; report dropped")
         except Exception as enc_exc:  # noqa: BLE001 - must not mask exc
             logger.debug("remote error log encode failed: %s", enc_exc)
-            return
-        if self._log_sender is None or not self._log_sender.is_alive():
-            self._log_sender = threading.Thread(
-                target=self._drain_log_queue,
-                name="remote-error-log",
-                daemon=True,
-            )
-            self._log_sender.start()
 
     def _drain_log_queue(self) -> None:
         while True:
             payload = self._log_queue.get()
+            if payload is None:  # close() sentinel
+                return
             try:
                 req = urllib.request.Request(
                     self._log_url,
@@ -517,6 +524,16 @@ class EngineServer:
         for b in self._batchers:
             b.close()
         self._plugins.close()
+        if self._log_queue is not None:
+            # stop the sender so a retired server (and its staged
+            # model, reachable through the bound method) can be GC'd.
+            # A full queue is being actively drained (≤5 s per send),
+            # so a bounded blocking put suffices; on timeout the
+            # daemon thread is abandoned to process exit.
+            try:
+                self._log_queue.put(None, timeout=10)
+            except queue.Full:
+                logger.debug("remote error log sender did not stop")
 
 
 def undeploy_existing(host: str, port: int, server_config=None) -> bool:

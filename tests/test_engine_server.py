@@ -538,3 +538,36 @@ class TestRemoteErrorLog:
                 storage=memory_storage, ctx=ctx,
                 log_url="collector.internal/log",  # missing scheme
             )
+
+    def test_close_stops_sender_and_truncates_large_queries(
+        self, ctx, memory_storage
+    ):
+        import time
+
+        run_train(
+            _engine(), _params(), engine_id="trunc", ctx=ctx,
+            storage=memory_storage,
+        )
+        es = EngineServer(
+            _engine(), _params(), engine_id="trunc",
+            storage=memory_storage, ctx=ctx,
+            log_url="http://127.0.0.1:9/collect",  # unreachable
+        )
+        sender = [
+            t for t in threading.enumerate()
+            if t.name == "remote-error-log"
+        ]
+        assert len(sender) == 1  # started once, at init
+        es.close()
+        deadline = time.time() + 5
+        while time.time() < deadline and sender[0].is_alive():
+            time.sleep(0.05)
+        assert not sender[0].is_alive(), "sender did not stop on close"
+        # oversized failing query: the queued report is bounded (the
+        # sender is stopped, so the payload stays observable)
+        class FakeReq:
+            body = b"[" + b"1," * 100_000 + b"1]"
+        es._post_remote_log(ValueError("boom"), FakeReq())
+        payload = es._log_queue.get_nowait()
+        assert len(payload) < 8192
+        assert b'"queryTruncated": true' in payload
