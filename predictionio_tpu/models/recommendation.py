@@ -152,7 +152,8 @@ class ALSParams(Params):
     seed: int = 13
     block_len: int = 64
     row_chunk: int = 256
-    #: "" = f32; "bfloat16" halves gather HBM traffic (accum stays f32)
+    #: "" = auto (bf16 on TPU, f32 elsewhere — quality A/B in
+    #: BASELINE.md); "float32" opts out, "bfloat16" forces bf16
     compute_dtype: str = ""
     # mid-training checkpoint/resume (ops/als.py); dir empty = disabled
     checkpoint_dir: str = ""

@@ -427,19 +427,30 @@ def build_bucketed(
 
 
 def _resolve_compute(compute_dtype: str | None):
-    """Gather/Gramian compute dtype: None = keep factor dtype (f32).
+    """Gather/Gramian compute dtype: None result = factor dtype (f32).
 
     ``"bfloat16"``/``"bf16"`` halves the gather temp + HBM traffic (the
     factor matrix is cast BEFORE the gather) and doubles MXU rate;
     Gramians still accumulate in f32 (``preferred_element_type``) and
     the Cholesky solve stays f32. Empty/None falls back to the
-    ``PIO_ALS_COMPUTE_DTYPE`` env knob, then f32. Unknown names fail
+    ``PIO_ALS_COMPUTE_DTYPE`` env knob, then ``auto``: bf16 on the TPU
+    backend, f32 elsewhere. The default is bf16-on-TPU because the
+    quality impact is unmeasurable on ranking tasks — planted-cluster
+    precision@10 0.9729 (f32) vs 0.9730 (bf16), top-10 overlap 99.5%
+    (BASELINE.md quality A/B) — while epochs run 12–14% faster; pass
+    ``"float32"`` (or set the env knob) to opt out. Unknown names fail
     here — at solver build — with the supported list.
     """
     name = (compute_dtype or "").strip().lower()
     if not name:
         name = os.environ.get("PIO_ALS_COMPUTE_DTYPE", "").strip().lower()
-    if name in ("", "float32", "f32"):
+    if not name:
+        name = "auto"
+    if name == "auto":
+        return (
+            jnp.bfloat16 if jax.default_backend() == "tpu" else None
+        )
+    if name in ("float32", "f32"):
         return None
     if name in ("bfloat16", "bf16"):
         return jnp.bfloat16
@@ -448,7 +459,7 @@ def _resolve_compute(compute_dtype: str | None):
     # affected rows; bf16 has the f32 exponent range and is immune
     raise ValueError(
         f"unsupported ALS compute_dtype {name!r}; supported: "
-        "float32/f32, bfloat16/bf16"
+        "auto, float32/f32, bfloat16/bf16"
     )
 
 
@@ -463,6 +474,10 @@ def _resolve_max_slab_slots(value: int) -> int:
     = 1 GB/slab at 2M slots); under the kmajor layout the same HBM
     admits ~4× the slots — a knob worth A/B-ing at 20M-nnz scale."""
     if value:
+        if value < 0:
+            raise ValueError(
+                f"max_slab_slots must be positive, got {value}"
+            )
         return value
     raw = os.environ.get("PIO_ALS_MAX_SLAB_SLOTS", "").strip()
     if raw:

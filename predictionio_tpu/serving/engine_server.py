@@ -104,18 +104,14 @@ class EngineServer:
                 )
         self._log_url = log_url
         self._log_prefix = log_prefix
-        # bounded handoff to ONE sender thread (started here, not per
-        # failure — avoids a check-then-act race): a slow/dead
-        # collector under overload must never grow threads or block
-        # serving. close() stops it with a None sentinel.
-        self._log_queue: queue.Queue | None = None
-        if log_url:
-            self._log_queue = queue.Queue(maxsize=64)
-            threading.Thread(
-                target=self._drain_log_queue,
-                name="remote-error-log",
-                daemon=True,
-            ).start()
+        # bounded handoff to ONE sender thread: a slow/dead collector
+        # under overload must never grow threads or block serving.
+        # close() stops it with a None sentinel. The thread starts at
+        # the END of __init__ (not per failure — check-then-act race;
+        # not here — a later init failure would leak it unjoinably).
+        self._log_queue: queue.Queue | None = (
+            queue.Queue(maxsize=64) if log_url else None
+        )
         if server_config is None:
             from predictionio_tpu.serving.config import ServerConfig
 
@@ -137,6 +133,12 @@ class EngineServer:
         self.router.route("POST", "/stop", self._stop)
         install_plugin_routes(self.router, self._plugins, OUTPUT_SNIFFER)
         self._http: HTTPServer | None = None
+        if self._log_queue is not None:
+            threading.Thread(
+                target=self._drain_log_queue,
+                name="remote-error-log",
+                daemon=True,
+            ).start()
 
     # -- model loading / hot swap ----------------------------------------
     def _load(self) -> None:

@@ -246,7 +246,11 @@ class TestComputeDtype:
     def test_env_knob_resolves(self, monkeypatch):
         from predictionio_tpu.ops.als import _resolve_compute
 
+        monkeypatch.delenv("PIO_ALS_COMPUTE_DTYPE", raising=False)
+        # default is "auto": f32 on the CPU backend the tests pin
+        # (bf16 on TPU — quality A/B in BASELINE.md)
         assert _resolve_compute(None) is None
+        assert _resolve_compute("auto") is None
         assert _resolve_compute("float32") is None
         assert _resolve_compute("bfloat16") == jnp.bfloat16
         monkeypatch.setenv("PIO_ALS_COMPUTE_DTYPE", "bfloat16")
