@@ -1005,7 +1005,8 @@ def make_sharded_train_step(
     gather_layout = _resolve_gather_layout()
 
     @partial(jax.jit, static_argnames=("n_iters",))
-    def run(x, y, lam, n_iters):
+    def _run(x, y, u_slabs_a, u_heavy_a, u_inv_a,
+             i_slabs_a, i_heavy_a, i_inv_a, lam, n_iters):
         def body(x_loc, y_loc, u_slabs, u_heavy, u_inv,
                  i_slabs, i_heavy, i_inv, lam_):
             def it(_, carry):
@@ -1043,8 +1044,18 @@ def make_sharded_train_step(
             check_vma=False,
         )
         return f(
+            x, y, u_slabs_a, u_heavy_a, u_inv_a,
+            i_slabs_a, i_heavy_a, i_inv_a, lam,
+        )
+
+    def run(x, y, lam, n_iters):
+        # the staged side arrays enter as jit ARGUMENTS, not closure
+        # captures: jit may not close over arrays spanning another
+        # process's devices, and multi-host meshes are the point here
+        return _run(
             x, y, u_side.slabs, u_side.heavy, u_side.inv,
             i_side.slabs, i_side.heavy, i_side.inv, lam,
+            n_iters=n_iters,
         )
 
     return run
@@ -1062,7 +1073,7 @@ def make_sharded_half_step(
     gather_layout = _resolve_gather_layout()
 
     @jax.jit
-    def solve_once(y, lam):
+    def _solve(y, slabs_a, heavy_a, inv_a, lam):
         def body(y_loc, slabs, heavy, inv, lam_):
             y_full = lax.all_gather(
                 y_loc.astype(compute) if compute is not None else y_loc,
@@ -1083,7 +1094,12 @@ def make_sharded_half_step(
             out_specs=P(MODEL_AXIS, None),
             check_vma=False,
         )
-        return f(y, side.slabs, side.heavy, side.inv, lam)
+        return f(y, slabs_a, heavy_a, inv_a, lam)
+
+    def solve_once(y, lam):
+        # side arrays as jit arguments, not closure captures (multi-
+        # host meshes forbid closing over non-addressable arrays)
+        return _solve(y, side.slabs, side.heavy, side.inv, lam)
 
     return solve_once
 

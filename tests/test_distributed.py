@@ -26,6 +26,23 @@ class TestLaunchProcesses:
         )
         assert rc == 0
 
+    def test_two_process_sharded_als_train(self):
+        """The REAL training path across the process boundary: model-
+        sharded ALS (shard_map + all-gathers) on a 2-host × 2-device
+        mesh matches a single-process run of the same problem."""
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        rc = launch_processes(
+            [
+                sys.executable,
+                os.path.join(_HERE, "distributed_als_child.py"),
+            ],
+            num_processes=2,
+            env=env,
+            timeout=300,
+        )
+        assert rc == 0
+
     def test_env_contract(self):
         """Children see coordinator address, world size, and their rank."""
         probe = (
