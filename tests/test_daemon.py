@@ -124,3 +124,29 @@ class TestStatusAll:
         rc = daemon.status_all(out=lines.append)
         assert rc == 1  # nothing running
         assert any("eventserver: stopped" in ln for ln in lines)
+
+
+class TestStoreServerDaemon:
+    def test_storeserver_daemon_roundtrip(self, piodir):
+        """The storeserver rides the same supervisor as minipg: spawn,
+        serve, status, stop (reference bin/pio-start-all pattern)."""
+        port = 17903
+        pid = daemon.spawn_daemon(
+            "storeserver",
+            ["storeserver", "--ip", "127.0.0.1", "--port", str(port)],
+            env={"PIO_FS_BASEDIR": str(piodir)},
+        )
+        try:
+            assert daemon.wait_port(
+                "127.0.0.1", port, timeout=60.0, pid=pid
+            ), open(daemon.logfile("storeserver")).read()[-2000:]
+            state, got_pid = daemon.service_status("storeserver")
+            assert state == "running" and got_pid == pid
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/", timeout=10
+            ).read()
+            assert json.loads(body)["service"] == "storeserver"
+        finally:
+            outcome = daemon.stop_daemon("storeserver")
+        assert outcome.startswith("stopped")
+        assert daemon.service_status("storeserver") == ("stopped", None)
