@@ -13,6 +13,8 @@ Capability parity with the reference Event Server
 * ``POST /batch/events.json``    → ≤50 events, per-event status (:374-440)
 * ``GET  /stats.json``           → opt-in counters (``--stats``)
 * ``POST /webhooks/<name>.json`` / ``.form`` → connector-mapped events
+* ``GET  /webhooks/<name>.json`` / ``.form`` → connector-existence
+  probe, 200 "Ok" / 404 (Webhooks.scala:82-96,135-149)
 
 Differences: thread-per-request stdlib HTTP instead of spray/akka;
 plugins come from an explicit :class:`PluginContext` (+ ``PIO_PLUGINS``
@@ -80,6 +82,8 @@ class EventServer:
         r.route("GET", "/stats.json", self._stats_route)
         r.route("POST", "/webhooks/<name>.json", self._webhook_json)
         r.route("POST", "/webhooks/<name>.form", self._webhook_form)
+        r.route("GET", "/webhooks/<name>.json", self._webhook_json_probe)
+        r.route("GET", "/webhooks/<name>.form", self._webhook_form_probe)
         install_plugin_routes(r, self._plugins, INPUT_SNIFFER)
 
     # -- auth (reference EventServer.scala:90-140) ------------------------
@@ -325,6 +329,30 @@ class EventServer:
         if self._stats:
             self._stats.update(app_id, 201, event)
         return Response(201, {"eventId": event_id})
+
+    def _webhook_json_probe(self, request: Request) -> Response:
+        """Connector-existence probe (reference Webhooks.getJson,
+        api/Webhooks.scala:82-96): 200 Ok when registered, else 404 —
+        external services (segment.io) ping this before sending."""
+        self._auth(request)
+        if request.path_params["name"] not in JSON_CONNECTORS:
+            raise HTTPError(
+                404,
+                f"webhooks connection for "
+                f"{request.path_params['name']} is not supported.",
+            )
+        return Response(200, {"message": "Ok"})
+
+    def _webhook_form_probe(self, request: Request) -> Response:
+        """Reference Webhooks.getForm (api/Webhooks.scala:135-149)."""
+        self._auth(request)
+        if request.path_params["name"] not in FORM_CONNECTORS:
+            raise HTTPError(
+                404,
+                f"webhooks connection for "
+                f"{request.path_params['name']} is not supported.",
+            )
+        return Response(200, {"message": "Ok"})
 
     def _webhook_form(self, request: Request) -> Response:
         app_id, channel_id, whitelist = self._auth(request)

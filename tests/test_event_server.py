@@ -218,6 +218,27 @@ class TestEventAPI:
         )
         assert status == 404
 
+    def test_webhook_get_probe(self, server):
+        """GET probe (reference Webhooks.getJson/getForm,
+        api/Webhooks.scala:82-96,135-149): 200 Ok for registered
+        connectors, 404 otherwise, auth required."""
+        base, key, _ = server
+        status, body = _call(
+            f"{base}/webhooks/segmentio.json?accessKey={key}"
+        )
+        assert (status, body) == (200, {"message": "Ok"})
+        status, body = _call(
+            f"{base}/webhooks/mailchimp.form?accessKey={key}"
+        )
+        assert (status, body) == (200, {"message": "Ok"})
+        # registered under the other protocol -> 404
+        status, _ = _call(
+            f"{base}/webhooks/mailchimp.json?accessKey={key}"
+        )
+        assert status == 404
+        status, _ = _call(f"{base}/webhooks/segmentio.json")
+        assert status == 401
+
     def test_method_not_allowed(self, server):
         base, key, _ = server
         status, _ = _call(f"{base}/batch/events.json?accessKey={key}")
