@@ -41,6 +41,11 @@ WORKLOADS = {
     # name: (n_users, n_items, nnz, rank)
     "default": (49_152, 8_192, 2_000_000, 32),
     "ml20m": (138_493, 26_744, 20_000_000, 32),
+    # Criteo-magnitude interaction count (BASELINE.md targets table:
+    # "MovieLens-20M/Criteo scale"); 5x the nnz and ~9x the entity
+    # rows of ml20m — a single-chip headroom probe, not a driver
+    # default (PIO_BENCH_SCALE=criteo100m to run)
+    "criteo100m": (1_000_000, 500_000, 100_000_000, 32),
 }
 BLOCK_LEN = 64
 EPOCHS_PER_DISPATCH = 8

@@ -638,6 +638,18 @@ def _assemble_and_solve(
     ``heavy_groups`` is a sequence of ``(idx, weights, valid, owner)``
     sub-row slab groups (possibly several — build_bucketed caps slab
     size to bound the factor-gather temp).
+
+    Memory shape: each slab group's ``[R_g, k, k]`` Gramians are solved
+    IMMEDIATELY and only the ``[R_g, k]`` factor rows survive to the
+    final concatenation — the full ``[n_stat_rows, k, k]`` stats array
+    never materializes. At 1M+ entity rows that array alone is >4 GB
+    (plus the epoch loop's copies), which OOMed a 16 GB chip at the
+    Criteo-magnitude workload; bounding peak HBM by the slab cap
+    instead makes row count a host-memory concern only. Heavy sub-rows
+    are the one scatter-add: their owner slots sit AFTER all regular
+    rows in the stats layout (build_bucketed appends them; plan_shards
+    keeps the same device-local shape), so they accumulate into a
+    small ``[n_heavy_slots, k, k]`` buffer solved last.
     """
     k = y.shape[1]
     dtype = y.dtype
@@ -649,38 +661,44 @@ def _assemble_and_solve(
         # dtype must not be inferred from it.
         dtype = jnp.float32
         y = y.astype(compute)
-    parts_a, parts_b, parts_cnt = [], [], []
-    for (idx, weights, valid) in slab_arrays:
-        a, b, cnt = _slab_stats(
-            y, idx, weights, valid, implicit, alpha, dtype, compute,
-            gather_layout,
-        )
-        parts_a.append(a)
-        parts_b.append(b)
-        parts_cnt.append(cnt)
-    if n_heavy_slots:
-        parts_a.append(jnp.zeros((n_heavy_slots, k, k), dtype))
-        parts_b.append(jnp.zeros((n_heavy_slots, k), dtype))
-        parts_cnt.append(jnp.zeros((n_heavy_slots,), dtype))
-    a = jnp.concatenate(parts_a, axis=0)
-    b = jnp.concatenate(parts_b, axis=0)
-    cnt = jnp.concatenate(parts_cnt, axis=0)
-    for (idx, weights, valid, owner) in heavy_groups:
-        ha, hb, hcnt = _slab_stats(
-            y, idx, weights, valid, implicit, alpha, dtype, compute,
-            gather_layout,
-        )
-        owner = jnp.asarray(owner)
-        # few sub-rows (head of the power law): small scatter-add
-        a = a.at[owner].add(ha)
-        b = b.at[owner].add(hb)
-        cnt = cnt.at[owner].add(hcnt)
     yty = (
         jnp.einsum("ik,im->km", y, y, preferred_element_type=dtype)
         if implicit
         else None
     )
-    return _solve(a, b, cnt, yty, lam, implicit, k, dtype)
+    n_regular = 0
+    parts_x = []
+    for (idx, weights, valid) in slab_arrays:
+        a, b, cnt = _slab_stats(
+            y, idx, weights, valid, implicit, alpha, dtype, compute,
+            gather_layout,
+        )
+        parts_x.append(_solve(a, b, cnt, yty, lam, implicit, k, dtype))
+        n_regular += idx.shape[0]
+    if n_heavy_slots:
+        ha = jnp.zeros((n_heavy_slots, k, k), dtype)
+        hb = jnp.zeros((n_heavy_slots, k), dtype)
+        hcnt = jnp.zeros((n_heavy_slots,), dtype)
+        for (idx, weights, valid, owner) in heavy_groups:
+            ga, gb, gcnt = _slab_stats(
+                y, idx, weights, valid, implicit, alpha, dtype, compute,
+                gather_layout,
+            )
+            # owners are absolute stats positions; rebase into the
+            # heavy-only buffer. Phantom sub-rows carry owner 0 with
+            # all-zero weights/valid — clip keeps their (zero)
+            # contribution in range instead of wrapping negatively.
+            local = jnp.clip(
+                jnp.asarray(owner) - n_regular, 0, n_heavy_slots - 1
+            )
+            # few sub-rows (head of the power law): small scatter-add
+            ha = ha.at[local].add(ga)
+            hb = hb.at[local].add(gb)
+            hcnt = hcnt.at[local].add(gcnt)
+        parts_x.append(
+            _solve(ha, hb, hcnt, yty, lam, implicit, k, dtype)
+        )
+    return jnp.concatenate(parts_x, axis=0)
 
 
 def make_bucketed_solver(

@@ -330,12 +330,12 @@ class EventServer:
             self._stats.update(app_id, 201, event)
         return Response(201, {"eventId": event_id})
 
-    def _webhook_json_probe(self, request: Request) -> Response:
-        """Connector-existence probe (reference Webhooks.getJson,
-        api/Webhooks.scala:82-96): 200 Ok when registered, else 404 —
-        external services (segment.io) ping this before sending."""
+    def _webhook_probe(self, request: Request, connectors) -> Response:
+        """Connector-existence probe (reference Webhooks.getJson/getForm,
+        api/Webhooks.scala:82-96,135-149): 200 Ok when registered, else
+        404 — external services (segment.io) ping this before sending."""
         self._auth(request)
-        if request.path_params["name"] not in JSON_CONNECTORS:
+        if request.path_params["name"] not in connectors:
             raise HTTPError(
                 404,
                 f"webhooks connection for "
@@ -343,16 +343,11 @@ class EventServer:
             )
         return Response(200, {"message": "Ok"})
 
+    def _webhook_json_probe(self, request: Request) -> Response:
+        return self._webhook_probe(request, JSON_CONNECTORS)
+
     def _webhook_form_probe(self, request: Request) -> Response:
-        """Reference Webhooks.getForm (api/Webhooks.scala:135-149)."""
-        self._auth(request)
-        if request.path_params["name"] not in FORM_CONNECTORS:
-            raise HTTPError(
-                404,
-                f"webhooks connection for "
-                f"{request.path_params['name']} is not supported.",
-            )
-        return Response(200, {"message": "Ok"})
+        return self._webhook_probe(request, FORM_CONNECTORS)
 
     def _webhook_form(self, request: Request) -> Response:
         app_id, channel_id, whitelist = self._auth(request)
