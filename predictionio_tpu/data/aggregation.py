@@ -38,7 +38,11 @@ class _PropTime:
     t: _dt.datetime
 
     def combine(self, other: "_PropTime") -> "_PropTime":
-        return other if other.t > self.t else self
+        # tie goes to ``other`` — reference parity: SetProp.++ keeps
+        # ``that`` when times are equal (PEventAggregator.scala:38-44,
+        # ``if (thisData.t > thatData.t) thisData else thatData``), so
+        # for same-time $set events the later-combined operand wins
+        return self if self.t > other.t else other
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,206 @@
+"""Property-based tests (hypothesis) for the two subtlest pure-logic
+pieces, complementing the deterministic suites the reference's test
+strategy prescribes (SURVEY.md §4):
+
+* the ``$set/$unset/$delete`` EventOp monoid — associativity and
+  fold-order invariance are exactly what the reference's distributed
+  ``aggregateByKey`` relies on (PEventAggregator.scala:87-207);
+* the ALS packer layout — whatever the bucketing/splitting/heavy
+  machinery does, every interaction must land exactly once in the slot
+  an entity's stats row owns.
+"""
+
+import datetime as _dt
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.aggregation import aggregate_properties
+from predictionio_tpu.ops import als
+
+# --------------------------------------------------------------------------
+# aggregation monoid
+# --------------------------------------------------------------------------
+
+_T0 = _dt.datetime(2026, 1, 1, tzinfo=_dt.timezone.utc)
+
+
+def _special_events(max_entities: int = 3):
+    """Random $set/$unset/$delete streams over a few entities/keys with
+    colliding and distinct timestamps."""
+    return st.lists(
+        st.tuples(
+            st.sampled_from(["$set", "$unset", "$delete"]),
+            st.integers(0, max_entities - 1),            # entity
+            st.integers(0, 600),                          # seconds offset
+            st.dictionaries(                              # properties
+                st.sampled_from(["a", "b", "c"]),
+                st.integers(0, 9),
+                min_size=1,                               # $set/$unset
+                max_size=3,                               # require props
+            ),
+        ),
+        max_size=14,
+    )
+
+
+def _build(events):
+    out = []
+    for name, ent, secs, props in events:
+        out.append(
+            Event(
+                event=name,
+                entity_type="e",
+                entity_id=f"id{ent}",
+                # $delete carries no properties (validation enforces
+                # non-empty props for $set/$unset only)
+                properties=DataMap({} if name == "$delete" else dict(props)),
+                event_time=_T0 + _dt.timedelta(seconds=secs),
+            )
+        )
+    return out
+
+
+def _naive(events):
+    """Declarative interpreter of the reference's monoid semantics
+    (PEventAggregator.scala toPropertyMap): per entity, the latest
+    $set value per key (input order breaks exact-time ties, matching
+    the fold), dropped when an $unset or $delete time is >= its set
+    time; the whole entity is dropped when the latest $delete covers
+    the latest $set."""
+    per: dict[str, dict] = {}
+    for e in events:
+        s = per.setdefault(
+            e.entity_id,
+            {"fields": {}, "set_t": None, "unset": {}, "del_t": None},
+        )
+        t = e.event_time
+        if e.event == "$set":
+            for k, v in e.properties.to_dict().items():
+                cur = s["fields"].get(k)
+                if cur is None or t >= cur[1]:  # tie -> later in fold
+                    s["fields"][k] = (v, t)
+            s["set_t"] = t if s["set_t"] is None else max(s["set_t"], t)
+        elif e.event == "$unset":
+            for k in e.properties.to_dict():
+                prev = s["unset"].get(k)
+                s["unset"][k] = t if prev is None else max(prev, t)
+        elif e.event == "$delete":
+            s["del_t"] = t if s["del_t"] is None else max(s["del_t"], t)
+    out = {}
+    for eid, s in per.items():
+        if s["set_t"] is None:
+            continue
+        if s["del_t"] is not None and s["del_t"] >= s["set_t"]:
+            continue
+        fields = {}
+        for k, (v, t) in s["fields"].items():
+            if k in s["unset"] and s["unset"][k] >= t:
+                continue
+            if s["del_t"] is not None and s["del_t"] >= t:
+                continue
+            fields[k] = v
+        out[eid] = fields
+    return out
+
+
+@settings(max_examples=200, deadline=None)
+@given(_special_events())
+def test_aggregation_matches_naive_interpreter(raw):
+    events = _build(raw)
+    got = {
+        eid: pm.to_dict()
+        for eid, pm in aggregate_properties(events).items()
+    }
+    assert got == _naive(events)
+
+
+@settings(max_examples=100, deadline=None)
+@given(_special_events(), st.randoms(use_true_random=False))
+def test_aggregation_fold_order_invariant(raw, rnd):
+    """Shuffling the event stream must not change the aggregate — the
+    monoid property distributed folds depend on. Holds for distinct
+    event times; same-time events tie-break by fold order in the
+    reference too (PEventAggregator.scala:38-44), so timestamps are
+    de-duplicated here."""
+    raw = [
+        (name, ent, i, props)  # unique, order-preserving times
+        for i, (name, ent, _secs, props) in enumerate(raw)
+    ]
+    events = _build(raw)
+    shuffled = list(events)
+    rnd.shuffle(shuffled)
+    a = {e: p.to_dict() for e, p in aggregate_properties(events).items()}
+    b = {e: p.to_dict() for e, p in aggregate_properties(shuffled).items()}
+    assert a == b
+
+
+# --------------------------------------------------------------------------
+# ALS packer layout invariant
+# --------------------------------------------------------------------------
+
+
+@settings(max_examples=60, deadline=None)
+@given(
+    st.integers(1, 40),          # n_rows
+    st.integers(1, 25),          # n_cols
+    st.integers(0, 300),         # nnz
+    st.sampled_from([1, 2, 4, 8]),     # block_len
+    st.sampled_from([1, 2, 4]),        # s_max
+    st.sampled_from([8, 64, 1 << 20]),  # max_slab_slots
+    st.sampled_from([1, 2, 4]),        # row_multiple
+    st.integers(0, 2**31 - 1),   # seed
+)
+def test_build_bucketed_places_every_nnz_exactly_once(
+    n_rows, n_cols, nnz, block_len, s_max, cap, row_multiple, seed
+):
+    rng = np.random.default_rng(seed)
+    rows = rng.integers(0, n_rows, nnz).astype(np.int32)
+    cols = rng.integers(0, n_cols, nnz).astype(np.int32)
+    vals = rng.uniform(0.5, 5.0, nnz).astype(np.float32)
+    packed = als.build_bucketed(
+        rows, cols, vals, n_rows,
+        block_len=block_len, row_multiple=row_multiple,
+        s_max=s_max, max_slab_slots=cap,
+    )
+
+    # stats-position -> owning entity (inv_perm is a bijection onto
+    # [0, n_stat_rows) for real rows; phantom stat rows own nothing)
+    inv = packed.inv_perm
+    assert len(set(inv.tolist())) == len(inv)  # injective
+    owner_of_pos = {int(p): r for r, p in enumerate(inv)}
+
+    per_entity: dict[int, list] = {}
+    pos = 0
+    for slab in packed.slabs:
+        for j in range(slab.idx.shape[0]):
+            ent = owner_of_pos.get(pos + j)
+            mask = slab.valid[j] > 0
+            if mask.any():
+                assert ent is not None, "valid slots in a phantom row"
+                per_entity.setdefault(ent, []).extend(
+                    zip(slab.idx[j][mask].tolist(),
+                        slab.weights[j][mask].tolist())
+                )
+        pos += slab.idx.shape[0]
+    for slab, owners in zip(packed.heavy, packed.heavy_owner_pos):
+        for j in range(slab.idx.shape[0]):
+            mask = slab.valid[j] > 0
+            if not mask.any():
+                continue
+            ent = owner_of_pos.get(int(owners[j]))
+            assert ent is not None
+            per_entity.setdefault(ent, []).extend(
+                zip(slab.idx[j][mask].tolist(),
+                    slab.weights[j][mask].tolist())
+            )
+
+    expected: dict[int, list] = {}
+    for r, c, v in zip(rows.tolist(), cols.tolist(), vals.tolist()):
+        expected.setdefault(r, []).append((c, float(np.float32(v))))
+    got = {
+        e: sorted(lst) for e, lst in per_entity.items() if lst
+    }
+    want = {e: sorted(lst) for e, lst in expected.items()}
+    assert got == want
