@@ -204,3 +204,89 @@ def test_build_bucketed_places_every_nnz_exactly_once(
     }
     want = {e: sorted(lst) for e, lst in expected.items()}
     assert got == want
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    st.integers(1, 40),            # n_rows
+    st.integers(1, 20),            # n_cols
+    st.integers(0, 250),           # nnz
+    st.sampled_from([1, 2]),       # s_max (small -> heavy rows likely)
+    st.sampled_from([2, 4]),       # n_shards
+    st.integers(0, 2**31 - 1),     # seed
+)
+def test_plan_shards_layout_invariants(
+    n_rows, n_cols, nnz, s_max, n_shards, seed
+):
+    """The device-major sharded layout must (a) keep inv_perm_dm
+    injective, (b) pin every heavy sub-row to the same device as its
+    owner slot, and (c) still place every interaction exactly once —
+    reconstructing entities through the device-major positions."""
+    rng = np.random.default_rng(seed)
+    rows = rng.integers(0, n_rows, nnz).astype(np.int32)
+    cols = rng.integers(0, n_cols, nnz).astype(np.int32)
+    vals = rng.uniform(0.5, 5.0, nnz).astype(np.float32)
+    packed = als.build_bucketed(
+        rows, cols, vals, n_rows,
+        block_len=2, row_multiple=n_shards, s_max=s_max,
+        max_slab_slots=64,
+    )
+    plan = als.plan_shards(packed, n_shards)
+
+    inv = plan.inv_perm_dm
+    assert len(set(inv.tolist())) == len(inv)
+    c_local = plan.c_local
+    c_slab = c_local - plan.n_heavy_slots_local
+
+    # (b): heavy owner slots are device-local heavy-region positions
+    if plan.heavy is not None:
+        for j in range(plan.heavy.idx.shape[0]):
+            if not (plan.heavy.valid[j] > 0).any():
+                continue
+            assert c_slab <= int(plan.heavy_owner_local[j]) < c_local
+    # (c): entity -> interactions through the device-major layout.
+    # Using shard(row) * c_local + owner_local as the position means a
+    # sub-row placed on the wrong device would reconstruct the wrong
+    # entity — co-location is checked by the equality below.
+    owner_of_pos: dict[int, int] = {}
+    for r in range(packed.n_rows_padded):
+        owner_of_pos[int(inv[r])] = r
+    per_entity: dict[int, list] = {}
+    # regular slab rows: device-major position = shard * c_local + local
+    rbs = [s.idx.shape[0] for s in packed.slabs]
+    per = [rb // n_shards for rb in rbs]
+    local_off = np.concatenate([[0], np.cumsum(per)[:-1]]).astype(int)
+    for si, slab in enumerate(packed.slabs):
+        for j in range(slab.idx.shape[0]):
+            mask = slab.valid[j] > 0
+            if not mask.any():
+                continue
+            shard = j // per[si]
+            local = local_off[si] + (j % per[si])
+            pos = shard * c_local + local
+            ent = owner_of_pos.get(pos)
+            assert ent is not None, "valid slots in an unowned row"
+            per_entity.setdefault(ent, []).extend(
+                zip(slab.idx[j][mask].tolist(),
+                    slab.weights[j][mask].tolist())
+            )
+    if plan.heavy is not None:
+        rb_per = plan.heavy.idx.shape[0] // n_shards
+        for j in range(plan.heavy.idx.shape[0]):
+            mask = plan.heavy.valid[j] > 0
+            if not mask.any():
+                continue
+            shard = j // rb_per
+            pos = shard * c_local + int(plan.heavy_owner_local[j])
+            ent = owner_of_pos.get(pos)
+            assert ent is not None
+            per_entity.setdefault(ent, []).extend(
+                zip(plan.heavy.idx[j][mask].tolist(),
+                    plan.heavy.weights[j][mask].tolist())
+            )
+    expected: dict[int, list] = {}
+    for r, c, v in zip(rows.tolist(), cols.tolist(), vals.tolist()):
+        expected.setdefault(r, []).append((c, float(np.float32(v))))
+    got = {e: sorted(lst) for e, lst in per_entity.items() if lst}
+    want = {e: sorted(lst) for e, lst in expected.items()}
+    assert got == want
