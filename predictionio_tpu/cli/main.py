@@ -340,6 +340,15 @@ def cmd_eval(args) -> int:
 def cmd_deploy(args) -> int:
     from predictionio_tpu.serving.engine_server import EngineServer
 
+    if args.max_batch < 1:
+        # 0 would also zero the derived queue bound, silently disabling
+        # overload shedding — refuse at deploy time
+        print(
+            f"error: --max-batch must be >= 1, got {args.max_batch}",
+            file=sys.stderr,
+        )
+        return 1
+
     engine, params, engine_id, variant, _ = _resolve(args)
     feedback_app_id = None
     if args.feedback:
@@ -363,6 +372,8 @@ def cmd_deploy(args) -> int:
         feedback_app_id=feedback_app_id,
         log_url=args.log_url or None,
         log_prefix=args.log_prefix,
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
     )
     http = server.serve(host=args.ip, port=args.port)
     print(f"Engine server is listening on {args.ip}:{http.port}")
@@ -834,6 +845,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--log-prefix", dest="log_prefix", default="",
         help="prefix for remote error-log messages",
+    )
+    p.add_argument(
+        "--max-batch", dest="max_batch", type=int, default=64,
+        help="micro-batcher bucket ceiling (queries per device dispatch)",
+    )
+    p.add_argument(
+        "--max-wait-ms", dest="max_wait_ms", type=float, default=2.0,
+        help="micro-batcher fill window in milliseconds",
     )
     p.set_defaults(func=cmd_deploy)
 

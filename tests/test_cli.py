@@ -437,3 +437,11 @@ class TestTemplateAndRun:
     def test_run_bad_target(self, cli):
         code, _, err = cli("run", "nocolon")
         assert code == 1 and "module:function" in err
+
+
+class TestDeployFlags:
+    def test_max_batch_zero_rejected(self, cli):
+        code, _out, err = cli(
+            "deploy", "--variant", "nope.json", "--max-batch", "0"
+        )
+        assert code != 0 and "max-batch" in err
