@@ -64,16 +64,42 @@ def _batched_insert(events_iter, backend, app_id, channel_id) -> int:
     return n
 
 
-def _mesh_ctx(args):
+def _variant_batch(args, variant: dict | None) -> str:
+    """Run batch label: the --batch flag wins, else the variant's
+    ``meshConf.batch``."""
+    return (
+        getattr(args, "batch", "")
+        or ((variant or {}).get("meshConf") or {}).get("batch", "")
+        or ""
+    )
+
+
+def _mesh_ctx(args, variant: dict | None = None):
+    """Compute context from CLI flags, falling back to the variant's
+    embedded ``meshConf`` — the analogue of the reference's engine.json
+    ``sparkConf`` block (WorkflowUtils.extractSparkConf:308-327):
+    ``{"meshConf": {"shape": "4,2" | [4, 2], "batch": "nightly"}}``
+    (shape = device counts per data/model axis)."""
     from predictionio_tpu.parallel import distributed
     from predictionio_tpu.parallel.mesh import ComputeContext
 
     distributed.initialize()
+    mesh_conf = (variant or {}).get("meshConf") or {}
     mesh_shape = None
-    if getattr(args, "mesh_shape", None):
-        mesh_shape = tuple(int(x) for x in args.mesh_shape.split(","))
+    raw_shape = getattr(args, "mesh_shape", None) or mesh_conf.get("shape")
+    if raw_shape:
+        try:
+            if isinstance(raw_shape, str):
+                mesh_shape = tuple(int(x) for x in raw_shape.split(","))
+            else:
+                mesh_shape = tuple(int(x) for x in raw_shape)
+        except (TypeError, ValueError):
+            raise SystemExit(
+                f"error: mesh shape {raw_shape!r} (--mesh-shape / "
+                "meshConf.shape) must be device counts like \"4,2\""
+            ) from None
     return ComputeContext.create(
-        batch=getattr(args, "batch", "") or "", mesh_shape=mesh_shape
+        batch=_variant_batch(args, variant), mesh_shape=mesh_shape
     )
 
 
@@ -302,9 +328,9 @@ def cmd_train(args) -> int:
     from predictionio_tpu.core.engine import WorkflowParams
     from predictionio_tpu.core.workflow import run_train
 
-    engine, params, engine_id, variant, _ = _resolve(args)
+    engine, params, engine_id, variant, variant_dict = _resolve(args)
     workflow = WorkflowParams(
-        batch=args.batch or "",
+        batch=_variant_batch(args, variant_dict),
         save_model=not args.no_save_model,
         skip_sanity_check=args.skip_sanity_check,
         stop_after_read=args.stop_after_read,
@@ -317,7 +343,7 @@ def cmd_train(args) -> int:
         engine_variant=variant,
         engine_factory=args.engine or "",
         workflow=workflow,
-        ctx=_mesh_ctx(args),
+        ctx=_mesh_ctx(args, variant_dict),
     )
     print(f"Training completed. Engine instance: {instance_id}")
     return 0
@@ -348,8 +374,15 @@ def cmd_deploy(args) -> int:
             file=sys.stderr,
         )
         return 1
+    if args.max_wait_ms < 0:
+        # negative puts every deadline in the past: 1-query batches
+        print(
+            f"error: --max-wait-ms must be >= 0, got {args.max_wait_ms}",
+            file=sys.stderr,
+        )
+        return 1
 
-    engine, params, engine_id, variant, _ = _resolve(args)
+    engine, params, engine_id, variant, variant_dict = _resolve(args)
     feedback_app_id = None
     if args.feedback:
         from predictionio_tpu.data.storage import get_storage
@@ -367,7 +400,7 @@ def cmd_deploy(args) -> int:
         params,
         engine_id=engine_id,
         engine_variant=variant,
-        ctx=_mesh_ctx(args),
+        ctx=_mesh_ctx(args, variant_dict),
         feedback=args.feedback,
         feedback_app_id=feedback_app_id,
         log_url=args.log_url or None,

@@ -78,6 +78,20 @@ def run_train(
     instance = instances.get(instance_id)
     ctx = ctx or ComputeContext.create(batch=workflow.batch or engine_id)
     try:
+        # record the compute topology on the run record (the reference
+        # stores sparkConf on EngineInstance, EngineInstances.scala:43-69);
+        # inside the try so a storage failure still marks the run FAILED
+        mesh = ctx.mesh
+        instance = dataclasses.replace(
+            instance,
+            mesh_conf={
+                "shape": ",".join(str(s) for s in mesh.devices.shape),
+                "axes": ",".join(mesh.axis_names),
+                "devices": str(mesh.devices.size),
+                "platform": mesh.devices.flat[0].platform,
+            },
+        )
+        instances.update(instance)
         # build algorithm instances once: the SAME objects train and (for
         # MANUAL persistence) save, so trained state is what gets saved
         algorithms = engine.make_algorithms(params)

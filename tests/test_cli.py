@@ -445,3 +445,50 @@ class TestDeployFlags:
             "deploy", "--variant", "nope.json", "--max-batch", "0"
         )
         assert code != 0 and "max-batch" in err
+
+    def test_variant_mesh_conf_used_and_recorded(
+        self, cli, memory_storage, tmp_path
+    ):
+        """engine.json meshConf (the reference's embedded sparkConf,
+        WorkflowUtils.extractSparkConf:308-327) selects the mesh when
+        no --mesh-shape flag is given; the topology lands on the
+        EngineInstance record."""
+        TestBuildTrainExportImport()._seed(cli, memory_storage)
+        variant = tmp_path / "engine.json"
+        variant.write_text(
+            json.dumps(
+                {
+                    "id": "clf-mesh",
+                    "engineFactory": "classification",
+                    "datasource": {"params": {"app_name": "clfapp"}},
+                    "meshConf": {"shape": "4,2", "batch": "from-variant"},
+                }
+            )
+        )
+        code, out, _ = cli("train", "--variant", str(variant))
+        assert code == 0 and "Training completed" in out
+        inst = memory_storage.get_meta_data_engine_instances().get_all()[-1]
+        assert inst.mesh_conf["shape"] == "4,2"
+        assert inst.mesh_conf["axes"] == "data,model"
+        assert inst.mesh_conf["devices"] == "8"
+        assert inst.batch == "from-variant"  # meshConf.batch recorded
+
+    def test_bad_mesh_shape_is_clean_cli_error(self, cli, tmp_path):
+        variant = tmp_path / "engine.json"
+        variant.write_text(
+            json.dumps(
+                {
+                    "id": "clf-bad",
+                    "engineFactory": "classification",
+                    "meshConf": {"shape": "data,model"},
+                }
+            )
+        )
+        with pytest.raises(SystemExit, match="mesh shape"):
+            cli("train", "--variant", str(variant))
+
+    def test_negative_max_wait_rejected(self, cli):
+        code, _out, err = cli(
+            "deploy", "--variant", "nope.json", "--max-wait-ms", "-5"
+        )
+        assert code != 0 and "max-wait-ms" in err
