@@ -785,6 +785,10 @@ def build_parser() -> argparse.ArgumentParser:
     sub = parser.add_subparsers(dest="command", required=True)
 
     sub.add_parser("version").set_defaults(func=cmd_version)
+    # reference Console has an explicit `help` verb besides -h
+    sub.add_parser("help").set_defaults(
+        func=lambda _args: (parser.print_help(), 0)[1]
+    )
     sub.add_parser("status").set_defaults(func=cmd_status)
 
     p = sub.add_parser("app")
