@@ -37,6 +37,12 @@ import subprocess
 import sys
 import time
 
+#: optional daemons (verb == service name, --ip/--port only): default port
+OPTIONAL_SERVICES: dict[str, int] = {
+    "minipg": 5432,
+    "storeserver": 7072,
+}
+
 #: name -> (CLI verb, default port, extra args)
 SERVICES: dict[str, tuple[str, int, tuple[str, ...]]] = {
     "eventserver": ("eventserver", 7070, ("--stats",)),
@@ -199,6 +205,7 @@ def start_all(
         names.insert(0, "storeserver")
     if with_minipg:
         names.insert(0, "minipg")
+    # optional services share their verb name and take only --ip/--port
     for name in names:
         state, pid = service_status(name)
         if state == "running":
@@ -210,12 +217,9 @@ def start_all(
         if state == "stale-pidfile":
             out(f"{name}: removing stale pidfile (pid {pid} is gone)")
             os.unlink(pidfile(name))
-        if name == "minipg":
-            port = ports.get(name, 5432)
-            argv = ["minipg", "--ip", ip, "--port", str(port)]
-        elif name == "storeserver":
-            port = ports.get(name, 7072)
-            argv = ["storeserver", "--ip", ip, "--port", str(port)]
+        if name in OPTIONAL_SERVICES:
+            port = ports.get(name, OPTIONAL_SERVICES[name])
+            argv = [name, "--ip", ip, "--port", str(port)]
         else:
             verb, default_port, extra = SERVICES[name]
             port = ports.get(name, default_port)
@@ -235,7 +239,7 @@ def start_all(
 
 
 def stop_all(out=print) -> int:
-    names = list(SERVICES) + ["minipg", "storeserver"]
+    names = list(SERVICES) + list(OPTIONAL_SERVICES)
     for name in names:
         out(f"{name}: {stop_daemon(name)}")
     return 0
@@ -244,10 +248,10 @@ def stop_all(out=print) -> int:
 def status_all(out=print) -> int:
     """One line per service; exit 0 iff everything is running."""
     all_up = True
-    names = list(SERVICES) + ["minipg", "storeserver"]
+    names = list(SERVICES) + list(OPTIONAL_SERVICES)
     for name in names:
         state, pid = service_status(name)
-        if state == "stopped" and name in ("minipg", "storeserver"):
+        if state == "stopped" and name in OPTIONAL_SERVICES:
             continue  # optional service: shown only when up or crashed
         suffix = f" (pid {pid})" if pid else ""
         out(f"{name}: {state}{suffix}")

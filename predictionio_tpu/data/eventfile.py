@@ -20,9 +20,10 @@ must not execute code.
 
 from __future__ import annotations
 
+import datetime as _dt
 import json
 import os
-from typing import Iterable, Iterator, Sequence
+from typing import Iterable, Iterator
 
 import numpy as np
 
@@ -103,8 +104,6 @@ def read_events_npz(path: str) -> Iterator[Event]:
                 "event_id",
             )
         }
-    import datetime as _dt
-
     for i in range(len(cols["event"])):
         yield Event(
             event=str(cols["event"][i]),

@@ -68,34 +68,10 @@ class StoreServer:
 
     def __init__(self, storage: Storage | None = None):
         self._storage = storage or get_storage()
-        self.router = Router()
-        r = self.router
-        r.route("GET", "/", self._status)
-        r.route("GET", "/meta/engine_manifests/<id>/<version>",
-                self._manifest_get)
-        r.route("PUT", "/meta/engine_manifests/<id>/<version>",
-                self._manifest_update)
-        r.route("DELETE", "/meta/engine_manifests/<id>/<version>",
-                self._manifest_delete)
-        for method, pattern, handler in (
-            ("POST", "/meta/<kind>", self._insert),
-            ("GET", "/meta/<kind>", self._list),
-            ("GET", "/meta/<kind>/<id>", self._get),
-            ("PUT", "/meta/<kind>/<id>", self._update),
-            ("DELETE", "/meta/<kind>/<id>", self._delete),
-        ):
-            r.route(method, pattern, handler)
-        r.route("PUT", "/models/<id>", self._model_put)
-        r.route("GET", "/models/<id>", self._model_get)
-        r.route("DELETE", "/models/<id>", self._model_delete)
-
-    # -- plumbing ---------------------------------------------------------
-
-    def _kind(self, request: Request):
-        """Resolve <kind> → (dao, to_json, from_json, id-parser)."""
-        kind = request.path_params["kind"]
         s = self._storage
-        table = {
+        #: <kind> -> (dao getter, to_json, from_json, id parser);
+        #: getters defer DAO construction to request time
+        self._kinds = {
             "apps": (
                 s.get_meta_data_apps, app_to_json, app_from_json, int
             ),
@@ -130,9 +106,35 @@ class StoreServer:
                 str,
             ),
         }
-        if kind not in table:
+        self.router = Router()
+        r = self.router
+        r.route("GET", "/", self._status)
+        r.route("GET", "/meta/engine_manifests/<id>/<version>",
+                self._manifest_get)
+        r.route("PUT", "/meta/engine_manifests/<id>/<version>",
+                self._manifest_update)
+        r.route("DELETE", "/meta/engine_manifests/<id>/<version>",
+                self._manifest_delete)
+        for method, pattern, handler in (
+            ("POST", "/meta/<kind>", self._insert),
+            ("GET", "/meta/<kind>", self._list),
+            ("GET", "/meta/<kind>/<id>", self._get),
+            ("PUT", "/meta/<kind>/<id>", self._update),
+            ("DELETE", "/meta/<kind>/<id>", self._delete),
+        ):
+            r.route(method, pattern, handler)
+        r.route("PUT", "/models/<id>", self._model_put)
+        r.route("GET", "/models/<id>", self._model_get)
+        r.route("DELETE", "/models/<id>", self._model_delete)
+
+    # -- plumbing ---------------------------------------------------------
+
+    def _kind(self, request: Request):
+        """Resolve <kind> → (dao, to_json, from_json, id-parser)."""
+        kind = request.path_params["kind"]
+        if kind not in self._kinds:
             raise HTTPError(404, f"unknown metadata kind {kind!r}")
-        getter, to_json, from_json, id_parse = table[kind]
+        getter, to_json, from_json, id_parse = self._kinds[kind]
         try:
             dao = getter()
         except StorageError as e:
