@@ -194,6 +194,7 @@ def start_all(
     ports: dict[str, int] | None = None,
     with_minipg: bool = False,
     with_storeserver: bool = False,
+    storeserver_access_key: str = "",
     out=print,
 ) -> int:
     """Bring up every service; refuses to double-start (the reference
@@ -217,14 +218,22 @@ def start_all(
         if state == "stale-pidfile":
             out(f"{name}: removing stale pidfile (pid {pid} is gone)")
             os.unlink(pidfile(name))
+        env = None
         if name in OPTIONAL_SERVICES:
             port = ports.get(name, OPTIONAL_SERVICES[name])
             argv = [name, "--ip", ip, "--port", str(port)]
+            if name == "storeserver" and storeserver_access_key:
+                # via the environment, not argv — a secret on the
+                # command line is readable by every local user in ps
+                env = {
+                    "PIO_SERVER_ACCESS_KEY": storeserver_access_key,
+                    "PIO_SERVER_KEY_AUTH_ENFORCED": "true",
+                }
         else:
             verb, default_port, extra = SERVICES[name]
             port = ports.get(name, default_port)
             argv = [verb, "--ip", ip, "--port", str(port), *extra]
-        pid = spawn_daemon(name, argv)
+        pid = spawn_daemon(name, argv, env=env)
         if wait_port(ip, port, pid=pid):
             out(f"{name}: started (pid {pid}, port {port}, "
                 f"log {logfile(name)})")

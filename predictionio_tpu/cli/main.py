@@ -482,6 +482,17 @@ def cmd_storeserver(args) -> int:
         config = dataclasses.replace(
             config, key_auth_enforced=True, access_key=args.access_key
         )
+    if not config.key_auth_enforced and args.ip not in (
+        "127.0.0.1", "localhost", "::1"
+    ):
+        print(
+            "WARNING: store server is starting WITHOUT an access key on "
+            f"non-loopback bind {args.ip} — it serves all event-server "
+            "credentials and model blobs. Pass --access-key, or set "
+            "PIO_SERVER_ACCESS_KEY together with "
+            "PIO_SERVER_KEY_AUTH_ENFORCED=true.",
+            file=sys.stderr,
+        )
     http = create_store_server(
         host=args.ip, port=args.port, server_config=config
     )
@@ -734,8 +745,11 @@ def cmd_start_all(args) -> int:
         # an explicit port is an explicit ask for the optional service
         with_minipg=args.with_minipg or bool(args.minipg_port),
         with_storeserver=(
-            args.with_storeserver or bool(args.storeserver_port)
+            args.with_storeserver
+            or bool(args.storeserver_port)
+            or bool(args.storeserver_access_key)
         ),
+        storeserver_access_key=args.storeserver_access_key,
     )
 
 
@@ -993,6 +1007,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--minipg-port", type=int, default=0)
     p.add_argument("--with-storeserver", action="store_true")
     p.add_argument("--storeserver-port", type=int, default=0)
+    p.add_argument(
+        "--storeserver-access-key", dest="storeserver_access_key",
+        default="",
+        help="require this key on every store-server request",
+    )
     p.set_defaults(func=cmd_start_all)
 
     sub.add_parser("stop-all").set_defaults(func=cmd_stop_all)

@@ -309,16 +309,21 @@ class HTTPStoreClient:
                 # Retry exactly once, and only when the server cannot
                 # have acted on the request: a send-phase failure on a
                 # reused socket (the stale keep-alive race — the request
-                # never arrived whole), or RemoteDisconnected on a
-                # reused socket (the server closed the idle connection
-                # without emitting any response bytes). Anything after a
-                # completed send on a fresh connection — a read timeout,
-                # a mid-response reset — is ambiguous: a non-idempotent
-                # insert may already be committed, so surface the error
-                # instead of silently duplicating it.
+                # never arrived whole, any method), or
+                # RemoteDisconnected on a reused socket for an
+                # *idempotent* method. After a completed send,
+                # RemoteDisconnected is ambiguous — the server may have
+                # processed the request and died before emitting any
+                # response bytes, which for a POST insert would
+                # duplicate the row — so non-idempotent methods surface
+                # the error instead.
+                idempotent = method in ("GET", "HEAD", "PUT", "DELETE")
                 stale = reused and (
                     not sent
-                    or isinstance(e, http.client.RemoteDisconnected)
+                    or (
+                        idempotent
+                        and isinstance(e, http.client.RemoteDisconnected)
+                    )
                 )
                 if attempt == 0 and stale:
                     continue

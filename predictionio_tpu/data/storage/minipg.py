@@ -180,14 +180,28 @@ class _Handler(socketserver.BaseRequestHandler):
             buf += chunk
         return buf
 
+    #: ceiling on one frontend message (64 MiB is far beyond any batch
+    #: the clients send); out-of-range lengths are a corrupt stream
+    _MAX_FRAME = 64 << 20
+
+    def _check_length(self, length: int, minimum: int = 4) -> int:
+        if not minimum <= length <= self._MAX_FRAME:
+            raise ConnectionError(
+                f"protocol violation: frame length {length} out of range"
+            )
+        return length
+
     def _read_startup(self) -> bytes:
         (length,) = struct.unpack("!I", self._read_exact(4))
-        return self._read_exact(length - 4)
+        # a startup packet is at least length (4) + protocol code (4)
+        return self._read_exact(self._check_length(length, minimum=8) - 4)
 
     def _read_msg(self) -> tuple[bytes, bytes]:
         header = self._read_exact(5)
         (length,) = struct.unpack("!I", header[1:5])
-        return header[:1], self._read_exact(length - 4)
+        return header[:1], self._read_exact(
+            self._check_length(length) - 4
+        )
 
     def _send(self, type_byte: bytes, payload: bytes = b"") -> None:
         # buffered: one syscall per protocol turn (flushed before every

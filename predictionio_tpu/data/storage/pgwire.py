@@ -203,6 +203,11 @@ class _Scram:
 # -- protocol plumbing ------------------------------------------------------
 
 
+#: sanity ceiling on a single backend message (1 GiB); a frame length
+#: outside [4, MAX] is a corrupt or hostile stream, not a big result
+_MAX_FRAME = 1 << 30
+
+
 class _Wire:
     """Framed reads/writes of protocol v3 messages."""
 
@@ -230,6 +235,12 @@ class _Wire:
     def recv(self) -> tuple[bytes, bytes]:
         header = self._read_exact(5)
         (length,) = struct.unpack("!I", header[1:5])
+        # the length field counts itself (>=4); reject nonsense before
+        # it turns into a negative read or an unbounded buffer
+        if not 4 <= length <= _MAX_FRAME:
+            raise OperationalError(
+                f"protocol violation: frame length {length} out of range"
+            )
         return header[:1], self._read_exact(length - 4)
 
 

@@ -7,6 +7,7 @@ from __future__ import annotations
 import json
 import os
 import subprocess
+import urllib.error
 import urllib.request
 
 import pytest
@@ -150,3 +151,39 @@ class TestStoreServerDaemon:
             outcome = daemon.stop_daemon("storeserver")
         assert outcome.startswith("stopped")
         assert daemon.service_status("storeserver") == ("stopped", None)
+
+    def test_start_all_storeserver_access_key(self, piodir, monkeypatch):
+        """`start-all --storeserver-access-key K` must (a) imply the
+        storeserver, (b) deliver the key via the environment — never
+        argv, where any local user could read it in ps — and (c) yield
+        a server that actually enforces the key."""
+        monkeypatch.setattr(daemon, "SERVICES", {})
+        port = 17904
+        lines = []
+        rc = daemon.start_all(
+            ip="127.0.0.1",
+            ports={"storeserver": port},
+            with_storeserver=True,
+            storeserver_access_key="sekrit",
+            out=lines.append,
+        )
+        try:
+            assert rc == 0, "\n".join(lines)
+            pid = daemon.read_pid("storeserver")
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                assert b"sekrit" not in f.read()
+            # unauthenticated requests are rejected...
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/meta/access_keys",
+                    timeout=10,
+                )
+            assert err.value.code == 401
+            # ...and the key opens the door
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/meta/access_keys",
+                headers={"Authorization": "Bearer sekrit"},
+            )
+            assert urllib.request.urlopen(req, timeout=10).status == 200
+        finally:
+            daemon.stop_daemon("storeserver")

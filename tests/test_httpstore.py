@@ -251,6 +251,59 @@ class TestProtocol:
         finally:
             srv.close()
 
+    def test_no_retry_for_post_on_reused_connection(self):
+        """RemoteDisconnected after a completed POST send on a reused
+        keep-alive socket is ambiguous (the server may have committed
+        the insert before dying) — it must surface, not re-send.
+        Idempotent GETs on the same path do retry
+        (test_keepalive_survives_server_connection_close)."""
+        import socket
+        import threading
+
+        from predictionio_tpu.data.storage.httpstore import HTTPStoreClient
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(2)
+        port = srv.getsockname()[1]
+        requests_seen = []
+
+        def _serve():
+            while True:
+                try:
+                    conn, _ = srv.accept()
+                except OSError:
+                    return
+                conn.settimeout(5)
+                try:
+                    # request 1: answer and keep the connection alive
+                    requests_seen.append(conn.recv(65536))
+                    conn.sendall(
+                        b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n"
+                        b"Content-Type: application/json\r\n\r\n[]"
+                    )
+                    # request 2: read it fully, then hang up without
+                    # any response bytes (server died mid-processing)
+                    requests_seen.append(conn.recv(65536))
+                finally:
+                    conn.close()
+
+        t = threading.Thread(target=_serve, daemon=True)
+        t.start()
+        try:
+            raw = HTTPStoreClient(
+                {"URL": f"http://127.0.0.1:{port}", "TIMEOUT": 5}
+            )
+            status, _ = raw.request("GET", "/meta/apps")
+            assert status == 200
+            with pytest.raises(StorageError, match="unreachable"):
+                raw.request("POST", "/meta/apps", json_body={"x": 1})
+            # the POST arrived exactly once — no duplicate insert
+            posts = [r for r in requests_seen if r.startswith(b"POST")]
+            assert len(posts) == 1
+        finally:
+            srv.close()
+
 
 class TestConfigValidation:
     def test_missing_url_raises(self):

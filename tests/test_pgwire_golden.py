@@ -1,0 +1,694 @@
+"""Spec-derived golden frames for the PostgreSQL wire protocol v3.
+
+pgwire (the client) and minipg (the test server) were written by the
+same author — a shared misunderstanding of the protocol would pass every
+contract test and still fail against real PostgreSQL. This suite breaks
+the cycle: every byte string below is hand-assembled from the protocol
+specification (PostgreSQL docs "Message Formats" / "Message Flow",
+protocol version 3.0; SCRAM from RFC 5802/7677), NOT captured from
+either implementation. Each half is then asserted against the golden
+bytes independently:
+
+* pgwire must EMIT the golden frontend frames (StartupMessage,
+  PasswordMessage, MD5 response, SASLInitialResponse, Query, Terminate)
+  and correctly DECODE golden backend frames (auth requests,
+  RowDescription, DataRow incl. NULL, CommandComplete, ErrorResponse
+  field layout, ReadyForQuery).
+* minipg must ACCEPT the golden frontend frames and EMIT backend frames
+  matching the golden layouts — read back with a test-local frame
+  reader, never with pgwire.
+* the SCRAM-SHA-256 math is pinned to the RFC 7677 §3 example vector on
+  the client side, and to a test-local RFC implementation driving a live
+  minipg socket on the server side.
+* both decoders survive truncated / oversized / garbage frames
+  (length-field fuzzing) instead of hanging or dying.
+
+Reference analogue: the JDBC specs ran against live PostgreSQL in CI
+(`/root/reference/.travis.yml:30-55`); this is the sandbox equivalent of
+that external ground truth.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import socket
+import struct
+import threading
+
+import pytest
+
+from predictionio_tpu.data.storage import pgwire
+from predictionio_tpu.data.storage.minipg import MiniPGServer
+
+# ---------------------------------------------------------------------------
+# Golden frames, hand-assembled from the spec ("Message Formats").
+# Frontend (client → server):
+
+
+def frame(type_byte: bytes, payload: bytes) -> bytes:
+    """Spec framing: 1-byte type, Int32 length INCLUDING itself, payload."""
+    return type_byte + struct.pack("!I", len(payload) + 4) + payload
+
+
+# StartupMessage: Int32 length, Int32 196608 (protocol 3.0), then
+# parameter name/value pairs as NUL-terminated strings, then a final NUL.
+GOLDEN_STARTUP = (
+    struct.pack("!I", 4 + 4 + len(
+        b"user\x00alice\x00database\x00db1\x00client_encoding\x00UTF8\x00\x00"
+    ))
+    + struct.pack("!I", 196608)
+    + b"user\x00alice\x00database\x00db1\x00client_encoding\x00UTF8\x00\x00"
+)
+
+# PasswordMessage: 'p', Int32 length, password as NUL-terminated string.
+GOLDEN_PASSWORD_CLEARTEXT = frame(b"p", b"s3cret\x00")
+
+# MD5 response: "md5" + hex(md5(hex(md5(password+user)) + salt)), from
+# the AuthenticationMD5Password doc: concat('md5', md5(concat(
+# md5(concat(password, username)), random-salt))).
+MD5_SALT = b"\x01\x02\x03\x04"
+_md5_inner = hashlib.md5(b"s3cret" + b"alice").hexdigest()
+GOLDEN_PASSWORD_MD5 = frame(
+    b"p",
+    b"md5"
+    + hashlib.md5(_md5_inner.encode() + MD5_SALT).hexdigest().encode()
+    + b"\x00",
+)
+
+# Query: 'Q', Int32 length, SQL as NUL-terminated string.
+GOLDEN_QUERY = frame(b"Q", b"SELECT 1\x00")
+
+# Terminate: 'X', Int32 4, no payload.
+GOLDEN_TERMINATE = b"X\x00\x00\x00\x04"
+
+# Backend (server → client):
+AUTH_OK = frame(b"R", struct.pack("!I", 0))
+AUTH_CLEARTEXT = frame(b"R", struct.pack("!I", 3))
+AUTH_MD5 = frame(b"R", struct.pack("!I", 5) + MD5_SALT)
+AUTH_SASL_SCRAM = frame(
+    b"R", struct.pack("!I", 10) + b"SCRAM-SHA-256\x00\x00"
+)
+PARAM_STATUS = frame(b"S", b"server_version\x0013.0\x00")
+BACKEND_KEY = frame(b"K", struct.pack("!II", 1234, 5678))
+READY_IDLE = frame(b"Z", b"I")
+
+# RowDescription: Int16 field count, then per field: name (NUL-terminated),
+# Int32 table OID, Int16 attnum, Int32 type OID, Int16 typlen,
+# Int32 atttypmod, Int16 format code (0 = text).
+ROWDESC_ID_NAME = frame(
+    b"T",
+    struct.pack("!H", 2)
+    + b"id\x00" + struct.pack("!IHIhih", 0, 0, 20, 8, -1, 0)
+    + b"name\x00" + struct.pack("!IHIhih", 0, 0, 25, -1, -1, 0),
+)
+
+# DataRow: Int16 column count, then per column Int32 value length
+# (-1 = NULL, no bytes follow) + bytes.
+DATAROW_1_OK = frame(
+    b"D",
+    struct.pack("!H", 2)
+    + struct.pack("!i", 1) + b"1"
+    + struct.pack("!i", 2) + b"ok",
+)
+DATAROW_NULL_OK = frame(
+    b"D",
+    struct.pack("!H", 2)
+    + struct.pack("!i", -1)
+    + struct.pack("!i", 2) + b"ok",
+)
+COMPLETE_SELECT2 = frame(b"C", b"SELECT 2\x00")
+
+# ErrorResponse: one-letter field codes, each value NUL-terminated, then
+# a final NUL. Field codes from the "Error and Notice Message Fields"
+# appendix: S severity, V nonlocalized severity, C SQLSTATE, M message,
+# D detail, H hint, P position, F file, L line, R routine.
+ERROR_UNDEFINED_TABLE = frame(
+    b"E",
+    b"SERROR\x00"
+    b"VERROR\x00"
+    b"C42P01\x00"
+    b'Mrelation "nope" does not exist\x00'
+    b"Dthe table was never created\x00"
+    b"Hcreate it first\x00"
+    b"P15\x00"
+    b"Fparse_relation.c\x00"
+    b"L1384\x00"
+    b"RparserOpenTable\x00"
+    b"\x00",
+)
+
+# RFC 7677 §3 SCRAM-SHA-256 example exchange (user "user", password
+# "pencil", client nonce "rOprNGfwEbeRWgbNEkqO").
+RFC7677_CLIENT_NONCE = "rOprNGfwEbeRWgbNEkqO"
+RFC7677_SERVER_FIRST = (
+    b"r=rOprNGfwEbeRWgbNEkqO%hvYDpWUa2RaTCAfuxFIlj)hNlF$k0,"
+    b"s=W22ZaJ0SNY7soEsUEjb6gQ==,i=4096"
+)
+RFC7677_CLIENT_FINAL = (
+    b"c=biws,r=rOprNGfwEbeRWgbNEkqO%hvYDpWUa2RaTCAfuxFIlj)hNlF$k0,"
+    b"p=dHzbZapWIk4jUhN+Ute9ytag9zjfMHgsqmmiz7AndVQ="
+)
+RFC7677_SERVER_FINAL = b"v=6rriTRBi23WpRR/wtup+mMhUZUn/dB5nLTJRsjl95G4="
+
+
+# ---------------------------------------------------------------------------
+# Test-local plumbing (independent of BOTH implementations).
+
+
+class ScriptedServer:
+    """A socket peer that follows a fixed script: ('recv', n) records
+    exactly n bytes from the client; ('send', b) writes raw bytes.
+    No protocol knowledge — the assertions compare recorded bytes to the
+    goldens."""
+
+    def __init__(self, script):
+        self.script = script
+        self.received: list[bytes] = []
+        self.error: BaseException | None = None
+        self._srv = socket.socket()
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(1)
+        self.port = self._srv.getsockname()[1]
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        try:
+            conn, _ = self._srv.accept()
+            conn.settimeout(10)
+            with conn:
+                for op, arg in self.script:
+                    if op == "recv":
+                        buf = b""
+                        while len(buf) < arg:
+                            chunk = conn.recv(arg - len(buf))
+                            if not chunk:
+                                raise ConnectionError("client went away")
+                            buf += chunk
+                        self.received.append(buf)
+                    else:
+                        conn.sendall(arg)
+        except BaseException as exc:  # surfaced by join()
+            self.error = exc
+
+    def join(self):
+        self._thread.join(timeout=10)
+        self._srv.close()
+        if self.error is not None:
+            raise self.error
+        return self.received
+
+
+def read_frame(sock: socket.socket) -> tuple[bytes, bytes]:
+    """Test-local backend-frame reader (NOT pgwire's)."""
+    header = b""
+    while len(header) < 5:
+        chunk = sock.recv(5 - len(header))
+        if not chunk:
+            raise ConnectionError("server went away")
+        header += chunk
+    (length,) = struct.unpack("!I", header[1:5])
+    payload = b""
+    while len(payload) < length - 4:
+        chunk = sock.recv(length - 4 - len(payload))
+        if not chunk:
+            raise ConnectionError("server went away")
+        payload += chunk
+    return header[:1], payload
+
+
+def read_until_ready(sock) -> list[tuple[bytes, bytes]]:
+    out = []
+    while True:
+        mtype, payload = read_frame(sock)
+        out.append((mtype, payload))
+        if mtype == b"Z":
+            return out
+
+
+def parse_error_fields(payload: bytes) -> dict[bytes, bytes]:
+    fields = {}
+    for part in payload.split(b"\x00"):
+        if part:
+            fields[part[:1]] = part[1:]
+    return fields
+
+
+# ---------------------------------------------------------------------------
+# pgwire (client) vs the goldens.
+
+
+class TestPgwireEmitsGoldenFrames:
+    def test_startup_cleartext_and_terminate(self):
+        server = ScriptedServer([
+            ("recv", len(GOLDEN_STARTUP)),
+            ("send", AUTH_CLEARTEXT),
+            ("recv", len(GOLDEN_PASSWORD_CLEARTEXT)),
+            ("send", AUTH_OK + PARAM_STATUS + BACKEND_KEY + READY_IDLE),
+            ("recv", len(GOLDEN_TERMINATE)),
+        ])
+        conn = pgwire.connect(
+            host="127.0.0.1", port=server.port,
+            database="db1", user="alice", password="s3cret",
+        )
+        conn.close()
+        startup, password, terminate = server.join()
+        assert startup == GOLDEN_STARTUP
+        assert password == GOLDEN_PASSWORD_CLEARTEXT
+        assert terminate == GOLDEN_TERMINATE
+
+    def test_md5_response(self):
+        server = ScriptedServer([
+            ("recv", len(GOLDEN_STARTUP)),
+            ("send", AUTH_MD5),
+            ("recv", len(GOLDEN_PASSWORD_MD5)),
+            ("send", AUTH_OK + READY_IDLE),
+        ])
+        conn = pgwire.connect(
+            host="127.0.0.1", port=server.port,
+            database="db1", user="alice", password="s3cret",
+        )
+        conn.close()
+        assert server.join()[1] == GOLDEN_PASSWORD_MD5
+
+    def test_query_frame(self):
+        server = ScriptedServer([
+            ("recv", len(GOLDEN_STARTUP)),
+            ("send", AUTH_OK + READY_IDLE),
+            ("recv", len(GOLDEN_QUERY)),
+            ("send", COMPLETE_SELECT2 + READY_IDLE),
+        ])
+        conn = pgwire.connect(
+            host="127.0.0.1", port=server.port,
+            database="db1", user="alice", password="s3cret",
+        )
+        conn._query("SELECT 1")
+        conn.close()
+        assert server.join()[1] == GOLDEN_QUERY
+
+    def test_sasl_initial_response_format(self, monkeypatch):
+        """SASLInitialResponse: 'p', mechanism name NUL-terminated,
+        Int32 data length, then the SCRAM client-first message. Nonce
+        pinned to the RFC 7677 example via urandom."""
+        monkeypatch.setattr(
+            pgwire.os, "urandom",
+            lambda n: base64.b64decode(RFC7677_CLIENT_NONCE),
+        )
+        client_first = f"n,,n=,r={RFC7677_CLIENT_NONCE}".encode()
+        golden_sasl_initial = frame(
+            b"p",
+            b"SCRAM-SHA-256\x00"
+            + struct.pack("!I", len(client_first))
+            + client_first,
+        )
+        server = ScriptedServer([
+            ("recv", len(GOLDEN_STARTUP)),
+            ("send", AUTH_SASL_SCRAM),
+            ("recv", len(golden_sasl_initial)),
+        ])
+        with pytest.raises(pgwire.OperationalError):
+            # server hangs up after the SASL initial; connect fails, but
+            # the frame we care about was already sent
+            pgwire.connect(
+                host="127.0.0.1", port=server.port,
+                database="db1", user="alice", password="pencil",
+            )
+        assert server.join()[1] == golden_sasl_initial
+
+
+class TestPgwireDecodesGoldenFrames:
+    def _connect_and_query(self, backend_bytes: bytes):
+        server = ScriptedServer([
+            ("recv", len(GOLDEN_STARTUP)),
+            ("send", AUTH_OK + READY_IDLE),
+            ("recv", len(GOLDEN_QUERY)),
+            ("send", backend_bytes),
+        ])
+        conn = pgwire.connect(
+            host="127.0.0.1", port=server.port,
+            database="db1", user="alice", password="s3cret",
+        )
+        try:
+            return conn._query("SELECT 1")
+        finally:
+            conn.close()
+            server.join()
+
+    def test_rowdescription_datarow_null_and_tag(self):
+        columns, rows, rowcount = self._connect_and_query(
+            ROWDESC_ID_NAME + DATAROW_1_OK + DATAROW_NULL_OK
+            + COMPLETE_SELECT2 + READY_IDLE
+        )
+        assert columns == [("id", 20), ("name", 25)]
+        # oid 20 = int8 → int; oid 25 = text → str; -1 length → None
+        assert rows == [(1, "ok"), (None, "ok")]
+        assert rowcount == 2
+
+    def test_error_response_fields(self):
+        with pytest.raises(pgwire.ProgrammingError) as err:
+            self._connect_and_query(ERROR_UNDEFINED_TABLE + READY_IDLE)
+        assert err.value.sqlstate == "42P01"
+        assert 'relation "nope" does not exist' in str(err.value)
+
+    def test_auth_error_at_startup(self):
+        auth_failed = frame(
+            b"E",
+            b"SFATAL\x00C28P01\x00"
+            b'Mpassword authentication failed for user "alice"\x00\x00',
+        )
+        server = ScriptedServer([
+            ("recv", len(GOLDEN_STARTUP)),
+            ("send", auth_failed),
+        ])
+        with pytest.raises(pgwire.OperationalError) as err:
+            pgwire.connect(
+                host="127.0.0.1", port=server.port,
+                database="db1", user="alice", password="s3cret",
+            )
+        server.join()
+        assert err.value.sqlstate == "28P01"
+
+
+class TestScramRfc7677Vector:
+    """Pin the SCRAM-SHA-256 math to the RFC 7677 §3 example, byte for
+    byte. pgwire sends an empty SCRAM username (the server takes the
+    user from the startup packet, as postgres does), so the vector's
+    gs2/bare strings are injected to reproduce the exact exchange."""
+
+    def test_client_final_matches_rfc(self):
+        s = pgwire._Scram.__new__(pgwire._Scram)
+        s._password = b"pencil"
+        s._nonce = RFC7677_CLIENT_NONCE
+        s._client_first_bare = f"n=user,r={RFC7677_CLIENT_NONCE}"
+        assert s.client_final(RFC7677_SERVER_FIRST) == RFC7677_CLIENT_FINAL
+        # and the server-final signature verifies
+        s.verify_server_final(RFC7677_SERVER_FINAL)
+
+    def test_tampered_server_signature_rejected(self):
+        s = pgwire._Scram.__new__(pgwire._Scram)
+        s._password = b"pencil"
+        s._nonce = RFC7677_CLIENT_NONCE
+        s._client_first_bare = f"n=user,r={RFC7677_CLIENT_NONCE}"
+        s.client_final(RFC7677_SERVER_FIRST)
+        with pytest.raises(pgwire.OperationalError):
+            s.verify_server_final(b"v=AAAA" + RFC7677_SERVER_FINAL[6:])
+
+    def test_server_nonce_must_extend_client_nonce(self):
+        s = pgwire._Scram.__new__(pgwire._Scram)
+        s._password = b"pencil"
+        s._nonce = RFC7677_CLIENT_NONCE
+        s._client_first_bare = f"n=,r={RFC7677_CLIENT_NONCE}"
+        with pytest.raises(pgwire.OperationalError):
+            s.client_final(b"r=EVILNONCE,s=V1YyWg==,i=4096")
+
+
+# ---------------------------------------------------------------------------
+# minipg (server) vs the goldens, via raw sockets + the test-local reader.
+
+
+class TestMinipgSpeaksGoldenFrames:
+    def test_trust_auth_golden_startup(self):
+        with MiniPGServer() as server:
+            with socket.create_connection(("127.0.0.1", server.port)) as s:
+                s.sendall(GOLDEN_STARTUP)
+                frames = read_until_ready(s)
+        # first frame: AuthenticationOk, exact golden bytes
+        mtype, payload = frames[0]
+        assert frame(mtype, payload) == AUTH_OK
+        # last frame: ReadyForQuery with a one-byte idle status
+        mtype, payload = frames[-1]
+        assert frame(mtype, payload) == READY_IDLE
+        # in between: ParameterStatus frames are two NUL-terminated
+        # strings; BackendKeyData is exactly 8 payload bytes
+        kinds = [m for m, _ in frames]
+        assert b"S" in kinds and b"K" in kinds
+        for m, p in frames[1:-1]:
+            if m == b"S":
+                assert p.endswith(b"\x00") and p.count(b"\x00") == 2
+            elif m == b"K":
+                assert len(p) == 8
+
+    def test_simple_query_golden_layouts(self):
+        with MiniPGServer() as server:
+            with socket.create_connection(("127.0.0.1", server.port)) as s:
+                s.sendall(GOLDEN_STARTUP)
+                read_until_ready(s)
+                s.sendall(frame(b"Q", b"SELECT 1 AS one\x00"))
+                frames = read_until_ready(s)
+        by_type = dict(frames)
+        # RowDescription: 1 field named "one", 18 fixed bytes after the
+        # name — Int32 table OID, Int16 attnum, Int32 type OID,
+        # Int16 typlen, Int32 atttypmod, Int16 format (0 = text)
+        desc = by_type[b"T"]
+        (nfields,) = struct.unpack("!H", desc[:2])
+        assert nfields == 1
+        name_end = desc.index(b"\x00", 2)
+        assert desc[2:name_end] == b"one"
+        fixed = desc[name_end + 1:]
+        assert len(fixed) == 18
+        _table, _attnum, type_oid, _typlen, _mod, fmt = struct.unpack(
+            "!IHIhih", fixed
+        )
+        assert type_oid == 20  # int8: sqlite integers are 64-bit
+        assert fmt == 0
+        # DataRow: Int16 count, Int32 length, then the text value
+        row = by_type[b"D"]
+        assert row == struct.pack("!H", 1) + struct.pack("!i", 1) + b"1"
+        # CommandComplete: "SELECT <n>" tag, NUL-terminated
+        assert by_type[b"C"] == b"SELECT 1\x00"
+        assert frames[-1] == (b"Z", b"I")
+
+    def test_null_encoded_as_minus_one(self):
+        with MiniPGServer() as server:
+            with socket.create_connection(("127.0.0.1", server.port)) as s:
+                s.sendall(GOLDEN_STARTUP)
+                read_until_ready(s)
+                s.sendall(frame(b"Q", b"SELECT NULL AS n\x00"))
+                frames = read_until_ready(s)
+        row = dict(frames)[b"D"]
+        assert row == struct.pack("!H", 1) + struct.pack("!i", -1)
+
+    def test_error_response_golden_fields(self):
+        with MiniPGServer() as server:
+            with socket.create_connection(("127.0.0.1", server.port)) as s:
+                s.sendall(GOLDEN_STARTUP)
+                read_until_ready(s)
+                s.sendall(frame(b"Q", b"SELECT * FROM nope\x00"))
+                frames = read_until_ready(s)
+        mtype, payload = frames[0]
+        assert mtype == b"E"
+        # spec field layout: code byte + NUL-terminated value, final NUL
+        assert payload.endswith(b"\x00\x00")
+        fields = parse_error_fields(payload)
+        assert fields[b"S"] == b"ERROR"
+        assert fields[b"C"] == b"42P01"  # undefined_table
+        assert b"M" in fields
+        assert frames[-1] == (b"Z", b"I")  # session still usable
+
+    def test_md5_auth_accepts_golden_response(self):
+        with MiniPGServer(password="s3cret", auth="md5") as server:
+            with socket.create_connection(("127.0.0.1", server.port)) as s:
+                s.sendall(GOLDEN_STARTUP)
+                mtype, payload = read_frame(s)
+                assert mtype == b"R"
+                (code,) = struct.unpack("!I", payload[:4])
+                assert code == 5 and len(payload) == 8
+                salt = payload[4:]
+                # golden response computed from the doc formula with the
+                # startup user ("alice"), never from pgwire
+                inner = hashlib.md5(b"s3cret" + b"alice").hexdigest()
+                digest = hashlib.md5(inner.encode() + salt).hexdigest()
+                s.sendall(frame(b"p", b"md5" + digest.encode() + b"\x00"))
+                frames = read_until_ready(s)
+        assert frame(*frames[0]) == AUTH_OK
+
+    def test_md5_auth_rejects_wrong_password(self):
+        with MiniPGServer(password="s3cret", auth="md5") as server:
+            with socket.create_connection(("127.0.0.1", server.port)) as s:
+                s.sendall(GOLDEN_STARTUP)
+                _mtype, payload = read_frame(s)
+                salt = payload[4:]
+                inner = hashlib.md5(b"wrong" + b"alice").hexdigest()
+                digest = hashlib.md5(inner.encode() + salt).hexdigest()
+                s.sendall(frame(b"p", b"md5" + digest.encode() + b"\x00"))
+                mtype, payload = read_frame(s)
+        assert mtype == b"E"
+        assert parse_error_fields(payload)[b"C"] == b"28P01"
+
+    def test_scram_against_test_local_rfc_implementation(self):
+        """Authenticate to minipg with SCRAM computed here from the RFC
+        5802 formulas (Hi = PBKDF2-HMAC-SHA-256; ClientKey = HMAC(salted,
+        'Client Key'); proof = ClientKey XOR HMAC(H(ClientKey), auth));
+        verify its ServerSignature the same way. pgwire is not involved."""
+        password = b"pio-secret"
+        with MiniPGServer(password=password.decode()) as server:
+            with socket.create_connection(("127.0.0.1", server.port)) as s:
+                s.sendall(GOLDEN_STARTUP)
+                mtype, payload = read_frame(s)
+                assert mtype == b"R"
+                (code,) = struct.unpack("!I", payload[:4])
+                assert code == 10
+                mechs = payload[4:].split(b"\x00")
+                assert b"SCRAM-SHA-256" in mechs
+                assert payload.endswith(b"\x00\x00")  # list is NUL-terminated
+                bare = "n=,r=testnonce0123456789"
+                client_first = ("n,," + bare).encode()
+                s.sendall(frame(
+                    b"p",
+                    b"SCRAM-SHA-256\x00"
+                    + struct.pack("!I", len(client_first)) + client_first,
+                ))
+                mtype, payload = read_frame(s)
+                assert mtype == b"R"
+                (code,) = struct.unpack("!I", payload[:4])
+                assert code == 11  # SASLContinue
+                server_first = payload[4:].decode("ascii")
+                fields = dict(
+                    kv.split("=", 1) for kv in server_first.split(",")
+                )
+                assert fields["r"].startswith("testnonce0123456789")
+                salt = base64.b64decode(fields["s"])
+                iters = int(fields["i"])
+                salted = hashlib.pbkdf2_hmac(
+                    "sha256", password, salt, iters
+                )
+                client_key = hmac.digest(salted, b"Client Key", "sha256")
+                stored = hashlib.sha256(client_key).digest()
+                without_proof = f"c=biws,r={fields['r']}"
+                auth_msg = ",".join(
+                    (bare, server_first, without_proof)
+                ).encode()
+                proof = bytes(
+                    a ^ b for a, b in zip(
+                        client_key, hmac.digest(stored, auth_msg, "sha256")
+                    )
+                )
+                s.sendall(frame(b"p", (
+                    without_proof
+                    + ",p=" + base64.b64encode(proof).decode()
+                ).encode()))
+                mtype, payload = read_frame(s)
+                assert mtype == b"R"
+                (code,) = struct.unpack("!I", payload[:4])
+                assert code == 12  # SASLFinal carries v=ServerSignature
+                server_key = hmac.digest(salted, b"Server Key", "sha256")
+                want_v = base64.b64encode(
+                    hmac.digest(server_key, auth_msg, "sha256")
+                ).decode()
+                assert payload[4:].decode() == f"v={want_v}"
+                mtype, payload = read_frame(s)
+                assert frame(mtype, payload) == AUTH_OK
+
+    def test_scram_rejects_wrong_proof(self):
+        with MiniPGServer(password="right") as server:
+            with socket.create_connection(("127.0.0.1", server.port)) as s:
+                s.sendall(GOLDEN_STARTUP)
+                read_frame(s)  # SASL advertisement
+                bare = "n=,r=clientnonceXYZ"
+                client_first = ("n,," + bare).encode()
+                s.sendall(frame(
+                    b"p",
+                    b"SCRAM-SHA-256\x00"
+                    + struct.pack("!I", len(client_first)) + client_first,
+                ))
+                _mtype, payload = read_frame(s)
+                server_first = payload[4:].decode("ascii")
+                r = dict(
+                    kv.split("=", 1) for kv in server_first.split(",")
+                )["r"]
+                fake = base64.b64encode(b"\x00" * 32).decode()
+                s.sendall(frame(
+                    b"p", f"c=biws,r={r},p={fake}".encode()
+                ))
+                mtype, payload = read_frame(s)
+        assert mtype == b"E"
+        assert parse_error_fields(payload)[b"C"] == b"28P01"
+
+
+# ---------------------------------------------------------------------------
+# Length-field fuzzing: neither side may hang or die on corrupt frames.
+
+
+class TestFrameFuzzing:
+    @pytest.mark.parametrize("length", [0, 1, 3, 0x7FFFFFFF, 0xFFFFFFFF])
+    def test_pgwire_rejects_bad_backend_length(self, length):
+        bad = b"R" + struct.pack("!I", length)
+        server = ScriptedServer([
+            ("recv", len(GOLDEN_STARTUP)),
+            ("send", bad),
+        ])
+        with pytest.raises(pgwire.OperationalError):
+            pgwire.connect(
+                host="127.0.0.1", port=server.port,
+                database="db1", user="alice", password="s3cret",
+                connect_timeout=5,
+            )
+        server.join()
+
+    def test_pgwire_truncated_frame_then_close(self):
+        # length claims 100 payload bytes, server sends 3 and hangs up
+        server = ScriptedServer([
+            ("recv", len(GOLDEN_STARTUP)),
+            ("send", b"R" + struct.pack("!I", 104) + b"abc"),
+        ])
+        with pytest.raises(pgwire.OperationalError):
+            pgwire.connect(
+                host="127.0.0.1", port=server.port,
+                database="db1", user="alice", password="s3cret",
+                connect_timeout=5,
+            )
+        server.join()
+
+    @pytest.mark.parametrize("blob", [
+        b"\x00\x00\x00\x00",                      # zero startup length
+        b"\x00\x00\x00\x03",                      # length < 4
+        b"\x00\x00\x00\x05X",                     # too short for protocol code
+        b"\xff\xff\xff\xff",                      # absurd startup length
+        struct.pack("!I", 196608),                # truncated: length missing
+        b"\x16\x03\x01\x02\x00" + b"\x00" * 64,   # a TLS ClientHello
+        b"GET / HTTP/1.1\r\nHost: x\r\n\r\n",     # HTTP to the pg port
+    ])
+    def test_minipg_survives_garbage(self, blob):
+        with MiniPGServer() as server:
+            with socket.create_connection(("127.0.0.1", server.port)) as s:
+                s.settimeout(5)
+                s.sendall(blob)
+                try:
+                    s.shutdown(socket.SHUT_WR)
+                except OSError:
+                    pass
+                # drain whatever the server says until it hangs up
+                try:
+                    while s.recv(4096):
+                        pass
+                except OSError:
+                    pass
+            # the listener must still serve a clean session afterwards
+            with socket.create_connection(("127.0.0.1", server.port)) as s:
+                s.sendall(GOLDEN_STARTUP)
+                frames = read_until_ready(s)
+            assert frame(*frames[0]) == AUTH_OK
+
+    def test_minipg_oversized_message_length(self):
+        with MiniPGServer() as server:
+            with socket.create_connection(("127.0.0.1", server.port)) as s:
+                s.settimeout(5)
+                s.sendall(GOLDEN_STARTUP)
+                read_until_ready(s)
+                # Query frame claiming a 512 MiB payload
+                s.sendall(b"Q" + struct.pack("!I", (512 << 20) + 4))
+                try:
+                    s.shutdown(socket.SHUT_WR)
+                except OSError:
+                    pass
+                try:
+                    while s.recv(4096):
+                        pass
+                except OSError:
+                    pass
+            with socket.create_connection(("127.0.0.1", server.port)) as s:
+                s.sendall(GOLDEN_STARTUP)
+                frames = read_until_ready(s)
+            assert frame(*frames[0]) == AUTH_OK
