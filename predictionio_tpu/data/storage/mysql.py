@@ -14,10 +14,13 @@ the MySQL dialect:
   TEXT), plain ``CREATE INDEX`` (no IF NOT EXISTS; re-init swallows
   the duplicate-index error)
 
-Driver autodetection: ``pymysql`` then ``MySQLdb`` (mysqlclient); a
-clear StorageError says what to install when neither imports — unlike
-postgres there is no vendored wire driver (the MySQL protocol's auth
-plugins are a much larger surface than postgres v3).
+Driver autodetection: ``pymysql`` then ``MySQLdb`` (mysqlclient), then
+the vendored :mod:`~predictionio_tpu.data.storage.mywire` — a pure-
+Python wire driver (protocol 4.1, ``mysql_native_password``) that is
+always available, so the backend works with zero installs, exactly like
+postgres with :mod:`~predictionio_tpu.data.storage.pgwire`. The
+:mod:`~predictionio_tpu.data.storage.minimysql` server makes the
+contract suite run this backend over a live socket by default.
 
 Config (``PIO_STORAGE_SOURCES_<NAME>_*``)::
 
@@ -51,7 +54,11 @@ from predictionio_tpu.data.storage.sql_common import (
 
 
 def _load_driver():
-    """Return (module, kind) for the first available MySQL driver."""
+    """Return (module, kind) for the first available MySQL driver:
+    pymysql, then MySQLdb, then the vendored pure-Python
+    :mod:`~predictionio_tpu.data.storage.mywire` (always present —
+    mysql_native_password + text protocol, which covers minimysql and
+    stock MySQL/MariaDB servers with native-password accounts)."""
     try:
         import pymysql  # type: ignore
 
@@ -64,10 +71,9 @@ def _load_driver():
         return MySQLdb, "mysqlclient"
     except ImportError:
         pass
-    raise StorageError(
-        "mysql backend needs a driver: install pymysql or mysqlclient "
-        "(neither is importable)"
-    )
+    from predictionio_tpu.data.storage import mywire
+
+    return mywire, "mywire"
 
 
 class MySQLDialect(SQLDialect):

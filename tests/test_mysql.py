@@ -76,10 +76,34 @@ class TestRegistry:
                 "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MY",
             }
         )
-        # no driver installed in this image: DAO access must fail with
-        # the actionable install message, not an ImportError
-        with pytest.raises(StorageError, match="pymysql or mysqlclient"):
+        # the vendored mywire driver always resolves (no pymysql in
+        # this image); an unreachable server must surface as a clear
+        # StorageError, not an ImportError or raw socket error
+        with pytest.raises(StorageError, match="cannot reach mysql"):
             storage.get_meta_data_apps()
+
+    def test_driver_fallback_is_mywire(self):
+        from predictionio_tpu.data.storage.mysql import _load_driver
+
+        try:
+            import pymysql  # noqa: F401
+
+            pytest.skip("pymysql installed: fallback branch not in play")
+        except ImportError:
+            pass
+        try:
+            import MySQLdb  # noqa: F401
+
+            pytest.skip("MySQLdb installed: fallback branch not in play")
+        except ImportError:
+            pass
+        driver, kind = _load_driver()
+        # no external driver in this image: the vendored one must be
+        # found — and expose the DB-API error classes the dialect wires
+        assert kind == "mywire"
+        for name in ("IntegrityError", "OperationalError",
+                     "ProgrammingError"):
+            assert hasattr(driver, name)
 
 
 @pytest.mark.skipif(

@@ -27,17 +27,19 @@ def _t(seconds: int) -> dt.datetime:
 
 
 @pytest.fixture(
-    params=["memory", "sqlite", "eventlog", "postgres", "httpstore"]
+    params=["memory", "sqlite", "eventlog", "postgres", "mysql",
+            "httpstore"]
 )
 def storage(
     request, memory_storage, sqlite_storage, eventlog_storage,
-    postgres_storage, httpstore_storage,
+    postgres_storage, mysql_storage, httpstore_storage,
 ):
     return {
         "memory": memory_storage,
         "sqlite": sqlite_storage,
         "eventlog": eventlog_storage,
         "postgres": postgres_storage,
+        "mysql": mysql_storage,
         "httpstore": httpstore_storage,
     }[request.param]
 
