@@ -41,7 +41,9 @@ import sqlite3
 import struct
 import threading
 
+from predictionio_tpu.data.storage import mywire
 from predictionio_tpu.data.storage.mywire import (
+    _Packets,
     lenenc_int,
     native_password_scramble,
 )
@@ -230,49 +232,17 @@ class _Handler(socketserver.BaseRequestHandler):
         self.request.setsockopt(
             socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
         )
-        self._seq = 0
+        # shared framing layer (3-byte LE length + seq id, 16 MiB split
+        # packets) — one implementation for driver and server; the
+        # golden tests read the wire with their own independent reader
+        self._packets = _Packets(self.request)
 
     # -- framing -----------------------------------------------------------
-    def _read_exact(self, n: int) -> bytes:
-        buf = b""
-        while len(buf) < n:
-            chunk = self.request.recv(n - len(buf))
-            if not chunk:
-                raise ConnectionError("client went away")
-            buf += chunk
-        return buf
-
-    _MAX_PACKET = 0xFFFFFF
-
     def _read_packet(self) -> bytes:
-        # reassemble split packets (a 0xFFFFFF-length packet continues
-        # in the next one) — e.g. a >=16 MiB INSERT of a model blob
-        parts = []
-        while True:
-            header = self._read_exact(4)
-            length = header[0] | header[1] << 8 | header[2] << 16
-            self._seq = (header[3] + 1) & 0xFF
-            parts.append(self._read_exact(length))
-            if length < self._MAX_PACKET:
-                return b"".join(parts)
+        return self._packets.recv()
 
     def _send_packet(self, payload: bytes) -> None:
-        # split >=16 MiB payloads; terminated by a short (maybe empty)
-        # chunk, per the wire format
-        out = []
-        offset = 0
-        while True:
-            chunk = payload[offset:offset + self._MAX_PACKET]
-            out.append(
-                struct.pack("<I", len(chunk))[:3]
-                + bytes([self._seq])
-                + chunk
-            )
-            self._seq = (self._seq + 1) & 0xFF
-            offset += len(chunk)
-            if len(chunk) < self._MAX_PACKET:
-                break
-        self.request.sendall(b"".join(out))
+        self._packets.send(payload)
 
     def _send_ok(self, affected: int = 0, last_id: int = 0) -> None:
         self._send_packet(
@@ -427,7 +397,6 @@ class _Handler(socketserver.BaseRequestHandler):
             conn = self.server.open_db()
             try:
                 while True:
-                    self._seq = 0
                     packet = self._read_packet()
                     if not packet:
                         return
@@ -452,8 +421,9 @@ class _Handler(socketserver.BaseRequestHandler):
                 except sqlite3.Error:
                     pass
                 conn.close()
-        except ConnectionError:
-            pass
+        except (ConnectionError, mywire.OperationalError):
+            pass  # client hung up (the shared framing layer raises the
+            # driver-side OperationalError on a closed socket)
         except Exception:  # noqa: BLE001 - server loop must not die
             logger.exception("minimysql session failed")
 

@@ -1285,6 +1285,26 @@ def train_als(
     )
     lam = jnp.asarray(reg, dtype)
 
+    multiprocess = sharded and jax.process_count() > 1
+    gather = (
+        jax.jit(lambda a: a, out_shardings=ctx.replicated)
+        if multiprocess
+        else None
+    )
+
+    def fetch(arr) -> np.ndarray:
+        """Host copy of a (possibly model-sharded) global factor array.
+        On a multi-process mesh some model shards live on other hosts'
+        devices and are not addressable here; a jitted identity with
+        replicated out_shardings inserts the all-gather first (the
+        ``multihost_utils.process_allgather`` pattern), after which
+        every process holds the full matrix. The jitted identity is
+        hoisted so repeated fetches (checkpoints) hit the compile
+        cache. Collective: every process must call it."""
+        if gather is not None:
+            arr = gather(arr)
+        return np.asarray(arr)
+
     # jit is lazy, so constructing the half-step solvers up front costs
     # nothing unless they are actually called (timer / edge paths)
     if sharded:
@@ -1341,6 +1361,7 @@ def train_als(
             _maybe_checkpoint(
                 ckpt_path, checkpoint_every, it + 1, iterations,
                 user_factors, item_factors, n_users, n_items,
+                fetch=fetch,
             )
     else:
         checkpointing = bool(ckpt_path) and checkpoint_every > 0
@@ -1365,6 +1386,7 @@ def train_als(
             _maybe_checkpoint(
                 ckpt_path, checkpoint_every, it, iterations,
                 user_factors, item_factors, n_users, n_items,
+                fetch=fetch,
             )
 
     if not ran_any:
@@ -1373,18 +1395,19 @@ def train_als(
         if resumed_user_factors is not None:
             return ALSFactors(
                 user_factors=resumed_user_factors[:n_users],
-                item_factors=np.asarray(item_factors)[:n_items],
+                item_factors=fetch(item_factors)[:n_items],
             )
         user_factors = solve_u_half(item_factors, lam)
     return ALSFactors(
-        user_factors=np.asarray(user_factors)[:n_users],
-        item_factors=np.asarray(item_factors)[:n_items],
+        user_factors=fetch(user_factors)[:n_users],
+        item_factors=fetch(item_factors)[:n_items],
     )
 
 
 def _maybe_checkpoint(
     ckpt_path, checkpoint_every, iteration, total,
     user_factors, item_factors, n_users, n_items,
+    fetch=np.asarray,
 ) -> None:
     if (
         ckpt_path
@@ -1392,12 +1415,18 @@ def _maybe_checkpoint(
         and iteration % checkpoint_every == 0
         and iteration < total
     ):
-        _write_checkpoint(
-            ckpt_path,
-            iteration=iteration,
-            item_factors=np.asarray(item_factors)[:n_items],
-            user_factors=np.asarray(user_factors)[:n_users],
-        )
+        # fetch() is a collective — every process runs it — but only
+        # rank 0 writes: N hosts racing os.replace on one shared-fs
+        # path would corrupt the checkpoint
+        item_host = fetch(item_factors)[:n_items]
+        user_host = fetch(user_factors)[:n_users]
+        if jax.process_index() == 0:
+            _write_checkpoint(
+                ckpt_path,
+                iteration=iteration,
+                item_factors=item_host,
+                user_factors=user_host,
+            )
 
 
 def _sync_scalar(arr) -> None:

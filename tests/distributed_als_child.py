@@ -12,8 +12,17 @@ mesh spanning hosts, collectives riding the process boundary.
 
 import os
 
+#: geometry knobs (set by the launching test; defaults = historic 2x2)
+_NPROCS = int(os.environ.get("PIO_TEST_NPROCS", "2"))
+_LOCAL_DEVICES = int(os.environ.get("PIO_TEST_LOCAL_DEVICES", "2"))
+_MESH = tuple(
+    int(x) for x in os.environ.get("PIO_TEST_MESH", "2x2").split("x")
+)
+
 os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["XLA_FLAGS"] = (
+    f"--xla_force_host_platform_device_count={_LOCAL_DEVICES}"
+)
 
 import jax  # noqa: E402
 
@@ -35,17 +44,17 @@ def _problem():
 
 def main() -> None:
     distributed.initialize()
-    assert jax.process_count() == 2, jax.process_count()
-    assert len(jax.devices()) == 4, jax.devices()
+    assert jax.process_count() == _NPROCS, jax.process_count()
+    assert len(jax.devices()) == _NPROCS * _LOCAL_DEVICES, jax.devices()
 
-    from predictionio_tpu.ops.als import train_als
+    from predictionio_tpu.ops.als import check_factor_sharding, train_als
     from predictionio_tpu.parallel.mesh import ComputeContext
 
     rows, cols, vals, n_users, n_items, rank = _problem()
     ctx = ComputeContext.create(
-        batch="dist-als", mesh_shape=(2, 2), devices=list(jax.devices())
+        batch="dist-als", mesh_shape=_MESH, devices=list(jax.devices())
     )
-    assert ctx.model_parallelism == 2
+    assert ctx.model_parallelism == _MESH[1]
     factors = train_als(
         ctx, rows, cols, vals,
         n_users=n_users, n_items=n_items, rank=rank,
@@ -55,6 +64,14 @@ def main() -> None:
     got_u = np.asarray(factors.user_factors)
     got_i = np.asarray(factors.item_factors)
     assert np.isfinite(got_u).all() and np.isfinite(got_i).all()
+
+    # every process checks its local shards: the in-loop factor arrays
+    # must be genuinely split over the model axis, not replicated
+    if ctx.model_parallelism > 1:
+        check_factor_sharding(
+            ctx, rows, cols, vals, n_users, n_items,
+            rank=rank, block_len=8,
+        )
 
     # single-process reference on a local 1x1 mesh (local devices only)
     ref_ctx = ComputeContext.create(

@@ -26,22 +26,43 @@ class TestLaunchProcesses:
         )
         assert rc == 0
 
-    def test_two_process_sharded_als_train(self):
-        """The REAL training path across the process boundary: model-
-        sharded ALS (shard_map + all-gathers) on a 2-host × 2-device
-        mesh matches a single-process run of the same problem."""
+    def _run_sharded_als(
+        self, nprocs: int, local_devices: int, mesh: str, timeout: int
+    ) -> None:
         env = dict(os.environ)
         env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["PIO_TEST_NPROCS"] = str(nprocs)
+        env["PIO_TEST_LOCAL_DEVICES"] = str(local_devices)
+        env["PIO_TEST_MESH"] = mesh
         rc = launch_processes(
             [
                 sys.executable,
                 os.path.join(_HERE, "distributed_als_child.py"),
             ],
-            num_processes=2,
+            num_processes=nprocs,
             env=env,
-            timeout=300,
+            timeout=timeout,
         )
         assert rc == 0
+
+    def test_two_process_sharded_als_train(self):
+        """The REAL training path across the process boundary: model-
+        sharded ALS (shard_map + all-gathers) on a 2-host × 2-device
+        mesh matches a single-process run of the same problem."""
+        self._run_sharded_als(2, 2, "2x2", timeout=300)
+
+    def test_four_process_model4_sharded_als(self):
+        """4 hosts × 2 devices, model axis 4: every all-gather group
+        spans two process boundaries; factors must still match the
+        single-process reference and stay genuinely sharded."""
+        self._run_sharded_als(4, 2, "2x4", timeout=420)
+
+    def test_eight_process_model8_sharded_als(self):
+        """8 single-device hosts, model axis 8 — the maximal topology
+        this sandbox can express: all-gather reassembly and the
+        plan_shards inverse permutation have the most ways to be wrong
+        here."""
+        self._run_sharded_als(8, 1, "1x8", timeout=600)
 
     def test_env_contract(self):
         """Children see coordinator address, world size, and their rank."""

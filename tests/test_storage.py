@@ -30,18 +30,10 @@ def _t(seconds: int) -> dt.datetime:
     params=["memory", "sqlite", "eventlog", "postgres", "mysql",
             "httpstore"]
 )
-def storage(
-    request, memory_storage, sqlite_storage, eventlog_storage,
-    postgres_storage, mysql_storage, httpstore_storage,
-):
-    return {
-        "memory": memory_storage,
-        "sqlite": sqlite_storage,
-        "eventlog": eventlog_storage,
-        "postgres": postgres_storage,
-        "mysql": mysql_storage,
-        "httpstore": httpstore_storage,
-    }[request.param]
+def storage(request):
+    # lazy lookup: only the backend under test is built — the socket
+    # backends (postgres/mysql/httpstore) boot a real server per use
+    return request.getfixturevalue(f"{request.param}_storage")
 
 
 class TestApps:
