@@ -1273,6 +1273,43 @@ def train_als(
                     "resuming ALS from checkpoint at iteration %d",
                     start_iteration,
                 )
+    if resume and ckpt_path and jax.process_count() > 1:
+        # Checkpoints are written by rank 0 only; with a host-local
+        # checkpoint_dir the other ranks see no file. Divergent resume
+        # state means divergent collective schedules (deadlock), so
+        # rank 0's view is broadcast and is authoritative — ranks that
+        # found a stale local file discard it.
+        from jax.experimental import multihost_utils as _mhu
+
+        state = _mhu.broadcast_one_to_all(
+            np.array(
+                [int(resumed_user_factors is not None), start_iteration],
+                np.int32,
+            )
+        )
+        if int(state[0]):
+            base = np.asarray(init).dtype
+            have = resumed_user_factors is not None
+            init = _mhu.broadcast_one_to_all(
+                np.asarray(init, base)
+                if have
+                else np.zeros((n_items, rank), base)
+            )
+            resumed_user_factors = _mhu.broadcast_one_to_all(
+                np.asarray(resumed_user_factors, base)
+                if have
+                else np.zeros((n_users, rank), base)
+            )
+            start_iteration = int(state[1])
+        else:
+            if resumed_user_factors is not None:
+                # this rank loaded a stale local file rank 0 never saw:
+                # back to the (seed-deterministic) cold init
+                init = np.asarray(
+                    jax.random.normal(key, (n_items, rank), dtype)
+                ) * (1.0 / math.sqrt(rank))
+            start_iteration = 0
+            resumed_user_factors = None
     item_factors = np.zeros(
         (item_packed.n_rows_padded, rank), np.asarray(init).dtype
     )
@@ -1361,7 +1398,7 @@ def train_als(
             _maybe_checkpoint(
                 ckpt_path, checkpoint_every, it + 1, iterations,
                 user_factors, item_factors, n_users, n_items,
-                fetch=fetch,
+                gather=gather,
             )
     else:
         checkpointing = bool(ckpt_path) and checkpoint_every > 0
@@ -1386,7 +1423,7 @@ def train_als(
             _maybe_checkpoint(
                 ckpt_path, checkpoint_every, it, iterations,
                 user_factors, item_factors, n_users, n_items,
-                fetch=fetch,
+                gather=gather,
             )
 
     if not ran_any:
@@ -1407,7 +1444,7 @@ def train_als(
 def _maybe_checkpoint(
     ckpt_path, checkpoint_every, iteration, total,
     user_factors, item_factors, n_users, n_items,
-    fetch=np.asarray,
+    gather=None,
 ) -> None:
     if (
         ckpt_path
@@ -1415,17 +1452,20 @@ def _maybe_checkpoint(
         and iteration % checkpoint_every == 0
         and iteration < total
     ):
-        # fetch() is a collective — every process runs it — but only
-        # rank 0 writes: N hosts racing os.replace on one shared-fs
-        # path would corrupt the checkpoint
-        item_host = fetch(item_factors)[:n_items]
-        user_host = fetch(user_factors)[:n_users]
+        # gather() is the collective — every process runs it — but the
+        # device→host copy and the write are rank-0-only: N hosts
+        # racing os.replace on one shared-fs path would corrupt the
+        # checkpoint, and non-writers materializing hundreds of MB of
+        # host factors per checkpoint is pure waste
+        if gather is not None:
+            item_factors = gather(item_factors)
+            user_factors = gather(user_factors)
         if jax.process_index() == 0:
             _write_checkpoint(
                 ckpt_path,
                 iteration=iteration,
-                item_factors=item_host,
-                user_factors=user_host,
+                item_factors=np.asarray(item_factors)[:n_items],
+                user_factors=np.asarray(user_factors)[:n_users],
             )
 
 

@@ -90,6 +90,43 @@ def main() -> None:
     np.testing.assert_allclose(
         got_i, np.asarray(ref.item_factors), rtol=2e-4, atol=2e-5
     )
+
+    if _MESH == (2, 2):
+        # checkpoint + resume across the process boundary with HOST-
+        # LOCAL (non-shared) checkpoint dirs: rank 0 writes, the other
+        # ranks find no file, and the rank-0 broadcast must keep every
+        # process on the same resume schedule (divergence = deadlock).
+        import tempfile
+
+        ckpt_dir = os.path.join(
+            tempfile.gettempdir(),
+            f"pio_dist_ckpt_{os.environ['PIO_COORDINATOR_ADDRESS'].replace(':', '_')}",
+            f"rank{jax.process_index()}",
+        )
+        train_als(
+            ctx, rows, cols, vals,
+            n_users=n_users, n_items=n_items, rank=rank,
+            iterations=2, reg=0.1, block_len=8,
+            factor_sharding="sharded",
+            checkpoint_dir=ckpt_dir, checkpoint_every=1,
+        )
+        has_file = os.path.exists(
+            os.path.join(ckpt_dir, "als_checkpoint.npz")
+        )
+        assert has_file == (jax.process_index() == 0), (
+            "checkpoint writes must be rank-0-only"
+        )
+        resumed = train_als(
+            ctx, rows, cols, vals,
+            n_users=n_users, n_items=n_items, rank=rank,
+            iterations=2, reg=0.1, block_len=8,
+            factor_sharding="sharded",
+            checkpoint_dir=ckpt_dir, checkpoint_every=1, resume=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(resumed.user_factors), got_u, rtol=2e-4, atol=2e-5
+        )
+
     print(
         f"distributed ALS OK rank={jax.process_index()}/"
         f"{jax.process_count()} factors match single-process reference",

@@ -122,9 +122,16 @@ class TestFastEvalEngine:
         assert len(engine._data_source_cache) == 1
         assert len(engine._preparator_cache) == 2
         assert len(engine._algorithms_cache) == 3 * 2  # 3 algos × 2 folds
-        assert engine.cache_hits["data_source"] > 0
-        assert engine.cache_hits["preparator"] > 0
-        assert engine.cache_hits["algorithms"] == 0  # all algos distinct
+        # exact per-prefix hit counts (reference FastEvalEngineTest bar):
+        # hits = lookups - owners, and lookups are deterministic —
+        # data source: 3 eval() calls + 2 preparator computes, 1 owner;
+        # preparator: 6 model computes (3 algos x 2 folds), 2 owners
+        assert engine.cache_hits == {
+            "data_source": 4,
+            "preparator": 4,
+            "algorithms": 0,  # all algos distinct
+            "predict": 0,
+        }
 
     def test_identical_candidate_full_reuse(self, ctx):
         engine = _engine(FastEvalEngine)
@@ -132,6 +139,7 @@ class TestFastEvalEngine:
         r = evaluator.evaluate(ctx, engine, [_params(3), _params(3)])
         # the predict-level cache short-circuits the whole pipeline
         assert engine.cache_hits["predict"] == 2  # 2 folds reused
+        assert engine.cache_hits["algorithms"] == 0  # never re-looked-up
         assert len(engine._algorithms_cache) == 2  # trained once per fold
         # identical scores
         scores = [s.score for _p, s in r.engine_params_scores]
