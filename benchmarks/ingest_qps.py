@@ -114,13 +114,21 @@ def bench_backend(storage, app_id: int, seconds: float) -> dict:
 
 
 def bench_http(
-    storage, key: str, seconds: float, clients: int, port: int
+    storage, key: str, seconds: float, clients: int, port: int,
+    external: bool = False,
 ) -> dict:
-    from predictionio_tpu.serving.event_server import create_event_server
+    """``external=True`` targets an already-listening server on ``port``
+    (e.g. a ``pio-tpu eventserver --workers N`` SO_REUSEPORT group)
+    instead of starting one in-process."""
+    http_srv = None
+    if not external:
+        from predictionio_tpu.serving.event_server import (
+            create_event_server,
+        )
 
-    http_srv = create_event_server(host="127.0.0.1", port=port)
-    http_srv.start()
-    port = http_srv.port
+        http_srv = create_event_server(host="127.0.0.1", port=port)
+        http_srv.start()
+        port = http_srv.port
     counts = [0] * clients
     errors = [0] * clients
     stop_at = time.perf_counter() + seconds
@@ -162,7 +170,8 @@ def bench_http(
     for t in threads:
         t.join()
     elapsed = time.perf_counter() - t0
-    http_srv.shutdown()
+    if http_srv is not None:
+        http_srv.shutdown()
     return {
         "eps": round(sum(counts) / elapsed, 1),
         "errors": sum(errors),
@@ -180,7 +189,31 @@ def main() -> int:
     ap.add_argument("--seconds", type=float, default=10.0)
     ap.add_argument("--clients", type=int, default=8)
     ap.add_argument("--port", type=int, default=0)
+    ap.add_argument(
+        "--external-port", type=int, default=0,
+        help="drive an already-running event server on this port (e.g. "
+             "a `pio-tpu eventserver --workers N` group) instead of an "
+             "in-process one; requires --access-key for its store",
+    )
+    ap.add_argument("--access-key", default="")
     args = ap.parse_args()
+
+    if args.external_port:
+        if not args.access_key:
+            ap.error("--external-port requires --access-key (without "
+                     "it every POST 401s and eps reads 0)")
+        r = bench_http(
+            None, args.access_key, args.seconds, args.clients,
+            args.external_port, external=True,
+        )
+        print(json.dumps({
+            "metric": "ingest_eps_http",
+            "value": r["eps"],
+            "unit": "events/s",
+            "backend": "external",
+            "extra": r,
+        }))
+        return 0
 
     with tempfile.TemporaryDirectory(prefix="pio-ingest-") as tmp:
         storage, app_id, key = make_storage(args.backend, tmp)

@@ -146,9 +146,17 @@ def main() -> None:
         elapsed = time.perf_counter() - t0
         return result, elapsed, CountingDS.reads, CountingPrep.prepares
 
-    # warmup trains one candidate so jit compile time (paid identically
-    # by both modes on matching shapes) doesn't skew the comparison
-    MetricEvaluator(metric).evaluate(ctx, make_engine(), grid[:1])
+    # warmup: compile every distinct factor shape (one candidate per
+    # rank) so neither timed run pays XLA compiles the other gets from
+    # the in-process jit cache — otherwise run order would skew the A/B
+    seen_ranks: set[int] = set()
+    warmup = []
+    for cand in grid:
+        r = cand.algorithms[0][1].rank
+        if r not in seen_ranks:
+            seen_ranks.add(r)
+            warmup.append(cand)
+    MetricEvaluator(metric).evaluate(ctx, make_engine(), warmup)
 
     plain_result, plain_s, plain_reads, plain_prepares = run(make_engine())
     fast_engine = make_engine(FastEvalEngine)

@@ -408,8 +408,27 @@ def cmd_deploy(args) -> int:
         max_batch=args.max_batch,
         max_wait_ms=args.max_wait_ms,
     )
-    http = server.serve(host=args.ip, port=args.port)
+    multi = args.workers > 1
+    http = server.serve(
+        host=args.ip, port=args.port,
+        reuse_port=multi or args.reuse_port,
+        # a re-exec'd worker must not "undeploy" its own parent
+        undeploy_first=not args.reuse_port,
+    )
     print(f"Engine server is listening on {args.ip}:{http.port}")
+    if multi:
+        from predictionio_tpu.serving import workers as _workers
+
+        print(
+            "note: every worker stages the model itself — multi-worker "
+            "deploy is for CPU-backend serving fronts (one process owns "
+            "an accelerator); storage must be a shared backend",
+            file=sys.stderr,
+        )
+        return _workers.serve_with_workers(
+            http, args.workers,
+            _workers.rebuild_argv(sys.argv[1:], http.port),
+        )
     try:
         http.serve_forever()
     except KeyboardInterrupt:
@@ -435,10 +454,24 @@ def cmd_undeploy(args) -> int:
 def cmd_eventserver(args) -> int:
     from predictionio_tpu.serving.event_server import create_event_server
 
+    multi = args.workers > 1
     http = create_event_server(
-        host=args.ip, port=args.port, stats=args.stats
+        host=args.ip, port=args.port, stats=args.stats,
+        reuse_port=multi or args.reuse_port,
     )
     print(f"Event server is listening on {args.ip}:{http.port}")
+    if multi:
+        from predictionio_tpu.serving import workers as _workers
+
+        print(
+            "note: each worker opens storage independently — use a "
+            "shared backend (sqlite/eventlog/postgres/...), not memory",
+            file=sys.stderr,
+        )
+        return _workers.serve_with_workers(
+            http, args.workers,
+            _workers.rebuild_argv(sys.argv[1:], http.port),
+        )
     try:
         http.serve_forever()
     except KeyboardInterrupt:
@@ -905,6 +938,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--max-wait-ms", dest="max_wait_ms", type=float, default=2.0,
         help="micro-batcher fill window in milliseconds",
     )
+    p.add_argument(
+        "--workers", type=int, default=1,
+        help="SO_REUSEPORT worker processes sharing the port "
+             "(CPU-backend serving fronts; 1 = single process)",
+    )
+    p.add_argument(
+        "--reuse-port", action="store_true", help=argparse.SUPPRESS
+    )
     p.set_defaults(func=cmd_deploy)
 
     p = sub.add_parser("undeploy")
@@ -916,6 +957,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ip", default="0.0.0.0")
     p.add_argument("--port", type=int, default=7070)
     p.add_argument("--stats", action="store_true")
+    p.add_argument(
+        "--workers", type=int, default=1,
+        help="SO_REUSEPORT worker processes sharing the port",
+    )
+    p.add_argument(
+        "--reuse-port", action="store_true", help=argparse.SUPPRESS
+    )
     p.set_defaults(func=cmd_eventserver)
 
     p = sub.add_parser("dashboard")

@@ -489,6 +489,7 @@ class EngineServer:
         port: int = 8000,
         bind_retries: int = 3,
         undeploy_first: bool = True,
+        reuse_port: bool = False,
     ) -> HTTPServer:
         """Bind the REST service: undeploy-before-deploy handshake, then
         bind with retries (reference MasterActor StartServer →
@@ -507,6 +508,7 @@ class EngineServer:
                     port=port,
                     server_config=self._server_config,
                     enforce_key=False,
+                    reuse_port=reuse_port,
                 )
                 return self._http
             except OSError as exc:

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import datetime as _dt
 import logging
+import os
 from typing import Callable
 
 from predictionio_tpu.data.event import Event, EventValidationError
@@ -120,7 +121,9 @@ class EventServer:
 
     # -- routes -----------------------------------------------------------
     def _status(self, request: Request) -> Response:
-        return Response(200, {"status": "alive"})
+        # pid identifies which SO_REUSEPORT worker answered (ops +
+        # the multi-worker tests); reference returns a bare status line
+        return Response(200, {"status": "alive", "pid": os.getpid()})
 
     def _validate(
         self, event: Event, app_id: int, channel_id, whitelist
@@ -377,6 +380,7 @@ def create_event_server(
     stats: bool = False,
     plugins: PluginContext | None = None,
     server_config=None,
+    reuse_port: bool = False,
 ) -> HTTPServer:
     """Reference EventServer.createEventServer (default port 7070).
 
@@ -394,4 +398,5 @@ def create_event_server(
         port=port,
         server_config=server_config,
         enforce_key=False,
+        reuse_port=reuse_port,
     )

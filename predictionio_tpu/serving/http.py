@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import logging
 import re
+import socket
 import ssl
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -134,6 +135,7 @@ class HTTPServer:
         port: int = 0,
         server_config=None,
         enforce_key: bool = True,
+        reuse_port: bool = False,
     ):
         """``server_config`` (a
         :class:`~predictionio_tpu.serving.config.ServerConfig`) adds the
@@ -221,6 +223,25 @@ class HTTPServer:
             # concurrent bursts — the exact load the batcher exists for
             request_queue_size = 128
             daemon_threads = True
+
+            def server_bind(self):
+                # SO_REUSEPORT: N worker processes bind the same port
+                # and the kernel load-balances accepts across them (the
+                # multi-worker front-end; see serving/workers.py). Set
+                # explicitly rather than via socketserver's
+                # allow_reuse_port, which only exists on 3.11+ — on
+                # older runtimes that attribute silently no-ops and the
+                # workers would crash-loop on EADDRINUSE.
+                if reuse_port:
+                    if not hasattr(socket, "SO_REUSEPORT"):
+                        raise OSError(
+                            "SO_REUSEPORT is not supported on this "
+                            "platform; run with --workers 1"
+                        )
+                    self.socket.setsockopt(
+                        socket.SOL_SOCKET, socket.SO_REUSEPORT, 1
+                    )
+                super().server_bind()
 
             def handle_error(self, request, client_address):
                 # connection-level failures (e.g. aborted TLS handshakes)

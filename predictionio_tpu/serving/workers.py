@@ -1,0 +1,132 @@
+"""SO_REUSEPORT multi-worker front-end for the HTTP servers.
+
+Why: the reference's HTTP tier (spray on the JVM,
+``CreateServer.scala:495-647``) scales across cores with threads; a
+Python front-end cannot — the GIL serializes request parsing, so one
+process saturates one core at ~1k QPS while the framework underneath
+does ~48k predictions/s (BASELINE.md). The multi-worker shape is N
+processes, each binding the same host:port with ``SO_REUSEPORT``; the
+kernel load-balances accepted connections across them, no proxy in
+front.
+
+Mechanics: the parent binds first (resolving port 0 to a real port),
+then re-execs N-1 children with ``--port <resolved> --reuse-port
+--workers 1`` appended and serves alongside them. Children that die are
+respawned (with backoff) until the parent shuts down; SIGTERM/SIGINT
+tears the whole group down.
+
+Caveats:
+* every worker opens storage independently — the backends must be
+  multi-process-shared (sqlite/eventlog/postgres/mysql/httpstore; the
+  ``memory`` backend is per-process and will serve inconsistent data).
+* for ``deploy``, each worker stages the model on its own backend. On a
+  host-attached accelerator only one process can own the device — use
+  workers > 1 for CPU-backend serving fronts, or keep the device server
+  single-worker behind these as a second tier.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+#: respawn backoff: a crash-looping worker must not spin the host
+_RESPAWN_DELAY_S = 1.0
+
+
+def rebuild_argv(argv: list[str], port: int) -> list[str]:
+    """The child's CLI args: the parent's argv with ``--port`` pinned to
+    the resolved port, ``--workers``/``--reuse-port`` removed, then
+    ``--workers 1 --reuse-port`` appended."""
+    value_opts = {"--workers", "--port"}
+    flag_opts = {"--reuse-port"}
+    out: list[str] = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        name = a.split("=", 1)[0]
+        if name in flag_opts:
+            i += 1
+        elif name in value_opts:
+            i += 1 if "=" in a else 2
+        else:
+            out.append(a)
+            i += 1
+    return out + ["--port", str(port), "--workers", "1", "--reuse-port"]
+
+
+def serve_with_workers(
+    http_server,
+    n_workers: int,
+    child_argv: list[str],
+    out=print,
+) -> int:
+    """Serve ``http_server`` (already bound with ``reuse_port=True``) in
+    this process while supervising ``n_workers - 1`` re-exec'd children
+    on the same port. Blocks until interrupted; returns an exit code."""
+    stopping = threading.Event()
+    children: list[subprocess.Popen] = []
+
+    def spawn() -> subprocess.Popen:
+        return subprocess.Popen(
+            [sys.executable, "-m", "predictionio_tpu.cli.main"]
+            + child_argv,
+        )
+
+    def supervise() -> None:
+        while not stopping.is_set():
+            for i, proc in enumerate(children):
+                rc = proc.poll()
+                if rc is not None and not stopping.is_set():
+                    logger.warning(
+                        "worker pid %d exited rc=%s; respawning",
+                        proc.pid, rc,
+                    )
+                    stopping.wait(_RESPAWN_DELAY_S)
+                    if stopping.is_set():
+                        return  # shutdown won the race: don't spawn an
+                        # orphan the teardown loop will never see
+                    children[i] = spawn()
+            stopping.wait(0.5)
+
+    for _ in range(max(0, n_workers - 1)):
+        children.append(spawn())
+    if children:
+        out(
+            f"{len(children) + 1} workers sharing port {http_server.port} "
+            f"(pids {[p.pid for p in children]} + self)"
+        )
+    watchdog = threading.Thread(target=supervise, daemon=True)
+    watchdog.start()
+
+    def _terminate(*_sig) -> None:
+        raise KeyboardInterrupt
+
+    try:
+        signal.signal(signal.SIGTERM, _terminate)
+    except ValueError:
+        pass  # not the main thread (tests)
+    try:
+        http_server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        stopping.set()
+        # the watchdog must be parked before children are reaped — a
+        # respawn mid-teardown would orphan the new process
+        watchdog.join(timeout=_RESPAWN_DELAY_S + 1.0)
+        for proc in children:
+            proc.terminate()
+        deadline = time.monotonic() + 5
+        for proc in children:
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    return 0

@@ -2,6 +2,7 @@
 (reference EventServiceSpec / SegmentIOAuthSpec patterns)."""
 
 import json
+import os
 import urllib.error
 import urllib.request
 
@@ -58,7 +59,10 @@ def _event(name="view", entity="u1", **extra):
 class TestEventAPI:
     def test_alive(self, server):
         base, _, _ = server
-        assert _call(f"{base}/")[1] == {"status": "alive"}
+        status, body = _call(f"{base}/")
+        assert status == 200
+        assert body["status"] == "alive"
+        assert body["pid"] == os.getpid()  # in-process server
 
     def test_create_get_delete(self, server):
         base, key, _ = server

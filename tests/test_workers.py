@@ -1,0 +1,217 @@
+"""SO_REUSEPORT multi-worker front-end (serving/workers.py).
+
+Reference analogue: the spray HTTP tier scales across cores with JVM
+threads (CreateServer.scala:495-647); the Python front-end scales with
+worker processes sharing one port. These tests prove the mechanics on
+a live port: N processes bound together, kernel load-balancing across
+them, crashed workers respawned, clean group teardown.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.serving.workers import rebuild_argv
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestRebuildArgv:
+    def test_pins_port_and_resets_workers(self):
+        argv = ["eventserver", "--ip", "127.0.0.1", "--port", "0",
+                "--workers", "4", "--stats"]
+        out = rebuild_argv(argv, 7070)
+        assert out == [
+            "eventserver", "--ip", "127.0.0.1", "--stats",
+            "--port", "7070", "--workers", "1", "--reuse-port",
+        ]
+
+    def test_equals_style_options(self):
+        out = rebuild_argv(
+            ["eventserver", "--port=0", "--workers=3"], 8123
+        )
+        assert out == [
+            "eventserver", "--port", "8123", "--workers", "1",
+            "--reuse-port",
+        ]
+
+    def test_existing_reuse_port_not_duplicated(self):
+        out = rebuild_argv(["eventserver", "--reuse-port"], 9)
+        assert out.count("--reuse-port") == 1
+
+
+def _get_status(port: int) -> dict:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/", timeout=10
+    ) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture()
+def worker_group(tmp_path):
+    """A 3-worker event server via the real CLI; yields (proc, port)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update({
+        "PIO_STORAGE_SOURCES_SQL_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQL_PATH": str(tmp_path / "ev.sqlite"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQL",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQL",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQL",
+    })
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "predictionio_tpu.cli.main",
+            "eventserver", "--ip", "127.0.0.1", "--port", "0",
+            "--workers", "3",
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    port = None
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if "listening on" in line:
+            port = int(line.rsplit(":", 1)[1])
+            break
+    assert port, "server never reported its port"
+    # wait until requests are answered
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            _get_status(port)
+            break
+        except OSError:
+            time.sleep(0.2)
+    try:
+        yield proc, port, str(tmp_path / "ev.sqlite")
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+def _worker_pids(parent_pid: int) -> set[int]:
+    """Child pids of the parent that are re-exec'd workers."""
+    out = subprocess.run(
+        ["pgrep", "-P", str(parent_pid)],
+        capture_output=True, text=True,
+    )
+    return {int(p) for p in out.stdout.split()}
+
+
+class TestMultiWorkerEventServer:
+    def test_kernel_balances_across_processes(self, worker_group):
+        proc, port, _db = worker_group
+        # each request opens a fresh connection; SO_REUSEPORT assigns
+        # connections across the bound processes. Children take a
+        # couple of seconds to import + bind, so poll until at least 2
+        # distinct pids have answered.
+        pids: set[int] = set()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and len(pids) < 2:
+            pids.add(_get_status(port)["pid"])
+        assert len(pids) >= 2, f"only one worker ever answered: {pids}"
+        # and the answering pids really are the parent + its children
+        group = {proc.pid} | _worker_pids(proc.pid)
+        assert pids <= group
+
+    def test_events_visible_across_workers(self, worker_group):
+        """A write accepted by one worker is readable through any other
+        (shared sqlite backend) — the property the memory backend
+        cannot give a worker group."""
+        _proc, port, db_path = worker_group
+        # the event API needs an access key — create one against the
+        # same sqlite file the workers share
+        from predictionio_tpu.data.storage import AccessKey, App, Storage
+
+        env_file = db_path
+        storage = Storage(
+            env={
+                "PIO_STORAGE_SOURCES_SQL_TYPE": "sqlite",
+                "PIO_STORAGE_SOURCES_SQL_PATH": env_file,
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQL",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQL",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQL",
+            }
+        )
+        app_id = storage.get_meta_data_apps().insert(
+            App(id=0, name="wapp")
+        )
+        storage.get_meta_data_access_keys().insert(
+            AccessKey(key="wkey", appid=app_id)
+        )
+        storage.get_events().init(app_id)
+        body = json.dumps({
+            "event": "buy",
+            "entityType": "user",
+            "entityId": "u1",
+        }).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/events.json?accessKey=wkey",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 201
+        # read back until at least two distinct workers have served the
+        # find (children need a moment to import + bind)
+        seen_pids: set[int] = set()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and len(seen_pids) < 2:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/events.json?accessKey=wkey",
+                timeout=10,
+            ) as resp:
+                events = json.loads(resp.read())
+            assert len(events) == 1 and events[0]["event"] == "buy"
+            seen_pids.add(_get_status(port)["pid"])
+        assert len(seen_pids) >= 2
+
+    def test_crashed_worker_respawns(self, worker_group):
+        proc, port, _db = worker_group
+        before = _worker_pids(proc.pid)
+        assert len(before) == 2
+        victim = min(before)
+        os.kill(victim, signal.SIGKILL)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            now = _worker_pids(proc.pid)
+            if len(now) == 2 and victim not in now:
+                break
+            time.sleep(0.3)
+        else:
+            pytest.fail("killed worker was not respawned")
+        # the group still serves
+        assert _get_status(port)["status"] == "alive"
+
+    def test_sigterm_tears_down_group(self, worker_group):
+        proc, port, _db = worker_group
+        children = _worker_pids(proc.pid)
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=10)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            alive = {
+                pid for pid in children
+                if os.path.isdir(f"/proc/{pid}")
+                and "zombie" not in open(f"/proc/{pid}/status").read()
+            }
+            if not alive:
+                break
+            time.sleep(0.2)
+        assert not alive, f"workers survived parent: {alive}"
