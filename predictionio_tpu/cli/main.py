@@ -17,6 +17,7 @@ import argparse
 import dataclasses
 import json
 import os
+import subprocess
 import sys
 
 from predictionio_tpu.version import __version__
@@ -409,6 +410,9 @@ def cmd_deploy(args) -> int:
         max_wait_ms=args.max_wait_ms,
     )
     multi = args.workers > 1
+    if multi and (err := _reuseport_unsupported()):
+        print(err, file=sys.stderr)
+        return 1
     http = server.serve(
         host=args.ip, port=args.port,
         reuse_port=multi or args.reuse_port,
@@ -427,7 +431,7 @@ def cmd_deploy(args) -> int:
         )
         return _workers.serve_with_workers(
             http, args.workers,
-            _workers.rebuild_argv(sys.argv[1:], http.port),
+            _workers.rebuild_argv(args.raw_argv, http.port),
         )
     try:
         http.serve_forever()
@@ -455,6 +459,9 @@ def cmd_eventserver(args) -> int:
     from predictionio_tpu.serving.event_server import create_event_server
 
     multi = args.workers > 1
+    if multi and (err := _reuseport_unsupported()):
+        print(err, file=sys.stderr)
+        return 1
     http = create_event_server(
         host=args.ip, port=args.port, stats=args.stats,
         reuse_port=multi or args.reuse_port,
@@ -470,7 +477,7 @@ def cmd_eventserver(args) -> int:
         )
         return _workers.serve_with_workers(
             http, args.workers,
-            _workers.rebuild_argv(sys.argv[1:], http.port),
+            _workers.rebuild_argv(args.raw_argv, http.port),
         )
     try:
         http.serve_forever()
@@ -599,6 +606,29 @@ def cmd_import(args) -> int:
     return 0
 
 
+def _reuseport_unsupported() -> str | None:
+    """A clean CLI error when ``--workers N`` cannot work here, instead
+    of a traceback (or, on the deploy path, 3 pointless bind retries)."""
+    import socket
+
+    if not hasattr(socket, "SO_REUSEPORT"):
+        return (
+            "error: --workers needs SO_REUSEPORT, which this platform "
+            "does not support; run with --workers 1"
+        )
+    return None
+
+
+def _is_git_source(src: str) -> bool:
+    """A template source that names a git repository rather than a
+    bundled template or local directory."""
+    return (
+        "://" in src  # https://, git://, file://, ssh://
+        or src.startswith("git@")
+        or src.endswith(".git")
+    )
+
+
 def _templates_dir() -> str:
     """Bundled template gallery (the offline stand-in for the
     reference's GitHub gallery, console/Template.scala:130-429)."""
@@ -619,18 +649,8 @@ def cmd_template(args) -> int:
 
     if args.template_command == "get":
         import shutil
+        import tempfile
 
-        src = args.template
-        if not os.path.isdir(src):
-            src = os.path.join(_templates_dir(), args.template)
-        if not os.path.isdir(src):
-            print(
-                f"error: template {args.template!r} not found "
-                f"(looked in {_templates_dir()}); `pio-tpu template "
-                f"list` shows bundled engines",
-                file=sys.stderr,
-            )
-            return 1
         dst = args.directory
         if os.path.exists(dst) and (
             not os.path.isdir(dst) or os.listdir(dst)
@@ -641,10 +661,71 @@ def cmd_template(args) -> int:
                 file=sys.stderr,
             )
             return 1
-        shutil.copytree(
-            src, dst, dirs_exist_ok=True,
-            ignore=shutil.ignore_patterns("__pycache__"),
-        )
+        clone_tmp: tempfile.TemporaryDirectory | None = None
+        if _is_git_source(args.template):
+            # remote gallery fetch (reference Template.scala:226-369
+            # downloads a GitHub tag tarball; here: shallow git clone,
+            # which also covers file:// repos and private hosts)
+            clone_tmp = tempfile.TemporaryDirectory(prefix="pio-tpl-")
+            src = os.path.join(clone_tmp.name, "repo")
+            cmd = ["git", "clone", "--depth", "1"]
+            if args.ref:
+                cmd += ["--branch", args.ref]
+            cmd += [args.template, src]
+            try:
+                proc = subprocess.run(cmd, capture_output=True, text=True)
+            except FileNotFoundError:
+                print(
+                    "error: cannot fetch template: git is not installed "
+                    "(template get from a URL shells out to git clone)",
+                    file=sys.stderr,
+                )
+                clone_tmp.cleanup()
+                return 1
+            if proc.returncode != 0:
+                print(
+                    f"error: cannot fetch template from "
+                    f"{args.template!r}: {proc.stderr.strip()}",
+                    file=sys.stderr,
+                )
+                clone_tmp.cleanup()
+                return 1
+            if args.subdir:
+                root = os.path.realpath(src)
+                src = os.path.realpath(os.path.join(src, args.subdir))
+                # confine --subdir to the clone: an absolute path or
+                # ../ traversal must not scaffold from the host tree
+                if not src.startswith(root + os.sep) or not (
+                    os.path.isdir(src)
+                ):
+                    print(
+                        f"error: --subdir {args.subdir!r} does not "
+                        "name a directory inside the fetched repository",
+                        file=sys.stderr,
+                    )
+                    clone_tmp.cleanup()
+                    return 1
+        else:
+            src = args.template
+            if not os.path.isdir(src):
+                src = os.path.join(_templates_dir(), args.template)
+            if not os.path.isdir(src):
+                print(
+                    f"error: template {args.template!r} not found "
+                    f"(looked in {_templates_dir()}); `pio-tpu template "
+                    f"list` shows bundled engines, and a git URL / "
+                    f"file:// repo fetches remotely",
+                    file=sys.stderr,
+                )
+                return 1
+        try:
+            shutil.copytree(
+                src, dst, dirs_exist_ok=True,
+                ignore=shutil.ignore_patterns("__pycache__", ".git"),
+            )
+        finally:
+            if clone_tmp is not None:
+                clone_tmp.cleanup()
         # personalize engine.json (the reference's scaffolding prompts,
         # Template.scala:226-369, taken from flags instead)
         variant_path = os.path.join(dst, "engine.json")
@@ -1000,9 +1081,21 @@ def build_parser() -> argparse.ArgumentParser:
     tp = p.add_subparsers(dest="template_command", required=True)
     tp.add_parser("list")
     tg = tp.add_parser("get")
-    tg.add_argument("template", help="bundled template name or path")
+    tg.add_argument(
+        "template",
+        help="bundled template name, local path, or git URL "
+             "(https://…, git@…, file://…, anything ending .git)",
+    )
     tg.add_argument("directory", help="destination project directory")
     tg.add_argument("--engine-id", dest="engine_id")
+    tg.add_argument(
+        "--ref", default="",
+        help="branch or tag to fetch (git sources only)",
+    )
+    tg.add_argument(
+        "--subdir", default="",
+        help="template subdirectory inside the fetched repository",
+    )
     p.set_defaults(func=cmd_template)
 
     p = sub.add_parser("run")
@@ -1089,6 +1182,10 @@ def main(argv: list[str] | None = None) -> int:
         format="[%(levelname)s] [%(name)s] %(message)s",
     )
     args = build_parser().parse_args(argv)
+    # the argv actually parsed — NOT sys.argv, which belongs to the host
+    # process when main() is called programmatically; multi-worker
+    # re-exec rebuilds child command lines from this
+    args.raw_argv = list(argv) if argv is not None else sys.argv[1:]
     try:
         return args.func(args)
     except CommandError as e:

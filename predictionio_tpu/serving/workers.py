@@ -12,8 +12,9 @@ front.
 Mechanics: the parent binds first (resolving port 0 to a real port),
 then re-execs N-1 children with ``--port <resolved> --reuse-port
 --workers 1`` appended and serves alongside them. Children that die are
-respawned (with backoff) until the parent shuts down; SIGTERM/SIGINT
-tears the whole group down.
+respawned — consecutive startup failures back off exponentially (1 s
+doubling to 30 s; a worker that served >=10 s resets the clock) —
+until the parent shuts down; SIGTERM/SIGINT tears the whole group down.
 
 Caveats:
 * every worker opens storage independently — the backends must be
@@ -38,6 +39,11 @@ logger = logging.getLogger(__name__)
 
 #: respawn backoff: a crash-looping worker must not spin the host
 _RESPAWN_DELAY_S = 1.0
+#: exponential backoff ceiling for consecutive startup failures
+_RESPAWN_MAX_DELAY_S = 30.0
+#: a worker that served at least this long is considered to have been
+#: healthy — its next crash starts the backoff over
+_HEALTHY_UPTIME_S = 10.0
 
 
 def rebuild_argv(argv: list[str], port: int) -> list[str]:
@@ -71,7 +77,8 @@ def serve_with_workers(
     this process while supervising ``n_workers - 1`` re-exec'd children
     on the same port. Blocks until interrupted; returns an exit code."""
     stopping = threading.Event()
-    children: list[subprocess.Popen] = []
+    # per-slot state: [Popen, spawn time, consecutive startup failures]
+    children: list[list] = []
 
     def spawn() -> subprocess.Popen:
         return subprocess.Popen(
@@ -81,26 +88,36 @@ def serve_with_workers(
 
     def supervise() -> None:
         while not stopping.is_set():
-            for i, proc in enumerate(children):
+            for slot in children:
+                proc, spawned_at, fails = slot
                 rc = proc.poll()
                 if rc is not None and not stopping.is_set():
-                    logger.warning(
-                        "worker pid %d exited rc=%s; respawning",
-                        proc.pid, rc,
+                    uptime = time.monotonic() - spawned_at
+                    fails = 0 if uptime >= _HEALTHY_UPTIME_S else fails + 1
+                    delay = min(
+                        _RESPAWN_DELAY_S * (2 ** max(fails - 1, 0)),
+                        _RESPAWN_MAX_DELAY_S,
                     )
-                    stopping.wait(_RESPAWN_DELAY_S)
+                    logger.warning(
+                        "worker pid %d exited rc=%s after %.1fs; "
+                        "respawning in %.1fs",
+                        proc.pid, rc, uptime, delay,
+                    )
+                    stopping.wait(delay)
                     if stopping.is_set():
                         return  # shutdown won the race: don't spawn an
                         # orphan the teardown loop will never see
-                    children[i] = spawn()
+                    slot[0] = spawn()
+                    slot[1] = time.monotonic()
+                    slot[2] = fails
             stopping.wait(0.5)
 
     for _ in range(max(0, n_workers - 1)):
-        children.append(spawn())
+        children.append([spawn(), time.monotonic(), 0])
     if children:
         out(
             f"{len(children) + 1} workers sharing port {http_server.port} "
-            f"(pids {[p.pid for p in children]} + self)"
+            f"(pids {[s[0].pid for s in children]} + self)"
         )
     watchdog = threading.Thread(target=supervise, daemon=True)
     watchdog.start()
@@ -120,13 +137,15 @@ def serve_with_workers(
         stopping.set()
         # the watchdog must be parked before children are reaped — a
         # respawn mid-teardown would orphan the new process
-        watchdog.join(timeout=_RESPAWN_DELAY_S + 1.0)
-        for proc in children:
-            proc.terminate()
+        watchdog.join(timeout=_RESPAWN_MAX_DELAY_S + 1.0)
+        for slot in children:
+            slot[0].terminate()
         deadline = time.monotonic() + 5
-        for proc in children:
+        for slot in children:
             try:
-                proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+                slot[0].wait(
+                    timeout=max(0.1, deadline - time.monotonic())
+                )
             except subprocess.TimeoutExpired:
-                proc.kill()
+                slot[0].kill()
     return 0

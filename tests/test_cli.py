@@ -2,6 +2,7 @@
 plus dashboard and admin server REST."""
 
 import json
+import os
 import urllib.error
 import urllib.request
 
@@ -423,6 +424,100 @@ class TestTemplateAndRun:
             "template", "get", "classification", str(tmp_path / "occupied")
         )
         assert code == 1 and "empty directory" in err
+
+    @staticmethod
+    def _make_git_repo(tmp_path, tag: str = "") -> str:
+        """A local git repo playing the remote gallery (the reference
+        fetches GitHub tag tarballs, Template.scala:226-369; offline
+        here via file://)."""
+        import subprocess
+
+        repo = tmp_path / "gallery-repo"
+        (repo / "engines" / "myrec").mkdir(parents=True)
+        (repo / "engines" / "myrec" / "engine.json").write_text(
+            json.dumps({"id": "default", "engineFactory": "x:y"})
+        )
+        (repo / "engines" / "myrec" / "engine.py").write_text("# engine\n")
+        (repo / "README.md").write_text("gallery\n")
+
+        def git(*argv):
+            subprocess.run(
+                ["git", "-C", str(repo), *argv],
+                check=True, capture_output=True,
+                env={
+                    **os.environ,
+                    "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                    "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t",
+                },
+            )
+
+        git("init", "-q")
+        git("add", "-A")
+        git("commit", "-qm", "gallery")
+        if tag:
+            git("tag", tag)
+        return f"file://{repo}"
+
+    def test_template_get_from_git_url(self, cli, tmp_path):
+        url = self._make_git_repo(tmp_path)
+        dst = str(tmp_path / "fetched")
+        code, out, _ = cli(
+            "template", "get", url, dst,
+            "--subdir", "engines/myrec", "--engine-id", "mine",
+        )
+        assert code == 0
+        variant = json.loads(
+            (tmp_path / "fetched" / "engine.json").read_text()
+        )
+        assert variant["id"] == "mine"
+        assert (tmp_path / "fetched" / "engine.py").exists()
+        # the clone's metadata must not leak into the project
+        assert not (tmp_path / "fetched" / ".git").exists()
+
+    def test_template_get_git_whole_repo_and_ref(self, cli, tmp_path):
+        url = self._make_git_repo(tmp_path, tag="v1.0")
+        dst = str(tmp_path / "whole")
+        code, _out, _ = cli("template", "get", url, dst, "--ref", "v1.0")
+        assert code == 0
+        assert (tmp_path / "whole" / "README.md").exists()
+
+    def test_template_get_git_bad_ref(self, cli, tmp_path):
+        url = self._make_git_repo(tmp_path)
+        code, _, err = cli(
+            "template", "get", url, str(tmp_path / "x"),
+            "--ref", "no-such-tag",
+        )
+        assert code == 1 and "cannot fetch" in err
+
+    def test_template_get_git_bad_subdir(self, cli, tmp_path):
+        url = self._make_git_repo(tmp_path)
+        code, _, err = cli(
+            "template", "get", url, str(tmp_path / "x"),
+            "--subdir", "engines/nope",
+        )
+        assert code == 1 and "--subdir" in err
+
+    @pytest.mark.parametrize("subdir", ["../..", "/etc", "engines/../.."])
+    def test_template_get_subdir_confined_to_clone(
+        self, cli, tmp_path, subdir
+    ):
+        """An absolute or ../-traversing --subdir must not scaffold
+        from the host filesystem."""
+        url = self._make_git_repo(tmp_path)
+        code, _, err = cli(
+            "template", "get", url, str(tmp_path / "x"),
+            "--subdir", subdir,
+        )
+        assert code == 1 and "--subdir" in err
+        assert not (tmp_path / "x").exists()
+
+    def test_template_get_unreachable_url(self, cli, tmp_path):
+        code, _, err = cli(
+            "template", "get",
+            f"file://{tmp_path}/definitely-missing.git",
+            str(tmp_path / "x"),
+        )
+        assert code == 1 and "cannot fetch" in err
 
     def test_run(self, cli, tmp_path, monkeypatch):
         (tmp_path / "fakejob.py").write_text(

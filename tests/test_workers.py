@@ -86,6 +86,17 @@ def worker_group(tmp_path):
             port = int(line.rsplit(":", 1)[1])
             break
     assert port, "server never reported its port"
+
+    # keep draining the shared stdout/stderr pipe: with request logging
+    # on, a full 64 KB pipe buffer would block whichever worker logs
+    # next, hanging the group mid-test
+    def _drain():
+        for _ in proc.stdout:
+            pass
+
+    import threading
+
+    threading.Thread(target=_drain, daemon=True).start()
     # wait until requests are answered
     deadline = time.monotonic() + 30
     while time.monotonic() < deadline:
