@@ -53,12 +53,38 @@ TIMED_ROUNDS = 3
 BENCH_VERSION = "v3-driverproof"
 
 MAX_TPU_ATTEMPTS = 4
-RETRY_BACKOFF_S = (10.0, 30.0, 60.0)  # between attempts
+# Attempts are SPREAD over the budget window rather than burned in the
+# first ~12 minutes: round 4's tunnel outage consumed all 4 attempts in
+# 13 min and the tunnel came back later the same day. Override for
+# manual runs with PIO_BENCH_RETRY_BACKOFF_S=10,30,60.
+def _env_floats(name: str, default: str) -> tuple[float, ...]:
+    """Parse a comma-separated float env override; a malformed value
+    falls back to the default — the driver contract (one JSON line, rc
+    0) must survive a typo'd environment."""
+    raw = os.environ.get(name, default)
+    try:
+        vals = tuple(float(s) for s in raw.split(",") if s.strip())
+        if not vals:
+            raise ValueError(raw)
+        return vals
+    except ValueError:
+        print(
+            f"[bench] ignoring malformed {name}={raw!r}; "
+            f"using {default}",
+            file=sys.stderr,
+        )
+        return tuple(float(s) for s in default.split(","))
+
+
+RETRY_BACKOFF_S = _env_floats("PIO_BENCH_RETRY_BACKOFF_S", "120,300,600")
 WORKER_TIMEOUT_S = 900   # one worker run (compile ~40s + epochs)
 PREFLIGHT_TIMEOUT_S = 180  # tiny jit probe: a dead tunnel costs ≤3min,
 # not 900s (process start + jax import alone can take >90s on a loaded
 # single-core host — observed while the test suite ran concurrently)
-TOTAL_TPU_BUDGET_S = 1800  # stop retrying past this (hung-tunnel guard)
+TOTAL_TPU_BUDGET_S = _env_floats(
+    "PIO_BENCH_TPU_BUDGET_S", "2400"
+)[0]  # stop retrying past this (hung-tunnel guard); attempts land at
+# ~0 / 5 / 13 / 26 min of the window with the default backoff
 _RETRYABLE = (
     "UNAVAILABLE",
     "Unable to initialize backend",

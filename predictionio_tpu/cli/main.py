@@ -706,6 +706,14 @@ def cmd_template(args) -> int:
                     clone_tmp.cleanup()
                     return 1
         else:
+            if args.ref or args.subdir:
+                print(
+                    "error: --ref/--subdir apply only to git sources "
+                    f"({args.template!r} is a bundled name or local "
+                    "directory)",
+                    file=sys.stderr,
+                )
+                return 1
             src = args.template
             if not os.path.isdir(src):
                 src = os.path.join(_templates_dir(), args.template)
@@ -719,8 +727,11 @@ def cmd_template(args) -> int:
                 )
                 return 1
         try:
+            # symlinks=True: preserve links as links — dereferencing
+            # would let a hostile template repo copy arbitrary host
+            # files (e.g. a link to ~/.ssh) into the scaffold
             shutil.copytree(
-                src, dst, dirs_exist_ok=True,
+                src, dst, dirs_exist_ok=True, symlinks=True,
                 ignore=shutil.ignore_patterns("__pycache__", ".git"),
             )
         finally:

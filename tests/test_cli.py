@@ -511,6 +511,43 @@ class TestTemplateAndRun:
         assert code == 1 and "--subdir" in err
         assert not (tmp_path / "x").exists()
 
+    def test_template_get_ref_rejected_for_local_source(
+        self, cli, tmp_path
+    ):
+        code, _, err = cli(
+            "template", "get", "classification", str(tmp_path / "x"),
+            "--subdir", "sub",
+        )
+        assert code == 1 and "git sources" in err
+
+    def test_template_get_symlinks_not_dereferenced(self, cli, tmp_path):
+        """A hostile template repo must not exfiltrate host files via
+        symlinks: links are preserved as links, never followed."""
+        secret = tmp_path / "secret.txt"
+        secret.write_text("host-private")
+        url = self._make_git_repo(tmp_path)
+        repo = tmp_path / "gallery-repo"
+        os.symlink(str(secret), repo / "engines" / "myrec" / "leak")
+        import subprocess
+
+        subprocess.run(
+            ["git", "-C", str(repo), "add", "-A"],
+            check=True, capture_output=True,
+        )
+        subprocess.run(
+            ["git", "-C", str(repo), "-c", "user.name=t",
+             "-c", "user.email=t@t", "commit", "-qm", "link"],
+            check=True, capture_output=True,
+        )
+        dst = tmp_path / "linked"
+        code, _, _ = cli(
+            "template", "get", url, str(dst), "--subdir", "engines/myrec",
+        )
+        assert code == 0
+        # the scaffold carries the LINK itself, not a dereferenced copy
+        # of whatever it pointed at on the fetching host
+        assert os.path.islink(dst / "leak")
+
     def test_template_get_unreachable_url(self, cli, tmp_path):
         code, _, err = cli(
             "template", "get",
