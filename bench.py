@@ -64,7 +64,11 @@ def _env_floats(name: str, default: str) -> tuple[float, ...]:
     raw = os.environ.get(name, default)
     try:
         vals = tuple(float(s) for s in raw.split(",") if s.strip())
-        if not vals:
+        if not vals or any(
+            not (v >= 0) or v != v or v == float("inf") for v in vals
+        ):
+            # negative would crash time.sleep mid-run; nan/inf are
+            # equally driver-contract-breaking
             raise ValueError(raw)
         return vals
     except ValueError:
@@ -202,11 +206,20 @@ def run_epoch_bench(scale: str) -> dict:
         )
         _phase(f"round {r + 1}/{TIMED_ROUNDS}: "
                f"{times[-1]:.4f}s/epoch")
+    peak_hbm = None
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use")
+        if peak:
+            peak_hbm = round(peak / 2**30, 2)
+    except Exception:  # noqa: BLE001 - stats are best-effort per backend
+        pass
     return {
         "seconds": float(np.median(times)),
         "pack_seconds": round(pack_seconds, 3),
         "backend": jax.default_backend(),
         "workload": f"{n_users}x{n_items}x{nnz}@r{rank}",
+        "peak_hbm_gib": peak_hbm,
     }
 
 
@@ -407,6 +420,7 @@ def main() -> None:
                 "backend": result.get("backend"),
                 "workload": result.get("workload"),
                 "pack_seconds": result.get("pack_seconds"),
+                "peak_hbm_gib": result.get("peak_hbm_gib"),
                 "cpu_epoch_seconds": round(baseline, 4) if baseline else None,
                 "attempts": len(errors) + 1,
             },

@@ -740,9 +740,26 @@ def cmd_template(args) -> int:
         # personalize engine.json (the reference's scaffolding prompts,
         # Template.scala:226-369, taken from flags instead)
         variant_path = os.path.join(dst, "engine.json")
-        if args.engine_id and os.path.exists(variant_path):
-            with open(variant_path) as f:
-                variant = json.load(f)
+        if args.engine_id and os.path.lexists(variant_path):
+            if os.path.islink(variant_path):
+                # a hostile repo could ship engine.json as a symlink to
+                # a user-writable host file; writing through it would
+                # overwrite that file
+                print(
+                    "error: fetched engine.json is a symlink — refusing "
+                    "to personalize it; inspect the template",
+                    file=sys.stderr,
+                )
+                return 1
+            try:
+                with open(variant_path) as f:
+                    variant = json.load(f)
+            except (OSError, ValueError) as exc:
+                print(
+                    f"error: cannot personalize engine.json: {exc}",
+                    file=sys.stderr,
+                )
+                return 1
             variant["id"] = args.engine_id
             with open(variant_path, "w") as f:
                 json.dump(variant, f, indent=2)
