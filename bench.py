@@ -64,11 +64,9 @@ def _env_floats(name: str, default: str) -> tuple[float, ...]:
     raw = os.environ.get(name, default)
     try:
         vals = tuple(float(s) for s in raw.split(",") if s.strip())
-        if not vals or any(
-            not (v >= 0) or v != v or v == float("inf") for v in vals
-        ):
-            # negative would crash time.sleep mid-run; nan/inf are
-            # equally driver-contract-breaking
+        # negative would crash time.sleep mid-run; nan/inf are equally
+        # driver-contract-breaking (nan fails the same range check)
+        if not vals or any(not 0 <= v < float("inf") for v in vals):
             raise ValueError(raw)
         return vals
     except ValueError:

@@ -754,6 +754,10 @@ def cmd_template(args) -> int:
             try:
                 with open(variant_path) as f:
                     variant = json.load(f)
+                if not isinstance(variant, dict):
+                    raise ValueError(
+                        f"expected a JSON object, got {type(variant).__name__}"
+                    )
             except (OSError, ValueError) as exc:
                 print(
                     f"error: cannot personalize engine.json: {exc}",

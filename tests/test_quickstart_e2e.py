@@ -1,0 +1,150 @@
+"""Automated quickstart — the reference's manual end-to-end flow
+(SURVEY §4.7: `examples/*/data/import_eventserver.py` + `send_query.py`
+around `pio app new` / eventserver / train / deploy) run as a test, so
+the user-facing path cannot rot silently.
+
+Every step goes through the REAL public surface in subprocesses:
+console verbs, the example seed/query scripts unmodified, HTTP servers
+on real sockets, sqlite storage shared via the documented env vars.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_EXAMPLES = os.path.join(_REPO, "examples", "recommendation")
+
+
+@pytest.fixture()
+def env(tmp_path):
+    e = dict(os.environ)
+    e["PYTHONPATH"] = _REPO + os.pathsep + e.get("PYTHONPATH", "")
+    e.update({
+        # the 'listening on' banner must cross the pipe before
+        # serve_forever() — don't depend on the host env setting this
+        "PYTHONUNBUFFERED": "1",
+        "JAX_PLATFORMS": "cpu",
+        "PIO_STORAGE_SOURCES_SQL_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQL_PATH": str(tmp_path / "quickstart.sqlite"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQL",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQL",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQL",
+    })
+    return e
+
+
+def _pio(env, *argv, timeout=240) -> tuple[int, str, str]:
+    out = subprocess.run(
+        [sys.executable, "-m", "predictionio_tpu.cli.main", *argv],
+        env=env, capture_output=True, text=True, timeout=timeout,
+    )
+    return out.returncode, out.stdout, out.stderr
+
+
+def _spawn_server(env, *argv):
+    """Start a serving verb; returns (proc, port) parsed from its
+    'listening on' banner."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "predictionio_tpu.cli.main", *argv],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    port = None
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if "listening on" in line:
+            port = int(line.rsplit(":", 1)[1])
+            break
+        if proc.poll() is not None:
+            break
+    assert port, "server never reported its port"
+
+    # drain the log pipe so request logging can't block the server
+    import threading
+
+    threading.Thread(
+        target=lambda: [None for _ in proc.stdout], daemon=True
+    ).start()
+    return proc, port
+
+
+def _stop(proc) -> None:
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def test_recommendation_quickstart(env, tmp_path):
+    # 1. create the app exactly as the quickstart does
+    rc, out, err = _pio(env, "app", "new", "MyRecApp")
+    assert rc == 0, err
+    key = re.search(r"Access Key:\s*(\S+)", out).group(1)
+
+    # 2. event server up; seed through the UNMODIFIED example script
+    es, es_port = _spawn_server(
+        env, "eventserver", "--ip", "127.0.0.1", "--port", "0"
+    )
+    try:
+        seed = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(_EXAMPLES, "import_eventserver.py"),
+                "--access-key", key,
+                "--url", f"http://127.0.0.1:{es_port}",
+                "--users", "40", "--items", "20",
+            ],
+            env=env, capture_output=True, text=True, timeout=240,
+        )
+        assert seed.returncode == 0, seed.stderr
+        assert "events imported" in seed.stdout
+    finally:
+        _stop(es)
+
+    # 3. train through the console against the example engine.json
+    variant = os.path.join(_EXAMPLES, "engine.json")
+    rc, out, err = _pio(env, "train", "--variant", variant, timeout=600)
+    assert rc == 0, err
+    assert "Training completed" in out
+
+    # 4. deploy; 5. query through the UNMODIFIED example script
+    srv, srv_port = _spawn_server(
+        env, "deploy", "--variant", variant,
+        "--ip", "127.0.0.1", "--port", "0",
+    )
+    try:
+        q = subprocess.run(
+            [
+                sys.executable, os.path.join(_EXAMPLES, "send_query.py"),
+                "--url", f"http://127.0.0.1:{srv_port}",
+                "--user", "u0", "--num", "4",
+            ],
+            env=env, capture_output=True, text=True, timeout=240,
+        )
+        assert q.returncode == 0, q.stderr
+        result = json.loads(q.stdout)
+        scores = result["itemScores"]
+        assert len(scores) == 4
+        # the seed plants two taste clusters: u0 likes even items, so
+        # its top-4 must be predominantly even-indexed
+        even = sum(1 for s in scores if int(s["item"][1:]) % 2 == 0)
+        assert even >= 3, scores
+    finally:
+        _stop(srv)
+
+    # 6. the system-readiness probe passes with this storage config
+    rc, out, _err = _pio(env, "status")
+    assert rc == 0
+    assert "ready to go" in out

@@ -61,6 +61,8 @@ def worker_group(tmp_path):
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
     env.update({
+        # the banner must cross the pipe before serve_forever()
+        "PYTHONUNBUFFERED": "1",
         "PIO_STORAGE_SOURCES_SQL_TYPE": "sqlite",
         "PIO_STORAGE_SOURCES_SQL_PATH": str(tmp_path / "ev.sqlite"),
         "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQL",
