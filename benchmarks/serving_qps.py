@@ -97,11 +97,15 @@ def build_server(storage, rank: int, host: str):
     return server, http_srv
 
 
-def _client_proc(host, port, n_users, seconds, conns_per_proc, seed, out_q):
+def _client_proc(
+    host, port, n_users, seconds, conns_per_proc, seed, out_q,
+    http_batch: int = 1,
+):
     """One client process running several keep-alive connection threads.
 
     Clients live in separate processes so their Python work does not
-    share the GIL with the server under test."""
+    share the GIL with the server under test. ``http_batch > 1`` posts
+    that many queries per round trip via ``/batch/queries.json``."""
     counts = [0] * conns_per_proc
     errors = [0] * conns_per_proc
     lat: list[list[float]] = [[] for _ in range(conns_per_proc)]
@@ -111,22 +115,37 @@ def _client_proc(host, port, n_users, seconds, conns_per_proc, seed, out_q):
         conn = http.client.HTTPConnection(host, port, timeout=30)
         rng = np.random.default_rng(seed * 1000 + w)
         while time.perf_counter() < stop_at:
-            body = json.dumps(
-                {"user": f"u{rng.integers(0, n_users)}", "num": 10}
-            )
+            if http_batch > 1:
+                body = json.dumps([
+                    {"user": f"u{rng.integers(0, n_users)}", "num": 10}
+                    for _ in range(http_batch)
+                ])
+                path = "/batch/queries.json"
+            else:
+                body = json.dumps(
+                    {"user": f"u{rng.integers(0, n_users)}", "num": 10}
+                )
+                path = "/queries.json"
             t0 = time.perf_counter()
             try:
                 conn.request(
-                    "POST", "/queries.json", body,
+                    "POST", path, body,
                     {"Content-Type": "application/json"},
                 )
                 resp = conn.getresponse()
                 data = resp.read()
-                if resp.status == 200 and b"itemScores" in data:
-                    counts[w] += 1
+                if resp.status != 200 or b"itemScores" not in data:
+                    # a wholesale failure costs every query in the batch
+                    errors[w] += http_batch
+                elif http_batch > 1:
+                    slots = json.loads(data)
+                    good = sum(1 for s in slots if s["status"] == 200)
+                    counts[w] += good
+                    errors[w] += len(slots) - good
                     lat[w].append(time.perf_counter() - t0)
                 else:
-                    errors[w] += 1
+                    counts[w] += 1
+                    lat[w].append(time.perf_counter() - t0)
             except Exception:
                 errors[w] += 1
                 conn.close()
@@ -151,6 +170,7 @@ def drive(
     seconds: float,
     clients: int,
     procs: int = 16,
+    http_batch: int = 1,
 ):
     """Multi-process client swarm; returns (ok, errors, latencies, s)."""
     import multiprocessing as mp
@@ -162,7 +182,9 @@ def drive(
     ps = [
         ctx.Process(
             target=_client_proc,
-            args=(host, port, n_users, seconds, per, i, out_q),
+            args=(
+                host, port, n_users, seconds, per, i, out_q, http_batch
+            ),
         )
         for i in range(procs)
     ]
@@ -239,7 +261,13 @@ def main() -> int:
         "--mode", choices=["http", "device"], default="http",
         help="http = full stack; device = batched predict only",
     )
+    ap.add_argument(
+        "--http-batch", dest="http_batch", type=int, default=1,
+        help="queries per HTTP round trip (>1 uses /batch/queries.json)",
+    )
     args = ap.parse_args()
+    if not 1 <= args.http_batch <= 100:
+        ap.error("--http-batch must be 1..100 (the server's batch cap)")
 
     storage = seed_storage(args.users, args.items)
     if args.mode == "device":
@@ -266,6 +294,7 @@ def main() -> int:
         ok, errs, lats, elapsed = drive(
             "127.0.0.1", http_srv.port, args.users,
             args.seconds, args.clients,
+            http_batch=args.http_batch,
         )
     finally:
         http_srv.shutdown()
@@ -284,6 +313,7 @@ def main() -> int:
                 "p50_ms": round(p50, 2),
                 "p99_ms": round(p99, 2),
                 "clients": args.clients,
+                "http_batch": args.http_batch,
             }
         )
     )

@@ -9,6 +9,14 @@ Capability parity with the reference's ServerActor/MasterActor
   ``serving.supplement`` → per-algorithm predict → ``serving.serve`` →
   JSON; optional feedback loop storing a ``predict`` event with a
   ``prId`` (entity type ``pio_pr``, :539-600); latency bookkeeping
+* ``POST /batch/queries.json`` → many queries in one HTTP round trip
+  with per-query statuses (shape mirrors the event API's
+  ``/batch/events.json``). TPU-first extension with no reference
+  counterpart: the Python HTTP tier costs ~1 ms/request on a host
+  core while the batched device path serves tens of thousands of
+  predictions per second — batching amortizes the HTTP tier away and
+  the submitted queries coalesce in the micro-batcher into full
+  device dispatches
 * ``POST /reload``       → hot-swap to the latest COMPLETED instance
   (MasterActor :337-363)
 * ``POST /stop``         → undeploy (Console.undeploy posts here, :905-932)
@@ -32,6 +40,7 @@ import time
 import urllib.error
 import urllib.parse
 import urllib.request
+from typing import Any
 
 from predictionio_tpu.core.engine import Engine, EngineParams
 from predictionio_tpu.core.workflow import load_deployment
@@ -129,6 +138,9 @@ class EngineServer:
         self.router = Router()
         self.router.route("GET", "/", self._status)
         self.router.route("POST", "/queries.json", self._queries)
+        self.router.route(
+            "POST", "/batch/queries.json", self._batch_queries
+        )
         self.router.route("POST", "/reload", self._reload)
         self.router.route("POST", "/stop", self._stop)
         install_plugin_routes(self.router, self._plugins, OUTPUT_SNIFFER)
@@ -408,24 +420,7 @@ class EngineServer:
             break
         else:
             raise HTTPError(503, "server is reloading; retry")
-        predictions = [
-            f.result(timeout=self._predict_timeout_s) for f in futures
-        ]
-        prediction = serving.serve(supplemented, predictions)
-
-        if self._feedback:
-            prediction = self._record_feedback(query, prediction)
-
-        # plugin output blockers fold (CreateServer.scala:603-606)
-        engine_info = {
-            "engineId": self._engine_id,
-            "engineVersion": self._engine_version,
-            "engineVariant": self._engine_variant,
-        }
-        prediction = self._plugins.block_output(
-            engine_info, query, prediction
-        )
-        self._plugins.sniff_output(engine_info, query, prediction)
+        prediction = self._serve_one(serving, query, supplemented, futures)
 
         elapsed = time.perf_counter() - t0
         with self._lock:
@@ -435,6 +430,131 @@ class EngineServer:
                 elapsed - self._avg_serving_sec
             ) / self._request_count
         return Response(200, prediction)
+
+    def _serve_one(self, serving, query, supplemented, futures):
+        """Collect one query's per-algorithm futures and run the shared
+        tail of the predict pipeline: serve → feedback → plugin
+        block/sniff (CreateServer.scala:603-606). Used by the single and
+        the batch routes so their semantics cannot diverge."""
+        predictions = [
+            f.result(timeout=self._predict_timeout_s) for f in futures
+        ]
+        prediction = serving.serve(supplemented, predictions)
+        if self._feedback:
+            prediction = self._record_feedback(query, prediction)
+        engine_info = {
+            "engineId": self._engine_id,
+            "engineVersion": self._engine_version,
+            "engineVariant": self._engine_variant,
+        }
+        prediction = self._plugins.block_output(
+            engine_info, query, prediction
+        )
+        self._plugins.sniff_output(engine_info, query, prediction)
+        return prediction
+
+    #: queries per /batch/queries.json call — generous relative to the
+    #: event API's 50 (a query is one dict; responses dominate the
+    #: payload), still bounding a single request's memory
+    MAX_QUERY_BATCH = 100
+
+    def _batch_queries(self, request: Request) -> Response:
+        """Many queries, one HTTP round trip, per-query statuses.
+
+        All queries are SUBMITTED to the micro-batchers before any
+        result is collected, so a batch fills device dispatches instead
+        of serializing one query per dispatch."""
+        t0 = time.perf_counter()
+        payload = request.json()
+        if not isinstance(payload, list):
+            raise HTTPError(400, "batch must be a JSON array of queries")
+        if len(payload) > self.MAX_QUERY_BATCH:
+            raise HTTPError(
+                400,
+                f"batch too large: {len(payload)} queries "
+                f"(max {self.MAX_QUERY_BATCH})",
+            )
+        with self._lock:
+            serving = self._serving
+            batchers = self._batchers
+        # submit phase — per-query outcome slots: ("ok", supplemented,
+        # futures) | ("bad"|"shed"|"reloading", None, None) |
+        # ("error", exc, None)
+        entries: list[tuple[str, Any, list | None]] = []
+        reloading = False
+        for q in payload:
+            if reloading:
+                # /reload closed the snapshot's batchers mid-submit.
+                # close() is graceful (already-submitted items still
+                # complete), so earlier slots stay valid; resubmitting
+                # them would double-dispatch — the remaining slots
+                # simply report the reload instead
+                entries.append(("reloading", None, None))
+                continue
+            if not isinstance(q, dict):
+                entries.append(("bad", None, None))
+                continue
+            try:
+                supplemented = serving.supplement(q)
+            except Exception as exc:  # noqa: BLE001 - per-slot status
+                entries.append(("error", exc, None))
+                continue
+            try:
+                futures = [b.submit(supplemented) for b in batchers]
+            except BatcherOverloaded:
+                entries.append(("shed", None, None))
+                continue
+            except RuntimeError:
+                reloading = True
+                entries.append(("reloading", None, None))
+                continue
+            entries.append(("ok", supplemented, futures))
+
+        results = []
+        logged = False  # one remote report per batch, not per slot
+        for (state, data, futures), q in zip(entries, payload):
+            if state == "bad":
+                results.append(
+                    {"status": 400,
+                     "message": "query must be a JSON object"}
+                )
+                continue
+            if state == "shed":
+                results.append(
+                    {"status": 503,
+                     "message": "server overloaded; retry later"}
+                )
+                continue
+            if state == "reloading":
+                results.append(
+                    {"status": 503,
+                     "message": "server is reloading; retry"}
+                )
+                continue
+            if state == "error":
+                if self._log_queue is not None and not logged:
+                    self._post_remote_log(data, request)
+                    logged = True
+                results.append({"status": 500, "message": str(data)})
+                continue
+            try:
+                prediction = self._serve_one(serving, q, data, futures)
+                results.append({"status": 200, "prediction": prediction})
+            except Exception as exc:  # noqa: BLE001 - per-slot status
+                if self._log_queue is not None and not logged:
+                    self._post_remote_log(exc, request)
+                    logged = True
+                results.append({"status": 500, "message": str(exc)})
+
+        elapsed = time.perf_counter() - t0
+        n = len(payload)
+        with self._lock:
+            self._request_count += n
+            self._last_serving_sec = elapsed / max(n, 1)
+            self._avg_serving_sec += (
+                elapsed / max(n, 1) - self._avg_serving_sec
+            ) * n / self._request_count
+        return Response(200, results)
 
     def _record_feedback(self, query: dict, prediction):
         """Store a ``predict`` event (entity ``pio_pr``) carrying query +

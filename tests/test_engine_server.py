@@ -173,6 +173,102 @@ class TestEngineServer:
         assert status == 200 and body["status"] == "alive"
 
 
+class TestBatchQueries:
+    def test_batch_roundtrip_per_query_results(self, server):
+        base, _, _ = server
+        status, body = _call(
+            f"{base}/batch/queries.json", "POST",
+            [{"x": 1}, {"x": 2}, {"x": 3}],
+        )
+        assert status == 200
+        assert [r["status"] for r in body] == [200, 200, 200]
+        assert [r["prediction"]["result"] for r in body] == [31, 32, 33]
+
+    def test_batch_matches_single_query_path(self, server):
+        base, _, _ = server
+        _, single = _call(f"{base}/queries.json", "POST", {"x": 9})
+        _, batch = _call(
+            f"{base}/batch/queries.json", "POST", [{"x": 9}]
+        )
+        # feedback injects a fresh prId per call; everything else equal
+        single.pop("prId", None)
+        got = batch[0]["prediction"]
+        got.pop("prId", None)
+        assert got == single
+
+    def test_bad_slot_keeps_per_query_status(self, server):
+        base, _, _ = server
+        status, body = _call(
+            f"{base}/batch/queries.json", "POST",
+            [{"x": 1}, "not-a-query", {"x": 2}],
+        )
+        assert status == 200
+        assert [r["status"] for r in body] == [200, 400, 200]
+        assert "JSON object" in body[1]["message"]
+
+    def test_non_array_rejected(self, server):
+        base, _, _ = server
+        status, body = _call(
+            f"{base}/batch/queries.json", "POST", {"x": 1}
+        )
+        assert status == 400
+        assert "array" in body["message"]
+
+    def test_batch_limit(self, server):
+        base, _, _ = server
+        status, body = _call(
+            f"{base}/batch/queries.json", "POST",
+            [{"x": i} for i in range(101)],
+        )
+        assert status == 400
+        assert "100" in body["message"]
+
+    def test_batch_counts_toward_stats(self, server):
+        base, _, _ = server
+        _, before = _call(f"{base}/")
+        _call(
+            f"{base}/batch/queries.json", "POST",
+            [{"x": i} for i in range(5)],
+        )
+        _, after = _call(f"{base}/")
+        assert after["requestCount"] == before["requestCount"] + 5
+
+    def test_supplement_error_stays_per_slot(self, server, monkeypatch):
+        """A serving.supplement that rejects one query must produce a
+        500 in THAT slot only — not reclassify the batch as a reload or
+        abandon the other slots."""
+        base, es, _ = server
+        original = es._serving.supplement
+
+        def picky(query):
+            if query.get("x") == 13:
+                raise ValueError("unlucky query")
+            return original(query)
+
+        monkeypatch.setattr(es._serving, "supplement", picky)
+        status, body = _call(
+            f"{base}/batch/queries.json", "POST",
+            [{"x": 1}, {"x": 13}, {"x": 2}],
+        )
+        assert status == 200
+        assert [r["status"] for r in body] == [200, 500, 200]
+        assert "unlucky" in body[1]["message"]
+
+    def test_batch_feedback_events_recorded(self, server):
+        base, _, storage = server
+        before = len(list(
+            storage.get_events().find(1, entity_type="pio_pr")
+        ))
+        _, body = _call(
+            f"{base}/batch/queries.json", "POST", [{"x": 1}, {"x": 2}]
+        )
+        assert all("prId" in r["prediction"] for r in body)
+        after = len(list(
+            storage.get_events().find(1, entity_type="pio_pr")
+        ))
+        assert after == before + 2
+
+
 class TestBindAndUndeploy:
     def test_undeploy_before_deploy_stops_old_server(
         self, ctx, memory_storage
