@@ -12,11 +12,11 @@ Capability parity with the reference's ServerActor/MasterActor
 * ``POST /batch/queries.json`` → many queries in one HTTP round trip
   with per-query statuses (shape mirrors the event API's
   ``/batch/events.json``). TPU-first extension with no reference
-  counterpart: the Python HTTP tier costs ~1 ms/request on a host
-  core while the batched device path serves tens of thousands of
-  predictions per second — batching amortizes the HTTP tier away and
-  the submitted queries coalesce in the micro-batcher into full
-  device dispatches
+  counterpart: the Python HTTP tier costs ~3.5 ms/request on a host
+  core (BASELINE.md) while the batched device path serves tens of
+  thousands of predictions per second — batching amortizes the HTTP
+  tier away and the submitted queries coalesce in the micro-batcher
+  into full device dispatches
 * ``POST /reload``       → hot-swap to the latest COMPLETED instance
   (MasterActor :337-363)
 * ``POST /stop``         → undeploy (Console.undeploy posts here, :905-932)
@@ -338,8 +338,14 @@ class EngineServer:
 </html>"""
 
     def _queries(self, request: Request) -> Response:
+        return self._with_remote_log(self._queries_inner, request)
+
+    def _batch_queries(self, request: Request) -> Response:
+        return self._with_remote_log(self._batch_queries_inner, request)
+
+    def _with_remote_log(self, handler, request: Request) -> Response:
         try:
-            return self._queries_inner(request)
+            return handler(request)
         except Exception as exc:
             # remote error log (reference CreateServer.scala:446-457,
             # --log-url/--log-prefix): ship serving failures to a
@@ -431,13 +437,21 @@ class EngineServer:
             ) / self._request_count
         return Response(200, prediction)
 
-    def _serve_one(self, serving, query, supplemented, futures):
+    def _serve_one(self, serving, query, supplemented, futures,
+                   deadline: float | None = None):
         """Collect one query's per-algorithm futures and run the shared
         tail of the predict pipeline: serve → feedback → plugin
         block/sniff (CreateServer.scala:603-606). Used by the single and
-        the batch routes so their semantics cannot diverge."""
+        the batch routes so their semantics cannot diverge.
+
+        ``deadline`` (a ``time.monotonic()`` value) bounds the TOTAL
+        wait across all futures; default is one predict timeout from
+        now."""
+        if deadline is None:
+            deadline = time.monotonic() + self._predict_timeout_s
         predictions = [
-            f.result(timeout=self._predict_timeout_s) for f in futures
+            f.result(timeout=max(0.001, deadline - time.monotonic()))
+            for f in futures
         ]
         prediction = serving.serve(supplemented, predictions)
         if self._feedback:
@@ -458,7 +472,7 @@ class EngineServer:
     #: payload), still bounding a single request's memory
     MAX_QUERY_BATCH = 100
 
-    def _batch_queries(self, request: Request) -> Response:
+    def _batch_queries_inner(self, request: Request) -> Response:
         """Many queries, one HTTP round trip, per-query statuses.
 
         All queries are SUBMITTED to the micro-batchers before any
@@ -474,41 +488,23 @@ class EngineServer:
                 f"batch too large: {len(payload)} queries "
                 f"(max {self.MAX_QUERY_BATCH})",
             )
-        with self._lock:
-            serving = self._serving
-            batchers = self._batchers
-        # submit phase — per-query outcome slots: ("ok", supplemented,
-        # futures) | ("bad"|"shed"|"reloading", None, None) |
-        # ("error", exc, None)
-        entries: list[tuple[str, Any, list | None]] = []
-        reloading = False
-        for q in payload:
-            if reloading:
-                # /reload closed the snapshot's batchers mid-submit.
-                # close() is graceful (already-submitted items still
-                # complete), so earlier slots stay valid; resubmitting
-                # them would double-dispatch — the remaining slots
-                # simply report the reload instead
-                entries.append(("reloading", None, None))
-                continue
-            if not isinstance(q, dict):
-                entries.append(("bad", None, None))
-                continue
-            try:
-                supplemented = serving.supplement(q)
-            except Exception as exc:  # noqa: BLE001 - per-slot status
-                entries.append(("error", exc, None))
-                continue
-            try:
-                futures = [b.submit(supplemented) for b in batchers]
-            except BatcherOverloaded:
-                entries.append(("shed", None, None))
-                continue
-            except RuntimeError:
-                reloading = True
-                entries.append(("reloading", None, None))
-                continue
-            entries.append(("ok", supplemented, futures))
+        if not payload:
+            return Response(200, [])
+        for _attempt in range(2):
+            with self._lock:
+                serving = self._serving
+                batchers = self._batchers
+            entries = self._submit_batch(serving, batchers, payload)
+            if any(e[0] == "ok" for e in entries) or not any(
+                e[0] == "reloading" for e in entries
+            ):
+                break
+            # a /reload raced us before ANY slot was accepted: nothing
+            # was dispatched, so retrying against the fresh batchers is
+            # safe (mirrors the single-query path's retry)
+        # one deadline for the WHOLE batch: a hung dispatch must not
+        # hold the connection for N sequential predict timeouts
+        deadline = time.monotonic() + self._predict_timeout_s
 
         results = []
         logged = False  # one remote report per batch, not per slot
@@ -538,7 +534,9 @@ class EngineServer:
                 results.append({"status": 500, "message": str(data)})
                 continue
             try:
-                prediction = self._serve_one(serving, q, data, futures)
+                prediction = self._serve_one(
+                    serving, q, data, futures, deadline=deadline
+                )
                 results.append({"status": 200, "prediction": prediction})
             except Exception as exc:  # noqa: BLE001 - per-slot status
                 if self._log_queue is not None and not logged:
@@ -550,11 +548,47 @@ class EngineServer:
         n = len(payload)
         with self._lock:
             self._request_count += n
-            self._last_serving_sec = elapsed / max(n, 1)
+            self._last_serving_sec = elapsed / n
             self._avg_serving_sec += (
-                elapsed / max(n, 1) - self._avg_serving_sec
+                elapsed / n - self._avg_serving_sec
             ) * n / self._request_count
         return Response(200, results)
+
+    def _submit_batch(self, serving, batchers, payload) -> list[tuple]:
+        """Submit every query; per-query outcome slots:
+        ``("ok", supplemented, futures)`` |
+        ``("bad"|"shed"|"reloading", None, None)`` |
+        ``("error", exc, None)``."""
+        entries: list[tuple[str, Any, list | None]] = []
+        reloading = False
+        for q in payload:
+            if reloading:
+                # /reload closed the snapshot's batchers mid-submit.
+                # close() is graceful (already-submitted items still
+                # complete), so earlier slots stay valid; resubmitting
+                # them would double-dispatch — the remaining slots
+                # simply report the reload instead
+                entries.append(("reloading", None, None))
+                continue
+            if not isinstance(q, dict):
+                entries.append(("bad", None, None))
+                continue
+            try:
+                supplemented = serving.supplement(q)
+            except Exception as exc:  # noqa: BLE001 - per-slot status
+                entries.append(("error", exc, None))
+                continue
+            try:
+                futures = [b.submit(supplemented) for b in batchers]
+            except BatcherOverloaded:
+                entries.append(("shed", None, None))
+                continue
+            except RuntimeError:
+                reloading = True
+                entries.append(("reloading", None, None))
+                continue
+            entries.append(("ok", supplemented, futures))
+        return entries
 
     def _record_feedback(self, query: dict, prediction):
         """Store a ``predict`` event (entity ``pio_pr``) carrying query +

@@ -100,6 +100,11 @@ class TestEngineClient:
             client = EngineClient(f"http://127.0.0.1:{http.port}")
             assert client.status()["engineId"] == "sdk"
             assert client.send_query({"x": 5}) == {"result": 35}
+            slots = client.send_batch_queries([{"x": 1}, {"x": 2}])
+            assert [s["status"] for s in slots] == [200, 200]
+            assert [
+                s["prediction"]["result"] for s in slots
+            ] == [31, 32]
         finally:
             http.shutdown()
             es.close()

@@ -233,6 +233,13 @@ class TestBatchQueries:
         _, after = _call(f"{base}/")
         assert after["requestCount"] == before["requestCount"] + 5
 
+    def test_empty_batch_is_empty_list(self, server):
+        """[] on a fresh server must not divide by a zero request
+        count in the stats update."""
+        base, _, _ = server
+        status, body = _call(f"{base}/batch/queries.json", "POST", [])
+        assert status == 200 and body == []
+
     def test_supplement_error_stays_per_slot(self, server, monkeypatch):
         """A serving.supplement that rejects one query must produce a
         500 in THAT slot only — not reclassify the batch as a reload or
