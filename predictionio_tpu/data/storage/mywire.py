@@ -159,8 +159,14 @@ def quote(value: Any) -> str:
         return f"x'{bytes(value).hex()}'"
     if isinstance(value, str):
         # backslash is an escape character in MySQL's default sql_mode;
-        # doubling the quote is understood in every mode
-        return "'" + value.replace("\\", "\\\\").replace("'", "''") + "'"
+        # doubling the quote is understood in every mode. NUL must be
+        # escaped (raw 0x00 ends the statement for most servers; note
+        # the sqlite-backed minimysql cannot store NUL either way)
+        return "'" + (
+            value.replace("\\", "\\\\")
+            .replace("\x00", "\\0")
+            .replace("'", "''")
+        ) + "'"
     raise ProgrammingError(f"cannot adapt parameter of type {type(value)}")
 
 

@@ -494,13 +494,16 @@ class EngineServer:
             with self._lock:
                 serving = self._serving
                 batchers = self._batchers
-            entries = self._submit_batch(serving, batchers, payload)
-            if any(e[0] == "ok" for e in entries) or not any(
+            entries, any_submitted = self._submit_batch(
+                serving, batchers, payload
+            )
+            if any_submitted or not any(
                 e[0] == "reloading" for e in entries
             ):
                 break
-            # a /reload raced us before ANY slot was accepted: nothing
-            # was dispatched, so retrying against the fresh batchers is
+            # a /reload raced us before ANY submit was accepted (not
+            # even a partial multi-algorithm one): nothing was
+            # dispatched, so retrying against the fresh batchers is
             # safe (mirrors the single-query path's retry)
         # one deadline for the WHOLE batch: a hung dispatch must not
         # hold the connection for N sequential predict timeouts
@@ -554,20 +557,26 @@ class EngineServer:
             ) * n / self._request_count
         return Response(200, results)
 
-    def _submit_batch(self, serving, batchers, payload) -> list[tuple]:
-        """Submit every query; per-query outcome slots:
-        ``("ok", supplemented, futures)`` |
+    def _submit_batch(
+        self, serving, batchers, payload
+    ) -> tuple[list[tuple], bool]:
+        """Submit every query; returns (slots, any_submitted).
+
+        Slots: ``("ok", supplemented, futures)`` |
         ``("bad"|"shed"|"reloading", None, None)`` |
-        ``("error", exc, None)``."""
+        ``("error", exc, None)``. ``any_submitted`` is True once ANY
+        ``submit`` was accepted — including a partial multi-algorithm
+        slot whose later batcher then raised — which is exactly the
+        condition under which a whole-batch retry would double-dispatch
+        (close() is graceful: accepted items still run)."""
         entries: list[tuple[str, Any, list | None]] = []
         reloading = False
+        any_submitted = False
         for q in payload:
             if reloading:
-                # /reload closed the snapshot's batchers mid-submit.
-                # close() is graceful (already-submitted items still
-                # complete), so earlier slots stay valid; resubmitting
-                # them would double-dispatch — the remaining slots
-                # simply report the reload instead
+                # /reload closed the snapshot's batchers mid-submit;
+                # earlier accepted slots stay valid (graceful close) —
+                # the remaining slots simply report the reload
                 entries.append(("reloading", None, None))
                 continue
             if not isinstance(q, dict):
@@ -578,8 +587,11 @@ class EngineServer:
             except Exception as exc:  # noqa: BLE001 - per-slot status
                 entries.append(("error", exc, None))
                 continue
+            futures = []
             try:
-                futures = [b.submit(supplemented) for b in batchers]
+                for b in batchers:
+                    futures.append(b.submit(supplemented))
+                    any_submitted = True
             except BatcherOverloaded:
                 entries.append(("shed", None, None))
                 continue
@@ -588,7 +600,7 @@ class EngineServer:
                 entries.append(("reloading", None, None))
                 continue
             entries.append(("ok", supplemented, futures))
-        return entries
+        return entries, any_submitted
 
     def _record_feedback(self, query: dict, prediction):
         """Store a ``predict`` event (entity ``pio_pr``) carrying query +
