@@ -1,0 +1,56 @@
+"""Seed the complementary-purchase quickstart with basketed buy events
+(gallery-parity counterpart of the reference examples' seed scripts,
+e.g. examples/scala-parallel-similarproduct/*/data/import_eventserver.py).
+
+Usage:
+    pio-tpu app new MyCPApp           # note the access key
+    pio-tpu eventserver &             # default :7070
+    python import_eventserver.py --access-key <KEY> [--url http://...:7070]
+"""
+
+import argparse
+import datetime as dt
+import random
+
+from predictionio_tpu.client import EventClient
+
+#: planted regularities the quickstart query can show off
+BASKET_PATTERNS = [
+    ("bread", "butter", "jam"),
+    ("pasta", "tomato-sauce", "parmesan"),
+    ("chips", "salsa"),
+]
+SOLO_ITEMS = ["beer", "water", "apples"]
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--access-key", required=True)
+    parser.add_argument("--url", default="http://127.0.0.1:7070")
+    parser.add_argument("--users", type=int, default=60)
+    args = parser.parse_args()
+
+    client = EventClient(args.access_key, args.url)
+    random.seed(11)
+    base = dt.datetime(2026, 1, 1, 9, 0, tzinfo=dt.timezone.utc)
+    count = 0
+    for u in range(args.users):
+        pattern = BASKET_PATTERNS[u % len(BASKET_PATTERNS)]
+        t = base + dt.timedelta(days=u)
+        for minute, item in enumerate(pattern):
+            client.record_user_action_on_item(
+                "buy", f"u{u}", item,
+                event_time=t + dt.timedelta(minutes=minute),
+            )
+            count += 1
+        solo = random.choice(SOLO_ITEMS)
+        client.record_user_action_on_item(
+            "buy", f"u{u}", solo,
+            event_time=t + dt.timedelta(hours=6),  # its own basket
+        )
+        count += 1
+    print(f"{count} events imported.")
+
+
+if __name__ == "__main__":
+    main()

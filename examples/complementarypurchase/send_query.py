@@ -1,0 +1,25 @@
+"""Query the deployed complementary-purchase engine.
+
+Usage: python send_query.py [--url http://127.0.0.1:8000] [--items bread]
+"""
+
+import argparse
+import json
+
+from predictionio_tpu.client import EngineClient
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--url", default="http://127.0.0.1:8000")
+    parser.add_argument("--items", nargs="+", default=["bread"])
+    parser.add_argument("--num", type=int, default=3)
+    args = parser.parse_args()
+    result = EngineClient(args.url).send_query(
+        {"items": args.items, "num": args.num}
+    )
+    print(json.dumps(result, indent=2))
+
+
+if __name__ == "__main__":
+    main()

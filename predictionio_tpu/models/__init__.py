@@ -42,3 +42,9 @@ try:
     _TEMPLATES.append("regression")
 except ImportError:  # pragma: no cover
     pass
+try:
+    from predictionio_tpu.models import complementarypurchase  # noqa: F401
+
+    _TEMPLATES.append("complementarypurchase")
+except ImportError:  # pragma: no cover
+    pass

@@ -1,0 +1,219 @@
+"""Complementary-purchase template (gallery parity: basket analysis
+over buy events; TPU path: chunked multi-hot BᵀB co-occurrence +
+lift/confidence + top-k)."""
+
+from __future__ import annotations
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import Event
+from predictionio_tpu.data.storage import App
+from predictionio_tpu.models.complementarypurchase import (
+    CPAlgoParams,
+    CPAlgorithm,
+    CPDataSource,
+    CPDataSourceParams,
+    CPTrainingData,
+    complementarypurchase_engine,
+)
+from predictionio_tpu.parallel.mesh import ComputeContext
+from predictionio_tpu.utils.bimap import BiMap
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return ComputeContext.create(batch="cp-test")
+
+
+def _buy(user, item, minute):
+    return Event(
+        event="buy",
+        entity_type="user",
+        entity_id=user,
+        target_entity_type="item",
+        target_entity_id=item,
+        event_time=dt.datetime(2026, 1, 1, 12, minute,
+                               tzinfo=dt.timezone.utc),
+    )
+
+
+def _seed(storage, app_name="CPApp"):
+    """20 users buy bread+butter together; 10 buy beer alone; one user
+    buys milk twice in sessions far apart (window split)."""
+    app_id = storage.get_meta_data_apps().insert(App(id=0, name=app_name))
+    events = storage.get_events()
+    events.init(app_id)
+    batch = []
+    for u in range(20):
+        batch.append(_buy(f"u{u}", "bread", 0))
+        batch.append(_buy(f"u{u}", "butter", 1))
+    for u in range(20, 30):
+        batch.append(_buy(f"u{u}", "beer", 0))
+    # one-off noise pair below min_support
+    batch.append(_buy("u40", "bread", 2))
+    batch.append(_buy("u40", "caviar", 3))
+    events.insert_batch(batch, app_id)
+    return app_id
+
+
+def _train(ctx, storage, algo_params=CPAlgoParams(), ds_params=None):
+    ds = CPDataSource(
+        ds_params or CPDataSourceParams(app_name="CPApp")
+    )
+    data = ds.read_training(ctx)
+    data.sanity_check()
+    return CPAlgorithm(algo_params).train(ctx, data)
+
+
+class TestBasketing:
+    def test_window_splits_baskets(self, ctx, memory_storage):
+        _seed(memory_storage)
+        # same user, purchases 2 hours apart: two baskets
+        events = memory_storage.get_events()
+        app_id = memory_storage.get_meta_data_apps().get_by_name(
+            "CPApp"
+        ).id
+        events.insert(
+            Event(
+                event="buy", entity_type="user", entity_id="u99",
+                target_entity_type="item", target_entity_id="milk",
+                event_time=dt.datetime(2026, 1, 2, 9, 0,
+                                       tzinfo=dt.timezone.utc),
+            ),
+            app_id,
+        )
+        events.insert(
+            Event(
+                event="buy", entity_type="user", entity_id="u99",
+                target_entity_type="item", target_entity_id="eggs",
+                event_time=dt.datetime(2026, 1, 2, 12, 0,
+                                       tzinfo=dt.timezone.utc),
+            ),
+            app_id,
+        )
+        ds = CPDataSource(CPDataSourceParams(app_name="CPApp"))
+        data = ds.read_training(ctx)
+        milk = data.item_map.get("milk")
+        eggs = data.item_map.get("eggs")
+        together = [
+            b for b in data.baskets if milk in b and eggs in b
+        ]
+        assert together == []  # 3h gap > 1h window → separate baskets
+
+    def test_sanity_check_rejects_empty(self):
+        with pytest.raises(ValueError, match="no buy events"):
+            CPTrainingData(
+                item_map=BiMap([]), baskets=[]
+            ).sanity_check()
+
+
+class TestCooccurrence:
+    def test_lift_finds_the_planted_pair(self, ctx, memory_storage):
+        _seed(memory_storage)
+        model = _train(ctx, memory_storage)
+        comps = model.complements("bread", 5)
+        assert comps, "bread must have complements"
+        assert comps[0][0] == "butter"
+        # butter ↔ bread is symmetric
+        assert model.complements("butter", 5)[0][0] == "bread"
+        # beer was always bought alone
+        assert model.complements("beer", 5) == []
+
+    def test_min_support_filters_noise(self, ctx, memory_storage):
+        _seed(memory_storage)
+        model = _train(ctx, memory_storage)
+        # caviar co-occurred with bread exactly once < min_support 2
+        others = [i for i, _ in model.complements("bread", 20)]
+        assert "caviar" not in others
+        permissive = _train(
+            ctx, memory_storage, CPAlgoParams(min_support=1)
+        )
+        others = [i for i, _ in permissive.complements("bread", 20)]
+        assert "caviar" in others
+
+    def test_confidence_metric(self, ctx, memory_storage):
+        _seed(memory_storage)
+        model = _train(
+            ctx, memory_storage, CPAlgoParams(metric="confidence")
+        )
+        comps = dict(model.complements("bread", 5))
+        # 20 of 21 bread baskets contain butter
+        assert comps["butter"] == pytest.approx(20 / 21, rel=1e-5)
+
+    def test_bad_metric_rejected(self, ctx, memory_storage):
+        _seed(memory_storage)
+        with pytest.raises(ValueError, match="metric"):
+            _train(ctx, memory_storage, CPAlgoParams(metric="magic"))
+
+    def test_chunked_accumulation_matches_single_chunk(
+        self, ctx, memory_storage
+    ):
+        _seed(memory_storage)
+        one = _train(ctx, memory_storage, CPAlgoParams(chunk=4096))
+        many = _train(ctx, memory_storage, CPAlgoParams(chunk=3))
+        np.testing.assert_array_equal(one.topk_items, many.topk_items)
+        np.testing.assert_allclose(
+            one.topk_scores, many.topk_scores, rtol=1e-6
+        )
+
+
+class TestServing:
+    def test_query_shape_and_exclusion(self, ctx, memory_storage):
+        _seed(memory_storage)
+        model = _train(ctx, memory_storage)
+        algo = CPAlgorithm(CPAlgoParams())
+        result = algo.predict(
+            model, {"items": ["bread", "butter"], "num": 3}
+        )
+        items = [s["item"] for s in result["itemScores"]]
+        # queried items never come back as their own complements
+        assert "bread" not in items and "butter" not in items
+
+    def test_duplicate_query_items_not_double_counted(
+        self, ctx, memory_storage
+    ):
+        _seed(memory_storage)
+        model = _train(ctx, memory_storage)
+        algo = CPAlgorithm(CPAlgoParams())
+        once = algo.predict(model, {"items": ["bread"], "num": 3})
+        twice = algo.predict(
+            model, {"items": ["bread", "bread"], "num": 3}
+        )
+        assert once == twice
+
+    def test_unknown_item_is_empty(self, ctx, memory_storage):
+        _seed(memory_storage)
+        model = _train(ctx, memory_storage)
+        algo = CPAlgorithm(CPAlgoParams())
+        assert algo.predict(
+            model, {"items": ["nope"], "num": 3}
+        ) == {"itemScores": []}
+
+    def test_engine_end_to_end(self, ctx, memory_storage):
+        """Full DASE assembly through Engine.train + predict."""
+        from predictionio_tpu.core import EngineParams
+        from predictionio_tpu.core.workflow import run_train, load_deployment
+
+        _seed(memory_storage)
+        engine = complementarypurchase_engine()
+        params = EngineParams(
+            data_source=("", CPDataSourceParams(app_name="CPApp")),
+            preparator=("", None),
+            algorithms=[("cooccurrence", CPAlgoParams())],
+        )
+        run_train(
+            engine, params, engine_id="cp", ctx=ctx,
+            storage=memory_storage,
+        )
+        _inst, algorithms, models, serving = load_deployment(
+            engine, params, engine_id="cp", ctx=ctx,
+            storage=memory_storage,
+        )
+        preds = algorithms[0].batch_predict(
+            models[0], [{"items": ["bread"], "num": 2}]
+        )
+        out = serving.serve({"items": ["bread"], "num": 2}, [preds[0]])
+        assert out["itemScores"][0]["item"] == "butter"
