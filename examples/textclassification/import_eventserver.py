@@ -1,0 +1,42 @@
+"""Seed the text-classification quickstart with labeled documents
+(gallery-parity counterpart of the reference examples' seed scripts).
+
+Usage:
+    pio-tpu app new MyTextApp         # note the access key
+    pio-tpu eventserver &             # default :7070
+    python import_eventserver.py --access-key <KEY> [--url http://...:7070]
+"""
+
+import argparse
+
+from predictionio_tpu.client import EventClient
+
+DOCS = [
+    ("spam", "win a free prize now claim your money today"),
+    ("spam", "free money click now to win the big prize"),
+    ("spam", "claim your exclusive free prize win money now"),
+    ("spam", "limited offer win money free claim instantly"),
+    ("ham", "meeting moved to tuesday please review the agenda"),
+    ("ham", "please review the quarterly report before our meeting"),
+    ("ham", "agenda attached for the tuesday planning meeting"),
+    ("ham", "notes from the review meeting are attached"),
+]
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--access-key", required=True)
+    parser.add_argument("--url", default="http://127.0.0.1:7070")
+    args = parser.parse_args()
+
+    client = EventClient(args.access_key, args.url)
+    for i, (label, text) in enumerate(DOCS):
+        client.create_event(
+            "$set", "document", f"d{i}",
+            properties={"text": text, "label": label},
+        )
+    print(f"{len(DOCS)} documents imported.")
+
+
+if __name__ == "__main__":
+    main()

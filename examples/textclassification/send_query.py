@@ -1,0 +1,22 @@
+"""Query the deployed text classifier.
+
+Usage: python send_query.py [--url http://127.0.0.1:8000] [--text "..."]
+"""
+
+import argparse
+import json
+
+from predictionio_tpu.client import EngineClient
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--url", default="http://127.0.0.1:8000")
+    parser.add_argument("--text", default="claim your free prize now")
+    args = parser.parse_args()
+    result = EngineClient(args.url).send_query({"text": args.text})
+    print(json.dumps(result, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -48,3 +48,9 @@ try:
     _TEMPLATES.append("complementarypurchase")
 except ImportError:  # pragma: no cover
     pass
+try:
+    from predictionio_tpu.models import textclassification  # noqa: F401
+
+    _TEMPLATES.append("textclassification")
+except ImportError:  # pragma: no cover
+    pass

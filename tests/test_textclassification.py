@@ -1,0 +1,157 @@
+"""Text-classification template (gallery parity: labeled documents →
+hashed bag-of-words → multinomial NB)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import App
+from predictionio_tpu.models.textclassification import (
+    TextDataSourceParams,
+    TextNBAlgorithm,
+    TextNBParams,
+    TextPreparator,
+    TextPreparatorParams,
+    TextTrainingData,
+    hash_counts,
+    textclassification_engine,
+    tokenize,
+)
+from predictionio_tpu.parallel.mesh import ComputeContext
+
+SPAM = [
+    "win a free prize now claim your money",
+    "free money click now to win big prize",
+    "claim your free prize win money now",
+    "exclusive offer win money free claim",
+]
+HAM = [
+    "meeting moved to tuesday please review the agenda",
+    "please review the quarterly report before the meeting",
+    "agenda attached for the tuesday planning meeting",
+    "notes from the review meeting attached",
+]
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return ComputeContext.create(batch="text-test")
+
+
+def _seed(storage, app_name="TextApp"):
+    app_id = storage.get_meta_data_apps().insert(App(id=0, name=app_name))
+    events = storage.get_events()
+    events.init(app_id)
+    batch = []
+    for i, text in enumerate(SPAM):
+        batch.append(Event(
+            event="$set", entity_type="document", entity_id=f"s{i}",
+            properties=DataMap({"text": text, "label": "spam"}),
+        ))
+    for i, text in enumerate(HAM):
+        batch.append(Event(
+            event="$set", entity_type="document", entity_id=f"h{i}",
+            properties=DataMap({"text": text, "label": "ham"}),
+        ))
+    events.insert_batch(batch, app_id)
+    return app_id
+
+
+def _train(ctx, storage, n_features=512):
+    from predictionio_tpu.models.textclassification import TextDataSource
+
+    ds = TextDataSource(TextDataSourceParams(app_name="TextApp"))
+    td = ds.read_training(ctx)
+    td.sanity_check()
+    prepared = TextPreparator(
+        TextPreparatorParams(n_features=n_features)
+    ).prepare(ctx, td)
+    return TextNBAlgorithm(TextNBParams()).train(ctx, prepared)
+
+
+class TestHashing:
+    def test_tokenize(self):
+        assert tokenize("Hello, World! it's 42") == [
+            "hello", "world", "it's", "42"
+        ]
+
+    def test_hashing_is_process_stable(self):
+        # FNV-1a, not builtin hash(): same buckets in every process
+        v = hash_counts(["alpha", "beta", "alpha"], 64)
+        assert v.sum() == 3.0
+        assert (v == hash_counts(["alpha", "beta", "alpha"], 64)).all()
+        assert v.max() >= 2.0  # the repeated token stacks
+
+    def test_fixed_width_regardless_of_vocabulary(self):
+        a = hash_counts(tokenize("one two three"), 128)
+        b = hash_counts(tokenize("totally different words here now"), 128)
+        assert a.shape == b.shape == (128,)
+
+
+class TestTraining:
+    def test_classifies_planted_corpus(self, ctx, memory_storage):
+        _seed(memory_storage)
+        model = _train(ctx, memory_storage)
+        algo = TextNBAlgorithm(TextNBParams())
+        spam = algo.predict(
+            model, {"text": "claim your free money prize"}
+        )
+        ham = algo.predict(
+            model, {"text": "please review the meeting agenda"}
+        )
+        assert spam["label"] == "spam"
+        assert ham["label"] == "ham"
+        assert set(spam["scores"]) == {"spam", "ham"}
+        assert spam["scores"]["spam"] > spam["scores"]["ham"]
+
+    def test_sanity_checks(self):
+        with pytest.raises(ValueError, match="no labeled documents"):
+            TextTrainingData(texts=[], labels=[]).sanity_check()
+        with pytest.raises(ValueError, match="two distinct labels"):
+            TextTrainingData(
+                texts=["a", "b"], labels=["x", "x"]
+            ).sanity_check()
+
+    def test_batch_matches_single(self, ctx, memory_storage):
+        _seed(memory_storage)
+        model = _train(ctx, memory_storage)
+        algo = TextNBAlgorithm(TextNBParams())
+        queries = [{"text": t} for t in ("free prize", "agenda review")]
+        batch = algo.batch_predict(model, queries)
+        singles = [algo.predict(model, q) for q in queries]
+        # float32 matmul sums differ in the last ulp across batch
+        # shapes (XLA reassociates); labels and scores agree to 1e-5
+        for b, s in zip(batch, singles):
+            assert b["label"] == s["label"]
+            for lbl in b["scores"]:
+                assert b["scores"][lbl] == pytest.approx(
+                    s["scores"][lbl], rel=1e-5
+                )
+
+    def test_engine_end_to_end(self, ctx, memory_storage):
+        from predictionio_tpu.core import EngineParams
+        from predictionio_tpu.core.workflow import (
+            load_deployment,
+            run_train,
+        )
+
+        _seed(memory_storage)
+        engine = textclassification_engine()
+        params = EngineParams(
+            data_source=("", TextDataSourceParams(app_name="TextApp")),
+            preparator=("", TextPreparatorParams(n_features=512)),
+            algorithms=[("nb", TextNBParams())],
+        )
+        run_train(
+            engine, params, engine_id="text", ctx=ctx,
+            storage=memory_storage,
+        )
+        _inst, algorithms, models, serving = load_deployment(
+            engine, params, engine_id="text", ctx=ctx,
+            storage=memory_storage,
+        )
+        query = {"text": "win free money now"}
+        preds = algorithms[0].batch_predict(models[0], [query])
+        assert serving.serve(query, [preds[0]])["label"] == "spam"
