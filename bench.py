@@ -405,7 +405,9 @@ def main() -> None:
     # UNAVAILABLE; rounds 1/2 lost theirs to unretried worker hangs).
     result, errors, cpu_clean = measure_tpu(scale)
 
-    metric = "als_epoch_time" + ("_ml20m" if scale == "ml20m" else "")
+    metric = "als_epoch_time" + (
+        f"_{scale}" if scale != "default" else ""
+    )
     if result is not None:
         secs = float(result["seconds"])
         baseline = cpu_baseline_seconds(scale)

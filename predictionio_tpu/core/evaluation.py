@@ -38,6 +38,21 @@ R = TypeVar("R")
 EvalData = Sequence[tuple[Any, Sequence[tuple[Any, Any, Any]]]]
 
 
+def kfold_indices(n: int, k: int):
+    """Index-modulo k-fold split (reference e2 CrossValidation.splitData,
+    e2/.../evaluation/CrossValidation.scala:33-63): yields
+    ``(fold, train_idx, test_idx)`` int arrays. The shared split used by
+    every template's ``read_eval``."""
+    import numpy as np
+
+    if k <= 1:
+        raise ValueError("eval_k must be >= 2 for evaluation")
+    idx = np.arange(n)
+    for fold in range(k):
+        test = idx % k == fold
+        yield fold, idx[~test], idx[test]
+
+
 class Metric(abc.ABC, Generic[R]):
     """Score one engine-params candidate from its eval output."""
 

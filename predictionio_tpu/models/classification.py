@@ -99,25 +99,25 @@ class ClassificationDataSource(
         return self._read()
 
     def read_eval(self, ctx: ComputeContext):
-        """k-fold split by index (reference e2 CrossValidation.splitData,
-        e2/.../evaluation/CrossValidation.scala:33-63)."""
-        k = self.params.eval_k
-        if k <= 1:
-            raise ValueError("eval_k must be >= 2 for evaluation")
+        """k-fold split (shared :func:`~predictionio_tpu.core.evaluation
+        .kfold_indices`)."""
+        from predictionio_tpu.core.evaluation import kfold_indices
+
         full = self._read()
         folds = []
-        idx = np.arange(len(full.x))
-        for fold in range(k):
-            test = idx % k == fold
+        for fold, train_idx, test_idx in kfold_indices(
+            len(full.x), self.params.eval_k
+        ):
             td = ClassificationTrainingData(
-                x=full.x[~test], y=full.y[~test], label_map=full.label_map
+                x=full.x[train_idx], y=full.y[train_idx],
+                label_map=full.label_map,
             )
             qa = [
                 (
                     {"features": full.x[i].tolist()},
                     full.label_map.inverse(int(full.y[i])),
                 )
-                for i in idx[test]
+                for i in test_idx
             ]
             folds.append((td, {"fold": fold}, qa))
         return folds

@@ -74,27 +74,28 @@ class RecDataSource(DataSource[RecTrainingData, dict, dict, list]):
         return RecTrainingData(interactions=self._interactions())
 
     def read_eval(self, ctx: ComputeContext):
-        """k-fold over interactions: held-out items per user become the
-        actuals (ranking evaluation)."""
-        k = self.params.eval_k
-        if k <= 1:
-            raise ValueError("eval_k must be >= 2 for evaluation")
+        """k-fold over interactions (shared
+        :func:`~predictionio_tpu.core.evaluation.kfold_indices`):
+        held-out items per user become the actuals (ranking
+        evaluation)."""
+        from predictionio_tpu.core.evaluation import kfold_indices
+
         inter = self._interactions()
-        idx = np.arange(inter.nnz)
         folds = []
-        for fold in range(k):
-            test = idx % k == fold
+        for fold, train_idx, test_idx in kfold_indices(
+            inter.nnz, self.params.eval_k
+        ):
             train = Interactions(
                 entity_map=inter.entity_map,
                 target_map=inter.target_map,
-                rows=inter.rows[~test],
-                cols=inter.cols[~test],
-                values=inter.values[~test],
-                times=inter.times[~test],
+                rows=inter.rows[train_idx],
+                cols=inter.cols[train_idx],
+                values=inter.values[train_idx],
+                times=inter.times[train_idx],
             )
             # group held-out items by user
             by_user: dict[int, list[str]] = {}
-            for r, c in zip(inter.rows[test], inter.cols[test]):
+            for r, c in zip(inter.rows[test_idx], inter.cols[test_idx]):
                 by_user.setdefault(int(r), []).append(
                     inter.target_map.inverse(int(c))
                 )

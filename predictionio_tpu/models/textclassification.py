@@ -80,6 +80,7 @@ class TextDataSourceParams(Params):
     entity_type: str = "document"
     text_property: str = "text"
     label_property: str = "label"
+    eval_k: int = 0  # >0 enables k-fold read_eval
 
 
 @dataclasses.dataclass
@@ -110,6 +111,28 @@ class TextDataSource(DataSource[TextTrainingData, dict, dict, list]):
             texts.append(str(pm[p.text_property]))
             labels.append(str(pm[p.label_property]))
         return TextTrainingData(texts=texts, labels=labels)
+
+    def read_eval(self, ctx: ComputeContext):
+        """k-fold split (shared :func:`~predictionio_tpu.core.evaluation
+        .kfold_indices`); actuals are the held-out labels, for
+        accuracy-style metrics."""
+        from predictionio_tpu.core.evaluation import kfold_indices
+
+        full = self.read_training(ctx)
+        folds = []
+        for fold, train_idx, test_idx in kfold_indices(
+            len(full.texts), self.params.eval_k
+        ):
+            td = TextTrainingData(
+                texts=[full.texts[i] for i in train_idx],
+                labels=[full.labels[i] for i in train_idx],
+            )
+            qa = [
+                ({"text": full.texts[i]}, full.labels[i])
+                for i in test_idx
+            ]
+            folds.append((td, {"fold": fold}, qa))
+        return folds
 
 
 @dataclasses.dataclass(frozen=True)

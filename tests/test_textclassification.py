@@ -130,6 +130,35 @@ class TestTraining:
                     s["scores"][lbl], rel=1e-5
                 )
 
+    def test_kfold_evaluation_accuracy(self, ctx, memory_storage):
+        """read_eval folds feed MetricEvaluator; the planted corpus is
+        separable, so held-out accuracy must be high."""
+        from predictionio_tpu.core import EngineParams
+        from predictionio_tpu.core.evaluation import (
+            AverageMetric,
+            MetricEvaluator,
+        )
+        from predictionio_tpu.models.textclassification import (
+            textclassification_engine,
+        )
+
+        class Accuracy(AverageMetric):
+            def calculate_point(self, ei, q, p, a):
+                return 1.0 if p["label"] == a else 0.0
+
+        _seed(memory_storage)
+        params = EngineParams(
+            data_source=(
+                "", TextDataSourceParams(app_name="TextApp", eval_k=2)
+            ),
+            preparator=("", TextPreparatorParams(n_features=512)),
+            algorithms=[("nb", TextNBParams())],
+        )
+        result = MetricEvaluator(Accuracy()).evaluate(
+            ctx, textclassification_engine(), [params]
+        )
+        assert result.best_score.score >= 0.75
+
     def test_engine_end_to_end(self, ctx, memory_storage):
         from predictionio_tpu.core import EngineParams
         from predictionio_tpu.core.workflow import (
