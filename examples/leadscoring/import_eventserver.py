@@ -1,0 +1,38 @@
+"""Seed the lead-scoring quickstart with labeled leads
+(gallery-parity counterpart of the reference examples' seed scripts).
+
+Usage:
+    pio-tpu app new MyLeadApp         # note the access key
+    pio-tpu eventserver &             # default :7070
+    python import_eventserver.py --access-key <KEY> [--url http://...:7070]
+"""
+
+import argparse
+import random
+
+from predictionio_tpu.client import EventClient
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--access-key", required=True)
+    parser.add_argument("--url", default="http://127.0.0.1:7070")
+    parser.add_argument("--leads", type=int, default=80)
+    args = parser.parse_args()
+
+    client = EventClient(args.access_key, args.url)
+    random.seed(9)
+    for i in range(args.leads):
+        converted = i < args.leads // 2
+        base = 8.0 if converted else 2.0
+        client.set_user(f"u{i}", {
+            "sessions": base + random.gauss(0, 0.5),
+            "pages": base * 3 + random.gauss(0, 1.0),
+            "minutes": base * 5 + random.gauss(0, 2.0),
+            "converted": converted,
+        })
+    print(f"{args.leads} leads imported.")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""Query the deployed lead scorer.
+
+Usage: python send_query.py [--url http://127.0.0.1:8000]
+       [--features 8 24 40]
+"""
+
+import argparse
+import json
+
+from predictionio_tpu.client import EngineClient
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--url", default="http://127.0.0.1:8000")
+    parser.add_argument(
+        "--features", nargs="+", type=float, default=[8.0, 24.0, 40.0]
+    )
+    args = parser.parse_args()
+    result = EngineClient(args.url).send_query(
+        {"features": args.features}
+    )
+    print(json.dumps(result, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -54,3 +54,9 @@ try:
     _TEMPLATES.append("textclassification")
 except ImportError:  # pragma: no cover
     pass
+try:
+    from predictionio_tpu.models import leadscoring  # noqa: F401
+
+    _TEMPLATES.append("leadscoring")
+except ImportError:  # pragma: no cover
+    pass

@@ -208,6 +208,12 @@ class EngineServer:
         for algo, model in zip(algorithms, models):
             name = type(algo).__name__
             query = getattr(algo, "warmup_query", lambda: {})()
+            if query is None:
+                # the algorithm declares no neutral query exists (e.g.
+                # data-dependent feature width) — serve cold by design,
+                # without burning three failed warmup attempts
+                logger.info("%s: no warmup query — serving cold", name)
+                continue
             bucket, failures, compiled = 1, 0, 0
             while True:
                 try:

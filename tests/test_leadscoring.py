@@ -1,0 +1,197 @@
+"""Lead-scoring template (gallery parity: conversion probability;
+the framework's gradient-descent exemplar — optax inside lax.scan,
+the whole descent compiled as one program)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import App
+from predictionio_tpu.models.leadscoring import (
+    LeadDataSource,
+    LeadDataSourceParams,
+    LeadPreparator,
+    LeadScoringAlgorithm,
+    LeadScoringParams,
+    LeadTrainingData,
+    leadscoring_engine,
+)
+from predictionio_tpu.parallel.mesh import ComputeContext
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return ComputeContext.create(batch="lead-test")
+
+
+def _seed(storage, app_name="LeadApp", n=80):
+    """Converted leads have clearly higher engagement; a margin
+    separates the clusters so logistic regression must find it."""
+    app_id = storage.get_meta_data_apps().insert(App(id=0, name=app_name))
+    events = storage.get_events()
+    events.init(app_id)
+    rng = np.random.default_rng(5)
+    batch = []
+    for i in range(n):
+        # block-assign labels: the k-fold index-modulo split must see
+        # both classes in every fold (alternating labels would make
+        # fold 0's training data single-class)
+        converted = i < n // 2
+        base = 8.0 if converted else 2.0
+        batch.append(Event(
+            event="$set", entity_type="user", entity_id=f"u{i}",
+            properties=DataMap({
+                "sessions": float(base + rng.normal(0, 0.5)),
+                "pages": float(base * 3 + rng.normal(0, 1.0)),
+                "minutes": float(base * 5 + rng.normal(0, 2.0)),
+                "converted": converted,
+            }),
+        ))
+    events.insert_batch(batch, app_id)
+    return app_id
+
+
+def _train(ctx, storage, algo_params=LeadScoringParams()):
+    ds = LeadDataSource(LeadDataSourceParams(app_name="LeadApp"))
+    td = ds.read_training(ctx)
+    td.sanity_check()
+    prepared = LeadPreparator(None).prepare(ctx, td)
+    return LeadScoringAlgorithm(algo_params).train(ctx, prepared)
+
+
+class TestTraining:
+    def test_separates_planted_clusters(self, ctx, memory_storage):
+        _seed(memory_storage)
+        model = _train(ctx, memory_storage)
+        algo = LeadScoringAlgorithm(LeadScoringParams())
+        hot = algo.predict(
+            model, {"features": [8.0, 24.0, 40.0]}
+        )
+        cold = algo.predict(
+            model, {"features": [2.0, 6.0, 10.0]}
+        )
+        assert hot["converted"] is True and hot["score"] > 0.9
+        assert cold["converted"] is False and cold["score"] < 0.1
+
+    def test_scores_are_probabilities(self, ctx, memory_storage):
+        _seed(memory_storage)
+        model = _train(ctx, memory_storage)
+        algo = LeadScoringAlgorithm(LeadScoringParams())
+        preds = algo.batch_predict(
+            model,
+            [{"features": [float(s), float(s * 3), float(s * 5)]}
+             for s in range(1, 10)],
+        )
+        scores = [p["score"] for p in preds]
+        assert all(0.0 <= s <= 1.0 for s in scores)
+        # monotone in engagement for this 1-direction dataset
+        assert scores == sorted(scores)
+
+    def test_empty_batch(self, ctx, memory_storage):
+        _seed(memory_storage)
+        model = _train(ctx, memory_storage)
+        assert LeadScoringAlgorithm(
+            LeadScoringParams()
+        ).batch_predict(model, []) == []
+
+    def test_sanity_checks(self):
+        with pytest.raises(ValueError, match="no labeled leads"):
+            LeadTrainingData(
+                x=np.zeros((0, 3), np.float32), y=np.zeros(0, np.float32)
+            ).sanity_check()
+        with pytest.raises(ValueError, match="both converted"):
+            LeadTrainingData(
+                x=np.ones((4, 3), np.float32), y=np.ones(4, np.float32)
+            ).sanity_check()
+
+    def test_nan_features_rejected(self):
+        x = np.ones((4, 3), np.float32)
+        x[1, 2] = np.nan
+        with pytest.raises(ValueError, match="NaN"):
+            LeadTrainingData(
+                x=x, y=np.array([0, 1, 0, 1], np.float32)
+            ).sanity_check()
+
+    def test_string_label_rejected(self, ctx, memory_storage):
+        """bool('false') is True — a CSV-derived string label must be
+        a loud error, never a silently inverted training signal."""
+        app_id = _seed(memory_storage)
+        memory_storage.get_events().insert(
+            Event(
+                event="$set", entity_type="user", entity_id="bad",
+                properties=DataMap({
+                    "sessions": 1.0, "pages": 1.0, "minutes": 1.0,
+                    "converted": "false",
+                }),
+            ),
+            app_id,
+        )
+        ds = LeadDataSource(LeadDataSourceParams(app_name="LeadApp"))
+        with pytest.raises(ValueError, match="must be a boolean"):
+            ds.read_training(ctx)
+
+    def test_threshold_param(self, ctx, memory_storage):
+        _seed(memory_storage)
+        strict = _train(
+            ctx, memory_storage, LeadScoringParams(threshold=0.99)
+        )
+        algo = LeadScoringAlgorithm(LeadScoringParams(threshold=0.99))
+        mid = algo.predict(strict, {"features": [5.0, 15.0, 25.0]})
+        assert mid["converted"] is (mid["score"] >= 0.99)
+
+
+class TestEvaluation:
+    def test_kfold_accuracy(self, ctx, memory_storage):
+        from predictionio_tpu.core import EngineParams
+        from predictionio_tpu.core.evaluation import (
+            AverageMetric,
+            MetricEvaluator,
+        )
+
+        class Accuracy(AverageMetric):
+            def calculate_point(self, ei, q, p, a):
+                return 1.0 if p["converted"] == a else 0.0
+
+        _seed(memory_storage)
+        params = EngineParams(
+            data_source=(
+                "", LeadDataSourceParams(app_name="LeadApp", eval_k=2)
+            ),
+            preparator=("", None),
+            algorithms=[("logreg", LeadScoringParams())],
+        )
+        result = MetricEvaluator(Accuracy()).evaluate(
+            ctx, leadscoring_engine(), [params]
+        )
+        assert result.best_score.score >= 0.9  # separable clusters
+
+
+class TestEngine:
+    def test_end_to_end(self, ctx, memory_storage):
+        from predictionio_tpu.core import EngineParams
+        from predictionio_tpu.core.workflow import (
+            load_deployment,
+            run_train,
+        )
+
+        _seed(memory_storage)
+        engine = leadscoring_engine()
+        params = EngineParams(
+            data_source=("", LeadDataSourceParams(app_name="LeadApp")),
+            preparator=("", None),
+            algorithms=[("logreg", LeadScoringParams())],
+        )
+        run_train(
+            engine, params, engine_id="lead", ctx=ctx,
+            storage=memory_storage,
+        )
+        _inst, algorithms, models, serving = load_deployment(
+            engine, params, engine_id="lead", ctx=ctx,
+            storage=memory_storage,
+        )
+        query = {"features": [8.0, 24.0, 40.0]}
+        preds = algorithms[0].batch_predict(models[0], [query])
+        out = serving.serve(query, [preds[0]])
+        assert out["converted"] is True
