@@ -35,7 +35,7 @@ from predictionio_tpu.core import (
 from predictionio_tpu.core.controller import SanityCheck
 from predictionio_tpu.data.store import EventStore
 from predictionio_tpu.ops import naive_bayes as nb
-from predictionio_tpu.parallel.mesh import ComputeContext, pad_to_multiple
+from predictionio_tpu.parallel.mesh import ComputeContext
 from predictionio_tpu.utils.bimap import BiMap
 
 logger = logging.getLogger(__name__)
@@ -141,13 +141,10 @@ class ClassificationPreparator(
     def prepare(
         self, ctx: ComputeContext, td: ClassificationTrainingData
     ) -> PreparedClassificationData:
-        n = len(td.x)
-        mult = ctx.data_parallelism
-        mask = pad_to_multiple(np.ones(n, np.float32), mult)
         return PreparedClassificationData(
             x=ctx.shard_rows(td.x),
             y=ctx.shard_rows(td.y),
-            mask=jax.device_put(mask, ctx.data_sharded),
+            mask=ctx.shard_rows(np.ones(len(td.x), np.float32)),
             label_map=td.label_map,
             n_classes=max(len(td.label_map), 1),
         )

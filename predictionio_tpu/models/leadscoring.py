@@ -40,7 +40,7 @@ from predictionio_tpu.core import (
 )
 from predictionio_tpu.core.controller import SanityCheck
 from predictionio_tpu.data.store import EventStore
-from predictionio_tpu.parallel.mesh import ComputeContext, pad_to_multiple
+from predictionio_tpu.parallel.mesh import ComputeContext
 
 logger = logging.getLogger(__name__)
 
@@ -144,13 +144,10 @@ class LeadPreparator(Preparator[LeadTrainingData, LeadPrepared]):
         mean = td.x.mean(axis=0)
         std = np.maximum(td.x.std(axis=0), 1e-6)
         x = (td.x - mean) / std
-        mask = pad_to_multiple(
-            np.ones(len(td.x), np.float32), ctx.data_parallelism
-        )
         return LeadPrepared(
             x=ctx.shard_rows(x.astype(np.float32)),
             y=ctx.shard_rows(td.y),
-            mask=jax.device_put(mask, ctx.data_sharded),
+            mask=ctx.shard_rows(np.ones(len(td.x), np.float32)),
             mean=mean.astype(np.float32),
             std=std.astype(np.float32),
         )
@@ -239,10 +236,15 @@ class LeadScoringAlgorithm(
             [q["features"] for q in queries], np.float32
         )
         scores = model.score(x)
+        # the DEPLOY-TIME params cut the boolean: threshold is a pure
+        # serving knob, so editing engine.json + redeploy must take
+        # effect without a retrain (model.threshold records what the
+        # training run used, for provenance)
+        threshold = self.params.threshold
         return [
             {
                 "score": float(s),
-                "converted": bool(s >= model.threshold),
+                "converted": bool(s >= threshold),
             }
             for s in scores
         ]

@@ -41,7 +41,7 @@ from predictionio_tpu.core import (
 from predictionio_tpu.core.controller import SanityCheck
 from predictionio_tpu.data.store import EventStore
 from predictionio_tpu.ops import naive_bayes as nb
-from predictionio_tpu.parallel.mesh import ComputeContext, pad_to_multiple
+from predictionio_tpu.parallel.mesh import ComputeContext
 from predictionio_tpu.utils.bimap import BiMap
 
 logger = logging.getLogger(__name__)
@@ -169,13 +169,10 @@ class TextPreparator(Preparator[TextTrainingData, TextPrepared]):
         x = np.stack(
             [hash_counts(tokenize(t), n) for t in td.texts]
         )
-        mask = pad_to_multiple(
-            np.ones(len(td.texts), np.float32), ctx.data_parallelism
-        )
         return TextPrepared(
             x=ctx.shard_rows(x),
             y=ctx.shard_rows(y),
-            mask=jax.device_put(mask, ctx.data_sharded),
+            mask=ctx.shard_rows(np.ones(len(td.texts), np.float32)),
             label_map=label_map,
             n_features=n,
         )

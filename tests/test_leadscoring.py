@@ -132,14 +132,19 @@ class TestTraining:
         with pytest.raises(ValueError, match="must be a boolean"):
             ds.read_training(ctx)
 
-    def test_threshold_param(self, ctx, memory_storage):
+    def test_threshold_is_a_serving_knob(self, ctx, memory_storage):
+        """Changing threshold in the deploy-time params must take
+        effect WITHOUT retraining (the model only records the
+        training-time value for provenance)."""
         _seed(memory_storage)
-        strict = _train(
-            ctx, memory_storage, LeadScoringParams(threshold=0.99)
+        model = _train(ctx, memory_storage)  # trained at threshold 0.5
+        query = {"features": [8.0, 24.0, 40.0]}  # scores ~0.99
+        default = LeadScoringAlgorithm(LeadScoringParams())
+        strict = LeadScoringAlgorithm(
+            LeadScoringParams(threshold=0.9999)
         )
-        algo = LeadScoringAlgorithm(LeadScoringParams(threshold=0.99))
-        mid = algo.predict(strict, {"features": [5.0, 15.0, 25.0]})
-        assert mid["converted"] is (mid["score"] >= 0.99)
+        assert default.predict(model, query)["converted"] is True
+        assert strict.predict(model, query)["converted"] is False
 
 
 class TestEvaluation:

@@ -148,3 +148,60 @@ def test_recommendation_quickstart(env, tmp_path):
     rc, out, _err = _pio(env, "status")
     assert rc == 0
     assert "ready to go" in out
+
+
+def test_leadscoring_quickstart(env, tmp_path):
+    """Second template family through the same public path — covers the
+    gradient-descent (optax) training loop end to end: CLI app/train/
+    deploy, the unmodified example seed + query scripts, real sockets."""
+    examples = os.path.join(_REPO, "examples", "leadscoring")
+    rc, out, err = _pio(env, "app", "new", "MyLeadApp")
+    assert rc == 0, err
+    key = re.search(r"Access Key:\s*(\S+)", out).group(1)
+
+    es, es_port = _spawn_server(
+        env, "eventserver", "--ip", "127.0.0.1", "--port", "0"
+    )
+    try:
+        seed = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(examples, "import_eventserver.py"),
+                "--access-key", key,
+                "--url", f"http://127.0.0.1:{es_port}",
+                "--leads", "40",
+            ],
+            env=env, capture_output=True, text=True, timeout=240,
+        )
+        assert seed.returncode == 0, seed.stderr
+    finally:
+        _stop(es)
+
+    variant = os.path.join(examples, "engine.json")
+    rc, out, err = _pio(env, "train", "--variant", variant, timeout=600)
+    assert rc == 0, err
+
+    srv, srv_port = _spawn_server(
+        env, "deploy", "--variant", variant,
+        "--ip", "127.0.0.1", "--port", "0",
+    )
+    try:
+        def query(features):
+            q = subprocess.run(
+                [
+                    sys.executable,
+                    os.path.join(examples, "send_query.py"),
+                    "--url", f"http://127.0.0.1:{srv_port}",
+                    "--features", *map(str, features),
+                ],
+                env=env, capture_output=True, text=True, timeout=240,
+            )
+            assert q.returncode == 0, q.stderr
+            return json.loads(q.stdout)
+
+        hot = query([8.0, 24.0, 40.0])
+        cold = query([2.0, 6.0, 10.0])
+        assert hot["converted"] is True and hot["score"] > 0.8
+        assert cold["converted"] is False and cold["score"] < 0.2
+    finally:
+        _stop(srv)
