@@ -26,7 +26,6 @@ import dataclasses
 import logging
 import re
 
-import jax
 import numpy as np
 
 from predictionio_tpu.core import (
