@@ -33,6 +33,7 @@ import datetime as _dt
 import html as _html
 import json
 import logging
+import os
 import queue
 import secrets
 import threading
@@ -255,6 +256,9 @@ class EngineServer:
         with self._lock:
             return {
                 "status": "alive",
+                # which SO_REUSEPORT worker answered (ops parity with
+                # the event server's status route)
+                "pid": os.getpid(),
                 "engineId": self._engine_id,
                 "engineVersion": self._engine_version,
                 "engineVariant": self._engine_variant,

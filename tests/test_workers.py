@@ -118,6 +118,35 @@ def worker_group(tmp_path):
                 proc.kill()
 
 
+def _read_banner_port(proc, timeout: float = 60.0) -> int | None:
+    """Bounded read of the 'listening on host:port' banner — a server
+    that wedges before printing must fail the test, not hang it."""
+    import threading
+
+    result: list[int] = []
+
+    def _scan():
+        for line in proc.stdout:
+            if "listening on" in line:
+                result.append(int(line.rsplit(":", 1)[1]))
+                return
+
+    t = threading.Thread(target=_scan, daemon=True)
+    t.start()
+    t.join(timeout)
+    return result[0] if result else None
+
+
+def _drain(proc) -> None:
+    """Keep the merged stdout/stderr pipe drained: with request logging
+    on, a full 64 KB pipe buffer would block the server mid-test."""
+    import threading
+
+    threading.Thread(
+        target=lambda: [None for _ in proc.stdout], daemon=True
+    ).start()
+
+
 def _worker_pids(parent_pid: int) -> set[int]:
     """Child pids of the parent that are re-exec'd workers."""
     out = subprocess.run(
@@ -211,6 +240,109 @@ class TestMultiWorkerEventServer:
             pytest.fail("killed worker was not respawned")
         # the group still serves
         assert _get_status(port)["status"] == "alive"
+
+    def test_multi_worker_deploy_serves_from_all_workers(self, tmp_path):
+        """`deploy --workers 2`: every worker stages the model from the
+        shared sqlite store and they all answer queries identically —
+        the CPU-front topology docs/serving.md describes."""
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env.update({
+            "PYTHONUNBUFFERED": "1",
+            "JAX_PLATFORMS": "cpu",
+            "PIO_STORAGE_SOURCES_SQL_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_SQL_PATH": str(tmp_path / "d.sqlite"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQL",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQL",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQL",
+        })
+
+        def pio(*argv, timeout=300):
+            return subprocess.run(
+                [sys.executable, "-m", "predictionio_tpu.cli.main",
+                 *argv],
+                env=env, capture_output=True, text=True, timeout=timeout,
+            )
+
+        # seed + train the lead-scoring example (fast, deterministic)
+        out = pio("app", "new", "MyLeadApp")
+        assert out.returncode == 0, out.stderr
+        import re as _re
+
+        key = _re.search(r"Access Key:\s*(\S+)", out.stdout).group(1)
+        examples = os.path.join(_REPO, "examples", "leadscoring")
+        es = subprocess.Popen(
+            [sys.executable, "-m", "predictionio_tpu.cli.main",
+             "eventserver", "--ip", "127.0.0.1", "--port", "0"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        try:
+            port = _read_banner_port(es)
+            assert port
+            _drain(es)
+            seed = subprocess.run(
+                [sys.executable,
+                 os.path.join(examples, "import_eventserver.py"),
+                 "--access-key", key,
+                 "--url", f"http://127.0.0.1:{port}",
+                 "--leads", "40"],
+                env=env, capture_output=True, text=True, timeout=240,
+            )
+            assert seed.returncode == 0, seed.stderr
+        finally:
+            es.terminate()
+            es.wait(timeout=10)
+        variant = os.path.join(examples, "engine.json")
+        out = pio("train", "--variant", variant, timeout=600)
+        assert out.returncode == 0, out.stderr
+
+        srv = subprocess.Popen(
+            [sys.executable, "-m", "predictionio_tpu.cli.main",
+             "deploy", "--variant", variant,
+             "--ip", "127.0.0.1", "--port", "0", "--workers", "2"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        try:
+            port = _read_banner_port(srv, timeout=180)
+            assert port
+            _drain(srv)
+
+            def query():
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/queries.json",
+                    data=json.dumps(
+                        {"features": [8.0, 24.0, 40.0]}
+                    ).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    return json.loads(resp.read())
+
+            # wait until the group answers, then until BOTH workers
+            # have answered (each stages the model independently)
+            deadline = time.monotonic() + 120
+            pids, answers = set(), []
+            while time.monotonic() < deadline and len(pids) < 2:
+                try:
+                    pids.add(_get_status(port)["pid"])
+                    answers.append(query())
+                except OSError:
+                    time.sleep(0.5)
+            assert len(pids) == 2, f"only {pids} answered"
+            assert answers and all(
+                a["converted"] is True for a in answers
+            )
+            scores = {round(a["score"], 5) for a in answers}
+            assert len(scores) == 1, f"workers disagree: {scores}"
+        finally:
+            if srv.poll() is None:
+                srv.send_signal(signal.SIGTERM)
+                try:
+                    srv.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    srv.kill()
 
     def test_sigterm_tears_down_group(self, worker_group):
         proc, port, _db = worker_group
