@@ -401,6 +401,40 @@ class TestTemplateAndRun:
         assert code == 0
         assert "classification" in out and "recommendation" in out
 
+    def test_help_verb(self, cli):
+        """Reference Console has an explicit `help` verb besides -h."""
+        code, out, _ = cli("help")
+        assert code == 0
+        for verb in ("train", "deploy", "eventserver", "template"):
+            assert verb in out
+
+    def test_shell_verb_runs_piped_commands(self):
+        """`pio-tpu shell` preloads storage/ctx/event_store; EOF on
+        stdin exits cleanly (the bin/pio-shell analogue)."""
+        import subprocess
+        import sys
+
+        env = dict(os.environ)
+        env["PYTHONPATH"] = (
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            + os.pathsep + env.get("PYTHONPATH", "")
+        )
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        })
+        out = subprocess.run(
+            [sys.executable, "-m", "predictionio_tpu.cli.main", "shell"],
+            input="print('CTX-AXES', sorted(ctx.mesh.axis_names))\n",
+            env=env, capture_output=True, text=True, timeout=180,
+        )
+        assert out.returncode == 0, out.stderr
+        assert "CTX-AXES ['data', 'model']" in out.stdout
+        assert "preloaded: storage" in out.stderr + out.stdout
+
     def test_template_get(self, cli, tmp_path):
         dst = str(tmp_path / "myengine")
         code, out, _ = cli(

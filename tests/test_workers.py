@@ -80,25 +80,9 @@ def worker_group(tmp_path):
         stderr=subprocess.STDOUT,
         text=True,
     )
-    port = None
-    deadline = time.monotonic() + 60
-    while time.monotonic() < deadline:
-        line = proc.stdout.readline()
-        if "listening on" in line:
-            port = int(line.rsplit(":", 1)[1])
-            break
+    port = _read_banner_port(proc)
     assert port, "server never reported its port"
-
-    # keep draining the shared stdout/stderr pipe: with request logging
-    # on, a full 64 KB pipe buffer would block whichever worker logs
-    # next, hanging the group mid-test
-    def _drain():
-        for _ in proc.stdout:
-            pass
-
-    import threading
-
-    threading.Thread(target=_drain, daemon=True).start()
+    _drain(proc)
     # wait until requests are answered
     deadline = time.monotonic() + 30
     while time.monotonic() < deadline:
@@ -292,7 +276,10 @@ class TestMultiWorkerEventServer:
             assert seed.returncode == 0, seed.stderr
         finally:
             es.terminate()
-            es.wait(timeout=10)
+            try:
+                es.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                es.kill()
         variant = os.path.join(examples, "engine.json")
         out = pio("train", "--variant", variant, timeout=600)
         assert out.returncode == 0, out.stderr
@@ -309,31 +296,48 @@ class TestMultiWorkerEventServer:
             assert port
             _drain(srv)
 
-            def query():
-                req = urllib.request.Request(
-                    f"http://127.0.0.1:{port}/queries.json",
-                    data=json.dumps(
-                        {"features": [8.0, 24.0, 40.0]}
-                    ).encode(),
-                    headers={"Content-Type": "application/json"},
-                )
-                with urllib.request.urlopen(req, timeout=30) as resp:
-                    return json.loads(resp.read())
+            import http.client
 
-            # wait until the group answers, then until BOTH workers
-            # have answered (each stages the model independently)
+            # a keep-alive connection stays pinned to whichever worker
+            # the kernel assigned it — collect one connection PER
+            # worker, then send a query down each, so both workers
+            # provably answer queries (status-only pids would not show
+            # where the queries landed)
+            body = json.dumps({"features": [8.0, 24.0, 40.0]})
+            by_pid: dict[int, http.client.HTTPConnection] = {}
             deadline = time.monotonic() + 120
-            pids, answers = set(), []
-            while time.monotonic() < deadline and len(pids) < 2:
+            while time.monotonic() < deadline and len(by_pid) < 2:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", port, timeout=30
+                )
                 try:
-                    pids.add(_get_status(port)["pid"])
-                    answers.append(query())
+                    conn.request("GET", "/")
+                    resp = conn.getresponse()
+                    pid = json.loads(resp.read())["pid"]
                 except OSError:
+                    conn.close()
                     time.sleep(0.5)
-            assert len(pids) == 2, f"only {pids} answered"
-            assert answers and all(
-                a["converted"] is True for a in answers
-            )
+                    continue
+                if pid in by_pid:
+                    conn.close()
+                    time.sleep(0.2)
+                else:
+                    by_pid[pid] = conn
+            assert len(by_pid) == 2, f"only {set(by_pid)} answered"
+            answers = []
+            try:
+                for pid, conn in by_pid.items():
+                    conn.request(
+                        "POST", "/queries.json", body,
+                        {"Content-Type": "application/json"},
+                    )
+                    resp = conn.getresponse()
+                    assert resp.status == 200, (pid, resp.status)
+                    answers.append(json.loads(resp.read()))
+            finally:
+                for conn in by_pid.values():
+                    conn.close()
+            assert all(a["converted"] is True for a in answers)
             scores = {round(a["score"], 5) for a in answers}
             assert len(scores) == 1, f"workers disagree: {scores}"
         finally:
