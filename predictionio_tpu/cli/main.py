@@ -112,14 +112,60 @@ def cmd_version(args) -> int:
     return 0
 
 
+def _print_metrics(url: str) -> int:
+    """Scrape a live server's ``/metrics.json`` and print a per-metric
+    one-liner (histograms with derived p50/p95/p99)."""
+    import urllib.request
+
+    target = url.rstrip("/") + "/metrics.json"
+    try:
+        with urllib.request.urlopen(target, timeout=10) as resp:
+            data = json.load(resp)
+    except (OSError, ValueError) as e:
+        # ValueError covers JSONDecodeError: a proxy error page or a
+        # non-pio service answering 200 must not traceback
+        print(f"[ERROR] cannot scrape {target}: {e}", file=sys.stderr)
+        return 1
+    try:
+        for name in sorted(data):
+            family = data[name]
+            for sample in family["samples"]:
+                label = ",".join(
+                    f"{k}={v}" for k, v in sample["labels"].items()
+                )
+                label = f"{{{label}}}" if label else ""
+                if family["type"] == "histogram":
+                    print(
+                        f"{name}{label} count={sample['count']} "
+                        f"p50={sample['p50']} p95={sample['p95']} "
+                        f"p99={sample['p99']}"
+                    )
+                else:
+                    print(f"{name}{label} {sample['value']}")
+    except (AttributeError, KeyError, TypeError) as e:
+        print(
+            f"[ERROR] {target} is not a pio metrics.json payload: {e!r}",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
 def cmd_status(args) -> int:
-    """Reference Console.status:1035-1107: verify storage + compute."""
+    """Reference Console.status:1035-1107: verify storage + compute.
+    With ``--metrics-url`` it instead scrapes a running server's
+    telemetry registry (any server: engine, event, store, dashboard)."""
+    if getattr(args, "metrics_url", ""):
+        # pure HTTP — return before the storage/mesh imports below pull
+        # in jax (seconds of startup, and a crash if the local
+        # accelerator runtime is broken) just to scrape a remote server
+        return _print_metrics(args.metrics_url)
+
     from predictionio_tpu.data.storage import get_storage
     from predictionio_tpu.parallel.mesh import (
         DeviceInitTimeout,
         devices_with_timeout,
     )
-
     print(f"PredictionIO-TPU {__version__}")
     try:
         devices = devices_with_timeout()
@@ -949,7 +995,13 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("help").set_defaults(
         func=lambda _args: (parser.print_help(), 0)[1]
     )
-    sub.add_parser("status").set_defaults(func=cmd_status)
+    p = sub.add_parser("status")
+    p.add_argument(
+        "--metrics-url", dest="metrics_url", default="",
+        help="scrape a running server's /metrics.json instead of "
+             "checking local storage/compute",
+    )
+    p.set_defaults(func=cmd_status)
 
     p = sub.add_parser("app")
     ap = p.add_subparsers(dest="app_command", required=True)

@@ -103,6 +103,11 @@ def run_train(
                 ctx, params, workflow, algorithms=algorithms
             )
         timer.log_summary(prefix=f"[{engine_id}] ")
+        # train-time telemetry joins the process registry: a trainer
+        # that also serves (or exposes /metrics) scrapes both as one
+        from predictionio_tpu.obs import get_registry
+
+        timer.publish(get_registry())
         instance = dataclasses.replace(
             instance, env={"timing": timer.to_json()}
         )

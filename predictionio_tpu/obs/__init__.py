@@ -1,0 +1,43 @@
+"""Unified telemetry: metric registry + request-ID propagation.
+
+The reference's only observability is the event-server StatsActor
+counters and the Spark UI (SURVEY §5); a server meant to sustain heavy
+multi-user traffic needs to see where latency goes. This package is the
+one system both sides feed: serving records per-route latency, batch
+occupancy, and device-dispatch time into it; training loops publish
+:class:`~predictionio_tpu.utils.profiling.StepTimer` records into it;
+every server scrapes it at ``GET /metrics`` (Prometheus text) and
+``GET /metrics.json``.
+
+Stdlib-only by design — the serving layer imports it, never the other
+way around, so there is no import cycle and no hot-path dependency
+beyond a dict lookup and a lock.
+"""
+
+from predictionio_tpu.obs.context import (
+    get_request_id,
+    new_request_id,
+    set_request_id,
+)
+from predictionio_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    LATENCY_BUCKETS,
+    MetricRegistry,
+    TRAIN_STEP_BUCKETS,
+    get_registry,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "LATENCY_BUCKETS",
+    "MetricRegistry",
+    "TRAIN_STEP_BUCKETS",
+    "get_registry",
+    "get_request_id",
+    "new_request_id",
+    "set_request_id",
+]

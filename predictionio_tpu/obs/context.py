@@ -1,0 +1,61 @@
+"""Request-ID propagation.
+
+Every inbound HTTP request gets (or forwards, via ``X-Request-ID``) an
+ID held in a :class:`contextvars.ContextVar`. The serving stack is
+thread-per-request with synchronous handlers, so the contextvar rides
+the handler thread end-to-end: the micro-batcher reads it at submit
+time and carries it into the device-dispatch log line, which is what
+makes one slow query traceable through the batcher to the device step.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import logging
+import re
+import secrets
+import time
+
+_request_id: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "pio_request_id", default=None
+)
+
+#: forwarded IDs are clamped to this shape so a hostile header cannot
+#: smuggle log-breaking bytes or unbounded cardinality into log lines
+_ID_OK = re.compile(r"^[A-Za-z0-9._:-]{1,128}$")
+
+
+def new_request_id() -> str:
+    return secrets.token_hex(8)
+
+
+def set_request_id(request_id: str | None) -> str:
+    """Install ``request_id`` (sanitized) for the current context,
+    minting a fresh one when absent or malformed; returns the ID."""
+    if not request_id or not _ID_OK.match(request_id):
+        request_id = new_request_id()
+    _request_id.set(request_id)
+    return request_id
+
+
+def get_request_id() -> str | None:
+    return _request_id.get()
+
+
+def log_json(
+    logger: logging.Logger, level: int, event: str, **fields
+) -> None:
+    """One structured JSON log line, request ID included when present.
+
+    Rendered eagerly only when the level is enabled — the hot path pays
+    an ``isEnabledFor`` check, not a ``json.dumps``.
+    """
+    if not logger.isEnabledFor(level):
+        return
+    record = {"event": event, "ts": round(time.time(), 3)}
+    rid = _request_id.get()
+    if rid is not None:
+        record["requestId"] = rid
+    record.update(fields)
+    logger.log(level, json.dumps(record, default=str))

@@ -12,20 +12,28 @@ from predictionio_tpu.cli.commands import (
     delete_app_data,
 )
 from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.obs import MetricRegistry, get_registry
 from predictionio_tpu.serving.http import (
     HTTPError,
     HTTPServer,
     Request,
     Response,
     Router,
+    install_metrics_routes,
 )
 
 
 class AdminServer:
-    def __init__(self, storage: Storage | None = None):
+    def __init__(
+        self,
+        storage: Storage | None = None,
+        registry: MetricRegistry | None = None,
+    ):
         self._storage = storage or get_storage()
+        self.registry = registry if registry is not None else get_registry()
         self.router = Router()
         r = self.router
+        install_metrics_routes(r, self.registry)
         r.route("GET", "/", self._status)
         r.route("GET", "/cmd/app", self._list)
         r.route("POST", "/cmd/app", self._new)
@@ -98,9 +106,12 @@ def create_admin_server(
     """``server_config`` enables TLS/key auth; the reference AdminAPI has
     neither, so unlike the dashboard nothing is read from the env by
     default."""
+    server = AdminServer(storage)
     return HTTPServer(
-        AdminServer(storage).router,
+        server.router,
         host=host,
         port=port,
         server_config=server_config,
+        service="adminserver",
+        registry=server.registry,
     )

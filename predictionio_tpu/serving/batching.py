@@ -6,6 +6,13 @@ SURVEY.md §3.2). The TPU answer is the opposite shape: concurrent
 requests are coalesced into one fixed-shape batch dispatched to a
 pre-compiled jitted program — XLA dispatch overhead amortizes across
 the batch, which is what makes the ≥1k QPS target reachable.
+
+Telemetry: when built with a :class:`~predictionio_tpu.obs.MetricRegistry`
+the batcher records batch occupancy, queue depth, device-dispatch time,
+dispatched/shed/cancelled counts — the queue instrumentation the
+Podracer line of work treats as a prerequisite for scaling. Each slot
+carries the submitting request's ID (from the obs contextvar), so a
+slow or failing dispatch logs exactly which requests rode in it.
 """
 
 from __future__ import annotations
@@ -13,8 +20,13 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from concurrent.futures import Future
 from typing import Any, Callable, Sequence
+
+from predictionio_tpu.obs import MetricRegistry, get_request_id
+from predictionio_tpu.obs.context import log_json
+from predictionio_tpu.obs.registry import LATENCY_BUCKETS, OCCUPANCY_BUCKETS
 
 logger = logging.getLogger(__name__)
 
@@ -28,6 +40,79 @@ class BatcherOverloaded(Exception):
     """
 
 
+class _NullMetrics:
+    """Registry-free fast path: every hook is a no-op."""
+
+    __slots__ = ()
+
+    def queue_depth(self, n: int) -> None:
+        pass
+
+    def shed(self) -> None:
+        pass
+
+    def dispatched(self, occupancy: int, seconds: float) -> None:
+        pass
+
+    def cancelled(self, n: int) -> None:
+        pass
+
+
+class _BatcherMetrics:
+    """Bound registry children for one named batcher."""
+
+    __slots__ = ("_depth", "_shed", "_occupancy", "_dispatch",
+                 "_batches", "_cancelled")
+
+    def __init__(self, registry: MetricRegistry, name: str):
+        self._depth = registry.gauge(
+            "pio_batch_queue_depth",
+            "Items waiting in the micro-batch queue",
+            ("batcher",),
+        ).labels(name)
+        self._shed = registry.counter(
+            "pio_batch_shed_total",
+            "Submissions refused at the queue-depth bound",
+            ("batcher",),
+        ).labels(name)
+        self._occupancy = registry.histogram(
+            "pio_batch_occupancy",
+            "Queries per dispatched device batch",
+            ("batcher",),
+            buckets=OCCUPANCY_BUCKETS,
+        ).labels(name)
+        self._dispatch = registry.histogram(
+            "pio_device_dispatch_seconds",
+            "Wall clock of one batch_fn dispatch (device-synced)",
+            ("batcher",),
+            buckets=LATENCY_BUCKETS,
+        ).labels(name)
+        self._batches = registry.counter(
+            "pio_batches_total",
+            "Device batches dispatched",
+            ("batcher",),
+        ).labels(name)
+        self._cancelled = registry.counter(
+            "pio_batch_cancelled_total",
+            "Slots cancelled before dispatch (device work avoided)",
+            ("batcher",),
+        ).labels(name)
+
+    def queue_depth(self, n: int) -> None:
+        self._depth.set(n)
+
+    def shed(self) -> None:
+        self._shed.inc()
+
+    def dispatched(self, occupancy: int, seconds: float) -> None:
+        self._batches.inc()
+        self._occupancy.observe(occupancy)
+        self._dispatch.observe(seconds)
+
+    def cancelled(self, n: int) -> None:
+        self._cancelled.inc(n)
+
+
 class MicroBatcher:
     """Coalesce submit()-ed items into batches for ``batch_fn``.
 
@@ -36,6 +121,13 @@ class MicroBatcher:
     latency/throughput knob. ``max_queue`` bounds queued items: beyond
     it, ``submit`` raises :class:`BatcherOverloaded` so overload turns
     into fast shedding rather than client-side timeout hangs.
+
+    Returned futures support ``cancel()`` up to the moment their batch
+    is dispatched: a cancelled slot is dropped from the batch (its
+    device work never happens) and counted in
+    ``pio_batch_cancelled_total``. Callers that abandon accepted
+    futures (e.g. a partially-overloaded multi-algorithm batch slot)
+    should cancel them rather than leak the dispatch.
     """
 
     def __init__(
@@ -44,12 +136,20 @@ class MicroBatcher:
         max_batch: int = 64,
         max_wait_ms: float = 2.0,
         max_queue: int | None = None,
+        registry: MetricRegistry | None = None,
+        name: str = "default",
     ):
         self._batch_fn = batch_fn
         self._max_batch = max_batch
         self._max_wait = max_wait_ms / 1000.0
         self._max_queue = (
             max_queue if max_queue is not None else 8 * max_batch
+        )
+        self.name = name
+        self._metrics = (
+            _BatcherMetrics(registry, name)
+            if registry is not None
+            else _NullMetrics()
         )
         self._queue: queue.Queue = queue.Queue()
         self._closed = threading.Event()
@@ -67,11 +167,15 @@ class MicroBatcher:
                 self._max_queue > 0
                 and self._queue.qsize() >= self._max_queue
             ):
+                self._metrics.shed()
                 raise BatcherOverloaded(
                     f"batch queue at capacity ({self._max_queue})"
                 )
             future: Future = Future()
-            self._queue.put((item, future))
+            # the submitting request's ID rides the slot so dispatch
+            # logs can name the requests in a slow/failed batch
+            self._queue.put((item, future, get_request_id()))
+            self._metrics.queue_depth(self._queue.qsize())
             return future
 
     def __call__(self, item: Any, timeout: float | None = 30.0) -> Any:
@@ -100,8 +204,6 @@ class MicroBatcher:
             self._flush(batch)
 
     def _loop(self) -> None:
-        import time
-
         while True:
             first = self._queue.get()
             if first is None:
@@ -124,7 +226,25 @@ class MicroBatcher:
             self._flush(batch)
 
     def _flush(self, batch) -> None:
-        items = [item for item, _f in batch]
+        # a closed batcher is a draining OLD generation — after /reload
+        # its replacement shares the same gauge child (same name), and
+        # a final set() here would overwrite the live queue depth
+        if not self._closed.is_set():
+            self._metrics.queue_depth(self._queue.qsize())
+        # transition every slot to running; cancelled slots drop out
+        # HERE, before the device sees them — cancellation is how an
+        # abandoning caller turns wasted dispatch into avoided dispatch
+        live = [
+            entry
+            for entry in batch
+            if entry[1].set_running_or_notify_cancel()
+        ]
+        if dropped := len(batch) - len(live):
+            self._metrics.cancelled(dropped)
+        if not live:
+            return
+        items = [item for item, _f, _rid in live]
+        t0 = time.perf_counter()
         try:
             results = self._batch_fn(items)
             if len(results) != len(items):
@@ -132,9 +252,26 @@ class MicroBatcher:
                     f"batch_fn returned {len(results)} results for "
                     f"{len(items)} items"
                 )
-            for (_item, future), result in zip(batch, results):
+            elapsed = time.perf_counter() - t0
+            self._metrics.dispatched(len(items), elapsed)
+            log_json(
+                logger, logging.DEBUG, "batch_dispatch",
+                batcher=self.name, occupancy=len(items),
+                ms=round(elapsed * 1000, 3),
+                requestIds=[rid for _i, _f, rid in live if rid],
+            )
+            for (_item, future, _rid), result in zip(live, results):
                 future.set_result(result)
         except Exception as e:  # noqa: BLE001 - propagate to every waiter
-            for _item, future in batch:
+            elapsed = time.perf_counter() - t0
+            self._metrics.dispatched(len(items), elapsed)
+            log_json(
+                logger, logging.WARNING, "batch_dispatch_failed",
+                batcher=self.name, occupancy=len(items),
+                ms=round(elapsed * 1000, 3),
+                error=f"{type(e).__name__}: {e}",
+                requestIds=[rid for _i, _f, rid in live if rid],
+            )
+            for _item, future, _rid in live:
                 if not future.done():
                     future.set_exception(e)

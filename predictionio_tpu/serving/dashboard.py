@@ -8,19 +8,27 @@ from __future__ import annotations
 import html
 
 from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.obs import MetricRegistry, get_registry
 from predictionio_tpu.serving.http import (
     HTTPError,
     HTTPServer,
     Request,
     Response,
     Router,
+    install_metrics_routes,
 )
 
 
 class Dashboard:
-    def __init__(self, storage: Storage | None = None):
+    def __init__(
+        self,
+        storage: Storage | None = None,
+        registry: MetricRegistry | None = None,
+    ):
         self._storage = storage or get_storage()
+        self.registry = registry if registry is not None else get_registry()
         self.router = Router()
+        install_metrics_routes(self.router, self.registry)
         self.router.route("GET", "/", self._index)
         self.router.route("GET", "/engine_instances/<iid>", self._detail)
 
@@ -64,6 +72,7 @@ def create_dashboard(
     port: int = 9000,
     storage: Storage | None = None,
     server_config=None,
+    registry: MetricRegistry | None = None,
 ) -> HTTPServer:
     """When ``server_config`` is None the environment's security config
     applies (key auth + TLS — the reference dashboard mixes in
@@ -72,9 +81,12 @@ def create_dashboard(
 
     if server_config is None:
         server_config = ServerConfig.from_env()
+    dashboard = Dashboard(storage, registry=registry)
     return HTTPServer(
-        Dashboard(storage).router,
+        dashboard.router,
         host=host,
         port=port,
         server_config=server_config,
+        service="dashboard",
+        registry=dashboard.registry,
     )

@@ -20,6 +20,8 @@ Capability parity with the reference's ServerActor/MasterActor
 * ``POST /reload``       → hot-swap to the latest COMPLETED instance
   (MasterActor :337-363)
 * ``POST /stop``         → undeploy (Console.undeploy posts here, :905-932)
+* ``GET /metrics`` / ``GET /metrics.json`` → telemetry scrape
+  (Prometheus text / JSON with derived percentiles; docs/observability.md)
 
 TPU-first difference: queries flow through a
 :class:`~predictionio_tpu.serving.batching.MicroBatcher` per algorithm
@@ -48,6 +50,7 @@ from predictionio_tpu.core.workflow import load_deployment
 from predictionio_tpu.data.datamap import DataMap
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.obs import MetricRegistry, get_registry
 from predictionio_tpu.parallel.mesh import ComputeContext
 from predictionio_tpu.serving.batching import BatcherOverloaded, MicroBatcher
 from predictionio_tpu.serving.plugins import (
@@ -61,7 +64,9 @@ from predictionio_tpu.serving.http import (
     Request,
     Response,
     Router,
+    install_metrics_routes,
 )
+from predictionio_tpu.utils import profiling
 
 logger = logging.getLogger(__name__)
 
@@ -87,6 +92,7 @@ class EngineServer:
         warmup: bool = True,
         log_url: str | None = None,
         log_prefix: str = "",
+        registry: MetricRegistry | None = None,
     ):
         self._engine = engine
         self._params = params
@@ -130,9 +136,19 @@ class EngineServer:
 
         self._lock = threading.Lock()
         self._request_count = 0
+        # wall clock of the last request — single and batch routes agree
         self._last_serving_sec = 0.0
+        # per-query mean of the last BATCH request (ADVICE r5: the old
+        # code stored this into lastServingSec, silently mixing units)
+        self._last_batch_per_query_sec = 0.0
         self._avg_serving_sec = 0.0
         self._start_time = _dt.datetime.now(_dt.timezone.utc)
+        self._registry = registry if registry is not None else get_registry()
+        self._shed_wasted = self._registry.counter(
+            "pio_shed_wasted_dispatch_total",
+            "Per-algorithm dispatches abandoned by partially-shed batch "
+            "slots that could not be cancelled before device dispatch",
+        )
         self._batchers: list[MicroBatcher] = []
         self._load()
 
@@ -144,6 +160,7 @@ class EngineServer:
         )
         self.router.route("POST", "/reload", self._reload)
         self.router.route("POST", "/stop", self._stop)
+        install_metrics_routes(self.router, self._registry)
         install_plugin_routes(self.router, self._plugins, OUTPUT_SNIFFER)
         self._http: HTTPServer | None = None
         if self._log_queue is not None:
@@ -167,16 +184,31 @@ class EngineServer:
         old = self._batchers
         if self._warmup:
             self._precompile(algorithms, models)
+
+        def batch_fn(a, m):
+            def dispatch(qs):
+                out = a.batch_predict(m, qs)
+                # device barrier before the batcher stops its dispatch
+                # clock: async dispatch would otherwise make
+                # pio_device_dispatch_seconds measure enqueue, not work
+                if isinstance(out, (list, tuple)) and out:
+                    profiling.sync(out[-1])
+                else:
+                    profiling.sync(out)
+                return out
+
+            return dispatch
+
         batchers = [
             MicroBatcher(
-                (lambda a, m: lambda qs: a.batch_predict(m, qs))(
-                    algo, model
-                ),
+                batch_fn(algo, model),
                 max_batch=self._max_batch,
                 max_wait_ms=self._max_wait_ms,
                 max_queue=self._max_queue,
+                registry=self._registry,
+                name=f"{self._engine_id}/algo{i}",
             )
-            for algo, model in zip(algorithms, models)
+            for i, (algo, model) in enumerate(zip(algorithms, models))
         ]
         with self._lock:
             self._instance = instance
@@ -269,6 +301,9 @@ class EngineServer:
                 "requestCount": self._request_count,
                 "avgServingSec": round(self._avg_serving_sec, 6),
                 "lastServingSec": round(self._last_serving_sec, 6),
+                "lastBatchPerQuerySec": round(
+                    self._last_batch_per_query_sec, 6
+                ),
             }
 
     def _status(self, request: Request) -> Response:
@@ -335,6 +370,8 @@ class EngineServer:
         ("Request Count", str(data["requestCount"])),
         ("Average Serving Time", f'{data["avgServingSec"]} seconds'),
         ("Last Serving Time", f'{data["lastServingSec"]} seconds'),
+        ("Last Batch Per-Query Time",
+         f'{data["lastBatchPerQuerySec"]} seconds'),
     ])}
     <h2>Data Source</h2>
     {table(params_rows(p.data_source))}
@@ -423,15 +460,20 @@ class EngineServer:
                 serving = self._serving
                 batchers = self._batchers
             supplemented = serving.supplement(query)
+            futures = []
             try:
-                futures = [b.submit(supplemented) for b in batchers]
+                for b in batchers:
+                    futures.append(b.submit(supplemented))
             except BatcherOverloaded:
                 # queue-depth bound hit: shed immediately instead of
-                # queueing into a predict-timeout hang
+                # queueing into a predict-timeout hang. Earlier
+                # algorithms' accepted submits must not run for nothing.
+                self._abandon(futures)
                 raise HTTPError(503, "server overloaded; retry later")
             except RuntimeError:
                 # /reload swapped+closed the batchers between our snapshot
                 # and submit — retry once against the fresh set
+                self._abandon(futures)
                 continue
             break
         else:
@@ -561,11 +603,26 @@ class EngineServer:
         n = len(payload)
         with self._lock:
             self._request_count += n
-            self._last_serving_sec = elapsed / n
+            # wall clock here, per-query mean in its OWN field — the
+            # old code stored elapsed/n into lastServingSec while the
+            # single route stored wall clock (ADVICE r5 semantics mix)
+            self._last_serving_sec = elapsed
+            self._last_batch_per_query_sec = elapsed / n
             self._avg_serving_sec += (
                 elapsed / n - self._avg_serving_sec
             ) * n / self._request_count
         return Response(200, results)
+
+    def _abandon(self, futures) -> None:
+        """A slot's accepted per-algorithm submits are being discarded
+        (partial overload or mid-submit reload): cancel them so the
+        batcher drops the slots before dispatch. A future past the
+        point of cancellation is genuinely wasted device work — counted
+        in ``pio_shed_wasted_dispatch_total`` instead of silently
+        thrown away (ADVICE r5)."""
+        for f in futures:
+            if not f.cancel():
+                self._shed_wasted.inc()
 
     def _submit_batch(
         self, serving, batchers, payload
@@ -578,7 +635,10 @@ class EngineServer:
         ``submit`` was accepted — including a partial multi-algorithm
         slot whose later batcher then raised — which is exactly the
         condition under which a whole-batch retry would double-dispatch
-        (close() is graceful: accepted items still run)."""
+        (close() is graceful: accepted items still run). Abandoned
+        partial slots are cancelled via :meth:`_abandon`, so
+        ``any_submitted`` stays conservative: a cancelled future can
+        already have been dispatched by the time cancel() runs."""
         entries: list[tuple[str, Any, list | None]] = []
         reloading = False
         any_submitted = False
@@ -603,9 +663,11 @@ class EngineServer:
                     futures.append(b.submit(supplemented))
                     any_submitted = True
             except BatcherOverloaded:
+                self._abandon(futures)
                 entries.append(("shed", None, None))
                 continue
             except RuntimeError:
+                self._abandon(futures)
                 reloading = True
                 entries.append(("reloading", None, None))
                 continue
@@ -685,6 +747,8 @@ class EngineServer:
                     server_config=self._server_config,
                     enforce_key=False,
                     reuse_port=reuse_port,
+                    service="engine",
+                    registry=self._registry,
                 )
                 return self._http
             except OSError as exc:

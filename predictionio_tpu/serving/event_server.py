@@ -32,12 +32,14 @@ from typing import Callable
 from predictionio_tpu.data.event import Event, EventValidationError
 from predictionio_tpu.data.storage import Storage, get_storage
 from predictionio_tpu.data.storage.base import PartialBatchError
+from predictionio_tpu.obs import MetricRegistry, get_registry
 from predictionio_tpu.serving.http import (
     HTTPError,
     HTTPServer,
     Request,
     Response,
     Router,
+    install_metrics_routes,
 )
 from predictionio_tpu.serving.plugins import (
     INPUT_SNIFFER,
@@ -67,13 +69,23 @@ class EventServer:
         stats: bool = False,
         input_blockers: list[InputBlocker] | None = None,
         plugins: PluginContext | None = None,
+        registry: MetricRegistry | None = None,
     ):
         self._storage = storage or get_storage()
+        self.registry = registry if registry is not None else get_registry()
+        # the hourly /stats.json view stays opt-in; registry mirroring
+        # happens in _count (not inside Stats) so nothing double-counts
         self._stats = Stats() if stats else None
+        self._ingested = self.registry.counter(
+            "pio_events_ingested_total",
+            "Event-API requests by app and response status",
+            ("app_id", "status"),
+        )
         self._input_blockers = list(input_blockers or [])
         self._plugins = plugins or PluginContext()
         self.router = Router()
         r = self.router
+        install_metrics_routes(r, self.registry)
         r.route("GET", "/", self._status)
         r.route("POST", "/events.json", self._create_event)
         r.route("GET", "/events.json", self._find_events)
@@ -118,6 +130,16 @@ class EventServer:
                 raise HTTPError(400, "Invalid channel.")
             channel_id = match.id
         return access_key.appid, channel_id, tuple(access_key.events)
+
+    def _count(
+        self, app_id: int, status: int, event: Event | None = None
+    ) -> None:
+        """One ingest observation: always into the shared registry
+        (``pio_events_ingested_total``), and into the hourly
+        ``/stats.json`` view when ``--stats`` is on."""
+        self._ingested.labels(str(app_id), str(status)).inc()
+        if self._stats:
+            self._stats.update(app_id, status, event)
 
     # -- routes -----------------------------------------------------------
     def _status(self, request: Request) -> Response:
@@ -166,13 +188,11 @@ class EventServer:
             event_id = self._store(event, app_id, channel_id, whitelist)
         except (EventValidationError, HTTPError) as e:
             status = e.status if isinstance(e, HTTPError) else 400
-            if self._stats:
-                self._stats.update(app_id, status)
+            self._count(app_id, status)
             if isinstance(e, HTTPError):
                 raise
             raise HTTPError(400, str(e)) from e
-        if self._stats:
-            self._stats.update(app_id, 201, event)
+        self._count(app_id, 201, event)
         return Response(201, {"eventId": event_id})
 
     def _parse_time(self, raw: str | None) -> _dt.datetime | None:
@@ -259,8 +279,7 @@ class EventServer:
             except (EventValidationError, HTTPError, TypeError) as e:
                 status = e.status if isinstance(e, HTTPError) else 400
                 results.append({"status": status, "message": str(e)})
-                if self._stats:
-                    self._stats.update(app_id, status)
+                self._count(app_id, status)
         if accepted:
             try:
                 ids = self._storage.get_events().insert_batch(
@@ -286,8 +305,7 @@ class EventServer:
                         results[slot] = {
                             "status": 201, "eventId": saved[i]
                         }
-                        if self._stats:
-                            self._stats.update(app_id, 201, event)
+                        self._count(app_id, 201, event)
                         if event_json is not None:
                             self._plugins.sniff_input(
                                 event_json, app_id, channel_id
@@ -296,13 +314,11 @@ class EventServer:
                         results[slot] = {
                             "status": 500, "message": fail_msg,
                         }
-                        if self._stats:
-                            self._stats.update(app_id, 500)
+                        self._count(app_id, 500)
                 return Response(200, results)
             for (slot, event, event_json), event_id in zip(accepted, ids):
                 results[slot] = {"status": 201, "eventId": event_id}
-                if self._stats:
-                    self._stats.update(app_id, 201, event)
+                self._count(app_id, 201, event)
                 if event_json is not None:
                     self._plugins.sniff_input(
                         event_json, app_id, channel_id
@@ -329,8 +345,7 @@ class EventServer:
             event_id = self._store(event, app_id, channel_id, whitelist)
         except (ConnectorError, EventValidationError) as e:
             raise HTTPError(400, str(e)) from e
-        if self._stats:
-            self._stats.update(app_id, 201, event)
+        self._count(app_id, 201, event)
         return Response(201, {"eventId": event_id})
 
     def _webhook_probe(self, request: Request, connectors) -> Response:
@@ -364,8 +379,7 @@ class EventServer:
             event_id = self._store(event, app_id, channel_id, whitelist)
         except (ConnectorError, EventValidationError) as e:
             raise HTTPError(400, str(e)) from e
-        if self._stats:
-            self._stats.update(app_id, 201, event)
+        self._count(app_id, 201, event)
         return Response(201, {"eventId": event_id})
 
     def close(self) -> None:
@@ -381,6 +395,7 @@ def create_event_server(
     plugins: PluginContext | None = None,
     server_config=None,
     reuse_port: bool = False,
+    registry: MetricRegistry | None = None,
 ) -> HTTPServer:
     """Reference EventServer.createEventServer (default port 7070).
 
@@ -391,7 +406,9 @@ def create_event_server(
 
     if server_config is None:
         server_config = ServerConfig.from_env()
-    server = EventServer(storage=storage, stats=stats, plugins=plugins)
+    server = EventServer(
+        storage=storage, stats=stats, plugins=plugins, registry=registry
+    )
     return HTTPServer(
         server.router,
         host=host,
@@ -399,4 +416,6 @@ def create_event_server(
         server_config=server_config,
         enforce_key=False,
         reuse_port=reuse_port,
+        service="eventserver",
+        registry=server.registry,
     )

@@ -16,11 +16,19 @@ import re
 import socket
 import ssl
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
 from urllib.parse import parse_qs, urlparse
 
+from predictionio_tpu.obs import MetricRegistry, set_request_id
+from predictionio_tpu.obs.context import log_json
+
 logger = logging.getLogger(__name__)
+
+#: structured access log: one JSON line per request (DEBUG on success,
+#: INFO on 4xx, WARNING on 5xx) carrying the request ID
+access_logger = logging.getLogger("predictionio_tpu.access")
 
 Handler = Callable[["Request"], "Response"]
 
@@ -41,6 +49,11 @@ class Request:
         self.headers = headers
         self.body = body
         self.path_params = path_params
+        #: set by the server wrapper (forwarded X-Request-ID or minted)
+        self.request_id: str | None = None
+        #: the route PATTERN that matched (set by Router.dispatch) —
+        #: bounded cardinality, unlike the raw path
+        self.route: str | None = None
 
     def json(self) -> Any:
         if not self.body:
@@ -87,7 +100,7 @@ class Router:
     ``<name:path>`` captures the rest of the path (slashes included)."""
 
     def __init__(self):
-        self._routes: list[tuple[str, re.Pattern, Handler]] = []
+        self._routes: list[tuple[str, re.Pattern, Handler, str]] = []
 
     def route(self, method: str, pattern: str, handler: Handler) -> None:
         # escape literal segments so '.' in '.json' doesn't match anything
@@ -103,12 +116,12 @@ class Router:
             for i, part in enumerate(parts)
         )
         self._routes.append(
-            (method.upper(), re.compile(f"^{built}$"), handler)
+            (method.upper(), re.compile(f"^{built}$"), handler, pattern)
         )
 
     def dispatch(self, request: Request) -> Response:
         path_matched = False
-        for method, regex, handler in self._routes:
+        for method, regex, handler, pattern in self._routes:
             m = regex.match(request.path)
             if not m:
                 continue
@@ -118,10 +131,41 @@ class Router:
             request.path_params = {
                 k: v for k, v in m.groupdict().items()
             }
+            request.route = pattern
             return handler(request)
         if path_matched:
             raise HTTPError(405, "method not allowed")
         raise HTTPError(404, "not found")
+
+    def match_route(self, request: Request) -> str | None:
+        """The route pattern that would handle ``request``, resolved
+        without dispatching — lets failures that fire before dispatch
+        (key auth) still carry a real route label in metrics/logs."""
+        for method, regex, _handler, pattern in self._routes:
+            if method == request.method and regex.match(request.path):
+                return pattern
+        return None
+
+
+def install_metrics_routes(
+    router: Router, registry: MetricRegistry
+) -> None:
+    """The common telemetry surface every server mounts: Prometheus
+    text at ``GET /metrics``, the same registry as JSON at
+    ``GET /metrics.json`` (histograms include derived p50/p95/p99)."""
+
+    def _metrics(request: Request) -> Response:
+        return Response(
+            200,
+            registry.render_prometheus(),
+            content_type="text/plain; version=0.0.4; charset=utf-8",
+        )
+
+    def _metrics_json(request: Request) -> Response:
+        return Response(200, registry.to_dict())
+
+    router.route("GET", "/metrics", _metrics)
+    router.route("GET", "/metrics.json", _metrics_json)
 
 
 class HTTPServer:
@@ -136,6 +180,8 @@ class HTTPServer:
         server_config=None,
         enforce_key: bool = True,
         reuse_port: bool = False,
+        service: str = "http",
+        registry: MetricRegistry | None = None,
     ):
         """``server_config`` (a
         :class:`~predictionio_tpu.serving.config.ServerConfig`) adds the
@@ -145,9 +191,29 @@ class HTTPServer:
         connections are TLS-wrapped with its SSL context
         (SSLConfiguration.scala). ``enforce_key=False`` keeps TLS but
         leaves auth to per-route handlers (the engine server key-auths
-        only its admin routes)."""
+        only its admin routes).
+
+        ``registry`` turns on the telemetry wrapper: every request gets
+        (or forwards) an ``X-Request-ID``, is timed into
+        ``pio_http_request_seconds{service,route}``, counted into
+        ``pio_http_requests_total{service,method,status}``, and emits a
+        structured access-log line. Request-ID handling is always on —
+        only the metrics need a registry."""
         router_ref = router
         config_ref = server_config if enforce_key else None
+        if registry is not None:
+            requests_total = registry.counter(
+                "pio_http_requests_total",
+                "HTTP requests by service, method, and status",
+                ("service", "method", "status"),
+            )
+            request_seconds = registry.histogram(
+                "pio_http_request_seconds",
+                "HTTP request latency by service and route pattern",
+                ("service", "route"),
+            )
+        else:
+            requests_total = request_seconds = None
 
         class _Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -190,8 +256,18 @@ class HTTPServer:
                     body=body,
                     path_params={},
                 )
+                # forwarded or minted; installed in the thread context so
+                # the batcher and log lines downstream can read it
+                request.request_id = set_request_id(
+                    self.headers.get("X-Request-ID")
+                )
+                t0 = time.perf_counter()
                 try:
                     if config_ref is not None:
+                        # resolve the route label BEFORE key auth so a
+                        # 401 counts against the real route, not
+                        # "(unmatched)" alongside path-scan noise
+                        request.route = router_ref.match_route(request)
                         config_ref.check_key(request)
                     response = router_ref.dispatch(request)
                 except HTTPError as e:
@@ -203,10 +279,41 @@ class HTTPServer:
                 except Exception as e:  # noqa: BLE001 - server boundary
                     logger.exception("handler error")
                     response = Response(500, {"message": str(e)})
+                elapsed = time.perf_counter() - t0
+                if response.status >= 400 and isinstance(
+                    response.body, dict
+                ):
+                    # error responses carry the ID so a client report
+                    # can be joined against server logs
+                    response.body = {
+                        **response.body, "requestId": request.request_id
+                    }
                 payload = response.payload()
+                route = request.route or "(unmatched)"
+                if requests_total is not None:
+                    requests_total.labels(
+                        service, self.command, str(response.status)
+                    ).inc()
+                    request_seconds.labels(service, route).observe(
+                        elapsed
+                    )
+                log_json(
+                    access_logger,
+                    logging.WARNING if response.status >= 500
+                    else logging.INFO if response.status >= 400
+                    else logging.DEBUG,
+                    "http_request",
+                    service=service,
+                    method=self.command,
+                    path=parsed.path,
+                    route=route,
+                    status=response.status,
+                    ms=round(elapsed * 1000, 3),
+                )
                 self.send_response(response.status)
                 self.send_header("Content-Type", response.content_type)
                 self.send_header("Content-Length", str(len(payload)))
+                self.send_header("X-Request-ID", request.request_id)
                 for k, v in response.headers.items():
                     self.send_header(k, v)
                 self.end_headers()

@@ -5,6 +5,11 @@ Capability parity with the reference's ``StatsActor``/``Stats``
 counters for request statuses, event names, and entity types, bucketed
 by hour, surfaced at ``GET /stats.json`` when the server runs with
 ``stats=True``.
+
+Registry mirroring (``pio_events_ingested_total{app_id,status}``) is
+deliberately NOT done here — ``EventServer._count`` is the single
+mirroring site, counting every ingest whether or not the hourly
+``/stats.json`` view is enabled.
 """
 
 from __future__ import annotations
@@ -14,6 +19,11 @@ import threading
 from collections import Counter
 
 from predictionio_tpu.data.event import Event
+
+
+def _now() -> _dt.datetime:
+    """Module-level so tests can pin the clock (hour-bucket rollover)."""
+    return _dt.datetime.now(_dt.timezone.utc)
 
 
 def _hour_bucket(t: _dt.datetime) -> str:
@@ -27,12 +37,12 @@ class Stats:
         self._status: dict[tuple[str, int], Counter] = {}
         self._events: dict[tuple[str, int], Counter] = {}
         self._entity_types: dict[tuple[str, int], Counter] = {}
-        self.start_time = _dt.datetime.now(_dt.timezone.utc)
+        self.start_time = _now()
 
     def update(
         self, app_id: int, status: int, event: Event | None = None
     ) -> None:
-        bucket = _hour_bucket(_dt.datetime.now(_dt.timezone.utc))
+        bucket = _hour_bucket(_now())
         key = (bucket, app_id)
         with self._lock:
             self._status.setdefault(key, Counter())[str(status)] += 1

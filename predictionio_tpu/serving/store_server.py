@@ -51,6 +51,7 @@ from predictionio_tpu.data.storage.httpstore import (
     manifest_from_json,
     manifest_to_json,
 )
+from predictionio_tpu.obs import MetricRegistry, get_registry
 from predictionio_tpu.serving.config import ServerConfig
 from predictionio_tpu.serving.http import (
     HTTPError,
@@ -58,6 +59,7 @@ from predictionio_tpu.serving.http import (
     Request,
     Response,
     Router,
+    install_metrics_routes,
 )
 
 
@@ -66,8 +68,13 @@ class StoreServer:
     hands the :class:`ServerConfig` to :class:`HTTPServer`, which
     enforces the key on every route before dispatch."""
 
-    def __init__(self, storage: Storage | None = None):
+    def __init__(
+        self,
+        storage: Storage | None = None,
+        registry: MetricRegistry | None = None,
+    ):
         self._storage = storage or get_storage()
+        self.registry = registry if registry is not None else get_registry()
         s = self._storage
         #: <kind> -> (dao getter, to_json, from_json, id parser);
         #: getters defer DAO construction to request time
@@ -108,6 +115,7 @@ class StoreServer:
         }
         self.router = Router()
         r = self.router
+        install_metrics_routes(r, self.registry)
         r.route("GET", "/", self._status)
         r.route("GET", "/meta/engine_manifests/<id>/<version>",
                 self._manifest_get)
@@ -308,10 +316,14 @@ def create_store_server(
     port: int = 7072,
     storage: Storage | None = None,
     server_config: ServerConfig | None = None,
+    registry: MetricRegistry | None = None,
 ) -> HTTPServer:
+    server = StoreServer(storage, registry=registry)
     return HTTPServer(
-        StoreServer(storage).router,
+        server.router,
         host=host,
         port=port,
         server_config=server_config,
+        service="storeserver",
+        registry=server.registry,
     )

@@ -73,6 +73,25 @@ class StepTimer:
     def to_json(self) -> str:
         return json.dumps(self.summary())
 
+    def publish(self, registry, name: str = "pio_train_step_seconds"):
+        """Fold the records into a shared metric registry
+        (:class:`~predictionio_tpu.obs.MetricRegistry`) as a per-step
+        labeled histogram — the bridge that makes train-time timing
+        scrapeable from the same ``/metrics`` surface as serving."""
+        from predictionio_tpu.obs import TRAIN_STEP_BUCKETS
+
+        hist = registry.histogram(
+            name,
+            "Training-loop step wall clock (StepTimer records)",
+            ("step",),
+            buckets=TRAIN_STEP_BUCKETS,
+        )
+        for step, xs in self.records.items():
+            child = hist.labels(step)
+            for seconds in xs:
+                child.observe(seconds)
+        return hist
+
     def log_summary(self, prefix: str = "") -> None:
         for name, s in self.summary().items():
             logger.info(

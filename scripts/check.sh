@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Repo check gate: the ROADMAP.md tier-1 pytest run plus a live
+# /metrics scrape smoke test, so telemetry regressions fail fast.
+# Usage: scripts/check.sh [--smoke-only]
+set -u -o pipefail
+
+cd "$(dirname "$0")/.."
+
+rc=0
+
+if [ "${1:-}" != "--smoke-only" ]; then
+    echo "== tier-1 pytest (ROADMAP.md) =="
+    rm -f /tmp/_t1.log
+    timeout -k 10 870 env JAX_PLATFORMS=cpu \
+        python -m pytest tests/ -q -m 'not slow' \
+        --continue-on-collection-errors -p no:cacheprovider \
+        -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+    t1_rc=${PIPESTATUS[0]}
+    echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+    if [ "$t1_rc" -ne 0 ]; then
+        echo "tier-1 pytest FAILED (rc=$t1_rc)"
+        rc=1
+    fi
+fi
+
+echo "== telemetry smoke test (live /metrics scrape) =="
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python scripts/metrics_smoke.py; then
+    echo "telemetry smoke test FAILED"
+    rc=1
+fi
+
+exit $rc

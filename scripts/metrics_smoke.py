@@ -1,0 +1,141 @@
+"""Telemetry smoke test: deploy a fake engine in-process, scrape
+``/metrics``, and verify request-ID echo — run by ``scripts/check.sh``
+so a telemetry regression fails fast without waiting on the full suite.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)  # the package itself (no install required)
+sys.path.insert(0, os.path.join(REPO, "tests"))  # fake_engine fixture
+
+
+def main() -> int:
+    from fake_engine import (
+        FakeAlgorithm,
+        FakeDataSource,
+        FakeParams,
+        FakePreparator,
+        FakeServing,
+    )
+    from predictionio_tpu.core import Engine, EngineParams
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.data.storage import Storage, set_storage
+    from predictionio_tpu.parallel.mesh import ComputeContext
+    from predictionio_tpu.serving.engine_server import EngineServer
+
+    class SmokeAlgorithm(FakeAlgorithm):
+        def predict(self, model, query):
+            return {"result": int(query.get("x", 0))}
+
+        def batch_predict(self, model, queries):
+            return [self.predict(model, q) for q in queries]
+
+    class SmokeServing(FakeServing):
+        def serve(self, query, predictions):
+            return predictions[0]
+
+    storage = Storage(
+        env={
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        }
+    )
+    set_storage(storage)
+    engine = Engine(
+        FakeDataSource, FakePreparator, SmokeAlgorithm, SmokeServing
+    )
+    params = EngineParams(
+        data_source=("", FakeParams(id=1)),
+        preparator=("", FakeParams(id=2)),
+        algorithms=[("", FakeParams(id=3))],
+        serving=("", FakeParams()),
+    )
+    ctx = ComputeContext.create(batch="metrics-smoke")
+    run_train(
+        engine, params, engine_id="smoke", ctx=ctx, storage=storage
+    )
+    server = EngineServer(
+        engine, params, engine_id="smoke", storage=storage, ctx=ctx,
+        warmup=False,
+    )
+    http = server.serve(host="127.0.0.1", port=0)
+    http.start()
+    base = f"http://127.0.0.1:{http.port}"
+    failures: list[str] = []
+
+    def check(cond: bool, label: str) -> None:
+        print(("ok   " if cond else "FAIL ") + label)
+        if not cond:
+            failures.append(label)
+
+    try:
+        req = urllib.request.Request(
+            f"{base}/queries.json",
+            data=json.dumps({"x": 7}).encode(),
+            method="POST",
+            headers={"X-Request-ID": "smoke-1"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            check(resp.status == 200, "query answered")
+            check(
+                resp.headers.get("X-Request-ID") == "smoke-1",
+                "X-Request-ID echoed",
+            )
+
+        with urllib.request.urlopen(
+            f"{base}/metrics", timeout=10
+        ) as resp:
+            text = resp.read().decode()
+        for needle in (
+            "pio_http_request_seconds_bucket",
+            'route="/queries.json"',
+            "pio_http_requests_total",
+            "pio_batch_occupancy_bucket",
+            "pio_batch_queue_depth",
+            "pio_device_dispatch_seconds_bucket",
+        ):
+            check(needle in text, f"/metrics exposes {needle}")
+
+        with urllib.request.urlopen(
+            f"{base}/metrics.json", timeout=10
+        ) as resp:
+            data = json.load(resp)
+        lat = data.get("pio_http_request_seconds", {})
+        sample = next(
+            (
+                s for s in lat.get("samples", ())
+                if s["labels"].get("route") == "/queries.json"
+            ),
+            None,
+        )
+        check(
+            sample is not None and sample["p50"] is not None,
+            "/metrics.json derives percentiles",
+        )
+        check(
+            data.get("pio_train_step_seconds") is not None,
+            "train-time StepTimer records joined the registry",
+        )
+    finally:
+        http.shutdown()
+        server.close()
+
+    if failures:
+        print(f"metrics smoke: {len(failures)} check(s) FAILED")
+        return 1
+    print("metrics smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

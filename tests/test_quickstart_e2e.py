@@ -102,7 +102,7 @@ def test_recommendation_quickstart(env, tmp_path):
             [
                 sys.executable,
                 os.path.join(_EXAMPLES, "import_eventserver.py"),
-                "--access-key", key,
+                f"--access-key={key}",
                 "--url", f"http://127.0.0.1:{es_port}",
                 "--users", "40", "--items", "20",
             ],
@@ -167,7 +167,7 @@ def test_leadscoring_quickstart(env, tmp_path):
             [
                 sys.executable,
                 os.path.join(examples, "import_eventserver.py"),
-                "--access-key", key,
+                f"--access-key={key}",
                 "--url", f"http://127.0.0.1:{es_port}",
                 "--leads", "40",
             ],

@@ -1,0 +1,112 @@
+"""serving/stats.py coverage (ISSUE 1 satellite): hour-bucket rollover,
+multi-app isolation, and concurrent update() — the lock finally gets
+exercised. Registry mirroring lives in ``EventServer._count`` (single
+site) and is covered end-to-end in test_obs.py."""
+
+import datetime as dt
+import threading
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.serving import stats as stats_mod
+from predictionio_tpu.serving.stats import Stats
+
+
+def _event(name="view", entity_type="user"):
+    return Event(event=name, entity_type=entity_type, entity_id="e1")
+
+
+class TestHourBuckets:
+    def test_rollover_creates_a_new_bucket(self, monkeypatch):
+        t = dt.datetime(2026, 8, 2, 10, 59, tzinfo=dt.timezone.utc)
+        monkeypatch.setattr(stats_mod, "_now", lambda: t)
+        s = Stats()
+        s.update(1, 201, _event())
+        # clock crosses the hour boundary
+        t2 = t + dt.timedelta(minutes=2)
+        monkeypatch.setattr(stats_mod, "_now", lambda: t2)
+        s.update(1, 201, _event())
+        buckets = {bucket for bucket, _aid in s._status}
+        assert buckets == {
+            "2026-08-02T10:00:00Z",
+            "2026-08-02T11:00:00Z",
+        }
+        # snapshot aggregates across buckets
+        assert s.snapshot(1)["statusCount"] == {"201": 2}
+
+    def test_bucket_is_utc_even_for_offset_times(self, monkeypatch):
+        tz = dt.timezone(dt.timedelta(hours=5, minutes=30))
+        t = dt.datetime(2026, 8, 2, 1, 15, tzinfo=tz)  # 19:45Z prev day
+        monkeypatch.setattr(stats_mod, "_now", lambda: t)
+        s = Stats()
+        s.update(1, 201)
+        (bucket, _aid), = s._status
+        assert bucket == "2026-08-01T19:00:00Z"
+
+
+class TestMultiAppIsolation:
+    def test_snapshots_do_not_mix_apps(self):
+        s = Stats()
+        s.update(1, 201, _event("view"))
+        s.update(1, 400)
+        s.update(2, 201, _event("buy", entity_type="order"))
+        snap1 = s.snapshot(1)
+        snap2 = s.snapshot(2)
+        assert snap1["statusCount"] == {"201": 1, "400": 1}
+        assert snap1["eventCount"] == {"view": 1}
+        assert snap2["statusCount"] == {"201": 1}
+        assert snap2["eventCount"] == {"buy": 1}
+        assert snap2["entityTypeCount"] == {"order": 1}
+
+    def test_unknown_app_snapshot_is_empty(self):
+        s = Stats()
+        s.update(1, 201)
+        assert s.snapshot(99)["statusCount"] == {}
+
+
+class TestConcurrency:
+    def test_concurrent_updates_lose_nothing(self):
+        s = Stats()
+        n_threads, per_thread = 8, 500
+        barrier = threading.Barrier(n_threads)
+
+        def work(app_id):
+            barrier.wait()
+            for _ in range(per_thread):
+                s.update(app_id, 201, _event())
+
+        threads = [
+            threading.Thread(target=work, args=(i % 2,))
+            for i in range(n_threads)
+        ]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        total = (
+            s.snapshot(0)["statusCount"]["201"]
+            + s.snapshot(1)["statusCount"]["201"]
+        )
+        assert total == n_threads * per_thread
+        assert s.snapshot(0)["eventCount"]["view"] == 2000
+
+    def test_concurrent_update_and_snapshot(self):
+        """snapshot() while updates are in flight must neither crash
+        nor observe torn counters (RuntimeError on dict mutation)."""
+        s = Stats()
+        stop = threading.Event()
+        errors = []
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    snap = s.snapshot(1)
+                    assert snap["statusCount"].get("201", 0) >= 0
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+        t = threading.Thread(target=reader)
+        t.start()
+        for _ in range(2000):
+            s.update(1, 201)
+        stop.set()
+        t.join()
+        assert errors == []
+        assert s.snapshot(1)["statusCount"] == {"201": 2000}

@@ -268,7 +268,7 @@ class TestMultiWorkerEventServer:
             seed = subprocess.run(
                 [sys.executable,
                  os.path.join(examples, "import_eventserver.py"),
-                 "--access-key", key,
+                 f"--access-key={key}",
                  "--url", f"http://127.0.0.1:{port}",
                  "--leads", "40"],
                 env=env, capture_output=True, text=True, timeout=240,
