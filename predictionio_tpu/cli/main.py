@@ -1,6 +1,6 @@
 """``pio``-style console (reference tools/.../console/Console.scala:186-677).
 
-Verbs: version, status, app (new/list/show/delete/data-delete/
+Verbs: version, status, trace, app (new/list/show/delete/data-delete/
 channel-new/channel-delete), accesskey (new/list/delete), build, train,
 eval, deploy, undeploy, eventserver, dashboard, adminserver, export,
 import, template (list/get), run.
@@ -20,6 +20,7 @@ import os
 import subprocess
 import sys
 
+from predictionio_tpu.obs.context import redact_keys
 from predictionio_tpu.version import __version__
 
 
@@ -112,19 +113,36 @@ def cmd_version(args) -> int:
     return 0
 
 
-def _print_metrics(url: str) -> int:
-    """Scrape a live server's ``/metrics.json`` and print a per-metric
-    one-liner (histograms with derived p50/p95/p99)."""
+def _fetch_json(target: str, access_key: str = ""):
+    """GET + parse one telemetry endpoint; on any transport/parse
+    failure prints a clean ``[ERROR]`` (key redacted) and returns None.
+    ``access_key`` travels as ``X-PIO-Server-Key`` — the header
+    ServerConfig.check_key prefers, because query strings leak into
+    request logs and proxies. ValueError covers JSONDecodeError: a
+    proxy error page or a non-pio service answering 200 must not
+    traceback."""
     import urllib.request
 
-    target = url.rstrip("/") + "/metrics.json"
+    req = urllib.request.Request(target)
+    if access_key:
+        req.add_header("X-PIO-Server-Key", access_key)
     try:
-        with urllib.request.urlopen(target, timeout=10) as resp:
-            data = json.load(resp)
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.load(resp)
     except (OSError, ValueError) as e:
-        # ValueError covers JSONDecodeError: a proxy error page or a
-        # non-pio service answering 200 must not traceback
-        print(f"[ERROR] cannot scrape {target}: {e}", file=sys.stderr)
+        print(
+            f"[ERROR] cannot fetch {redact_keys(target)}: {e}",
+            file=sys.stderr,
+        )
+        return None
+
+
+def _print_metrics(url: str, access_key: str = "") -> int:
+    """Scrape a live server's ``/metrics.json`` and print a per-metric
+    one-liner (histograms with derived p50/p95/p99)."""
+    target = url.rstrip("/") + "/metrics.json"
+    data = _fetch_json(target, access_key=access_key)
+    if data is None:
         return 1
     try:
         for name in sorted(data):
@@ -144,7 +162,8 @@ def _print_metrics(url: str) -> int:
                     print(f"{name}{label} {sample['value']}")
     except (AttributeError, KeyError, TypeError) as e:
         print(
-            f"[ERROR] {target} is not a pio metrics.json payload: {e!r}",
+            f"[ERROR] {redact_keys(target)} is not a pio metrics.json "
+            f"payload: {e!r}",
             file=sys.stderr,
         )
         return 1
@@ -159,7 +178,9 @@ def cmd_status(args) -> int:
         # pure HTTP — return before the storage/mesh imports below pull
         # in jax (seconds of startup, and a crash if the local
         # accelerator runtime is broken) just to scrape a remote server
-        return _print_metrics(args.metrics_url)
+        return _print_metrics(
+            args.metrics_url, getattr(args, "access_key", "")
+        )
 
     from predictionio_tpu.data.storage import get_storage
     from predictionio_tpu.parallel.mesh import (
@@ -185,6 +206,52 @@ def cmd_status(args) -> int:
         return 1
     print("Storage status: OK")
     print("Your system is all ready to go.")
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """Pull the tracing flight recorder from any live server and write
+    a Perfetto-loadable trace file (``pio-tpu trace --url
+    http://host:8000 --out trace.json``; open at ui.perfetto.dev).
+    Pure HTTP — never imports jax (mirrors ``status --metrics-url``)."""
+    target = args.url.rstrip("/") + (
+        "/debug/traces.json" if args.raw else "/debug/traces"
+    )
+    data = _fetch_json(target, access_key=args.access_key)
+    if data is None:
+        return 1
+    if not isinstance(data, dict):
+        # a non-pio service answering 200 with a JSON array/scalar must
+        # not traceback (same hardening as status --metrics-url)
+        data = {}
+    if args.raw:
+        if not isinstance(data.get("traces"), list):
+            print(
+                f"[ERROR] {redact_keys(target)} is not a pio "
+                "raw-trace payload",
+                file=sys.stderr,
+            )
+            return 1
+        summary = f"{len(data['traces'])} trace(s)"
+    else:
+        events = data.get("traceEvents")
+        if not isinstance(events, list):
+            print(
+                f"[ERROR] {redact_keys(target)} is not a Chrome "
+                "trace-event payload",
+                file=sys.stderr,
+            )
+            return 1
+        summary = f"{len(events)} trace event(s)"
+    try:
+        with open(args.out, "w") as f:
+            json.dump(data, f)
+    except OSError as e:
+        print(f"[ERROR] cannot write {args.out}: {e}", file=sys.stderr)
+        return 1
+    print(f"Wrote {summary} to {args.out}")
+    if not args.raw:
+        print("Open it at https://ui.perfetto.dev (or chrome://tracing).")
     return 0
 
 
@@ -1001,7 +1068,32 @@ def build_parser() -> argparse.ArgumentParser:
         help="scrape a running server's /metrics.json instead of "
              "checking local storage/compute",
     )
+    p.add_argument(
+        "--access-key", dest="access_key", default="",
+        help="server access key for key-authed scrape targets "
+             "(sent as the X-PIO-Server-Key header)",
+    )
     p.set_defaults(func=cmd_status)
+
+    p = sub.add_parser("trace")
+    p.add_argument(
+        "--url", required=True,
+        help="base URL of a live server (engine/event/store/dashboard)",
+    )
+    p.add_argument(
+        "--out", default="trace.json",
+        help="output file (default: trace.json)",
+    )
+    p.add_argument(
+        "--raw", action="store_true",
+        help="fetch raw span trees (/debug/traces.json) instead of "
+             "Perfetto-loadable Chrome trace-event JSON",
+    )
+    p.add_argument(
+        "--access-key", dest="access_key", default="",
+        help="server access key (servers that key-auth every route)",
+    )
+    p.set_defaults(func=cmd_trace)
 
     p = sub.add_parser("app")
     ap = p.add_subparsers(dest="app_command", required=True)

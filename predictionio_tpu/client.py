@@ -16,6 +16,9 @@ import urllib.parse
 import urllib.request
 from typing import Any, Mapping, Sequence
 
+from predictionio_tpu.obs.context import get_request_id
+from predictionio_tpu.obs.tracing import PARENT_SPAN_HEADER, current_span
+
 
 class PIOClientError(RuntimeError):
     def __init__(self, status: int, message: str):
@@ -31,6 +34,16 @@ def _request(
     req = urllib.request.Request(url, data=data, method=method)
     if data is not None:
         req.add_header("Content-Type", "application/json")
+    # join the caller's trace: forward the context request ID (even
+    # with tracing off — without it every hop mints a fresh ID and
+    # cross-server log correlation breaks) and, when a span is open,
+    # our span ID so the downstream server's root span nests under it
+    rid = get_request_id()
+    if rid:
+        req.add_header("X-Request-ID", rid)
+    parent = current_span()
+    if parent is not None:
+        req.add_header(PARENT_SPAN_HEADER, parent.span_id)
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             raw = resp.read()

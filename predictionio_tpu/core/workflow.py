@@ -13,7 +13,9 @@ from __future__ import annotations
 
 import dataclasses
 import datetime as _dt
+import json
 import logging
+import os
 from typing import Any, Sequence
 
 from predictionio_tpu.core.controller import PersistenceMode
@@ -34,6 +36,7 @@ from predictionio_tpu.data.storage import (
     Storage,
     get_storage,
 )
+from predictionio_tpu.obs import tracing
 from predictionio_tpu.parallel.mesh import ComputeContext
 from predictionio_tpu.utils.profiling import StepTimer, trace
 
@@ -42,6 +45,43 @@ logger = logging.getLogger(__name__)
 
 def _now() -> _dt.datetime:
     return _dt.datetime.now(_dt.timezone.utc)
+
+
+def _write_train_trace(
+    tracer, trace_id: str | None, instance_id: str
+) -> None:
+    """Persist the run's span timeline as Chrome trace-event JSON in
+    ``PIO_TRACE_DIR`` (the directory ``utils/profiling.trace`` already
+    uses for device-level traces) — ``pio train`` produces the same
+    Perfetto-loadable artifact the servers serve at ``/debug/traces``.
+    Best-effort: a full disk must not fail a COMPLETED run."""
+    trace_dir = os.environ.get("PIO_TRACE_DIR")
+    if not trace_dir or trace_id is None:
+        return
+    try:
+        os.makedirs(trace_dir, exist_ok=True)
+        path = os.path.join(
+            trace_dir, f"pio_train_{instance_id}.trace.json"
+        )
+        timeline = tracer.chrome_trace(trace_id=trace_id)
+        with open(path, "w") as f:
+            # default=str: span attributes are caller-supplied (numpy
+            # scalars, shapes, ...) and must not fail a COMPLETED run
+            json.dump(timeline, f, default=str)
+        if timeline["traceEvents"]:
+            logger.info("wrote training span timeline to %s", path)
+        else:
+            # the recorder can abandon a very long run's open trace at
+            # its cap (a trainer that also serves heavy traffic) — an
+            # empty timeline must not masquerade as a success
+            logger.warning(
+                "training trace %s has no spans (recorder abandoned "
+                "it?); wrote empty timeline to %s", trace_id, path,
+            )
+    except (OSError, TypeError, ValueError) as e:
+        # truly best-effort: a serialization surprise in the finally
+        # must neither fail a COMPLETED run nor mask a training error
+        logger.warning("could not write training trace: %s", e)
 
 
 def run_train(
@@ -77,56 +117,79 @@ def run_train(
     instance_id = instances.insert(instance)
     instance = instances.get(instance_id)
     ctx = ctx or ComputeContext.create(batch=workflow.batch or engine_id)
+    tracer = tracing.get_tracer()
+    # the whole run is one trace (trace ID = instance ID): the
+    # StepTimer steps inside engine.train become child spans, and the
+    # same timeline format every server exposes at /debug/traces is
+    # written to PIO_TRACE_DIR after the run — in the finally, because
+    # the timeline of a FAILED run is the one most worth keeping
+    root_trace_id = None
     try:
-        # record the compute topology on the run record (the reference
-        # stores sparkConf on EngineInstance, EngineInstances.scala:43-69);
-        # inside the try so a storage failure still marks the run FAILED
-        mesh = ctx.mesh
-        instance = dataclasses.replace(
-            instance,
-            mesh_conf={
-                "shape": ",".join(str(s) for s in mesh.devices.shape),
-                "axes": ",".join(mesh.axis_names),
-                "devices": str(mesh.devices.size),
-                "platform": mesh.devices.flat[0].platform,
+        with tracer.trace(
+            "pio_train",
+            trace_id=instance_id,
+            attributes={
+                "engineId": engine_id,
+                "engineVersion": engine_version,
+                "engineVariant": engine_variant,
             },
-        )
-        instances.update(instance)
-        # build algorithm instances once: the SAME objects train and (for
-        # MANUAL persistence) save, so trained state is what gets saved
-        algorithms = engine.make_algorithms(params)
-        timer = StepTimer()
-        for algo in algorithms:
-            algo.timer = timer
-        with timer.step("train/total"), trace():
-            models = engine.train(
-                ctx, params, workflow, algorithms=algorithms
+        ) as root_span:
+            if root_span is not None:
+                root_trace_id = root_span.trace_id
+            # record the compute topology on the run record (the
+            # reference stores sparkConf on EngineInstance,
+            # EngineInstances.scala:43-69); inside the try so a storage
+            # failure still marks the run FAILED
+            mesh = ctx.mesh
+            instance = dataclasses.replace(
+                instance,
+                mesh_conf={
+                    "shape": ",".join(str(s) for s in mesh.devices.shape),
+                    "axes": ",".join(mesh.axis_names),
+                    "devices": str(mesh.devices.size),
+                    "platform": mesh.devices.flat[0].platform,
+                },
             )
-        timer.log_summary(prefix=f"[{engine_id}] ")
-        # train-time telemetry joins the process registry: a trainer
-        # that also serves (or exposes /metrics) scrapes both as one
-        from predictionio_tpu.obs import get_registry
+            instances.update(instance)
+            # build algorithm instances once: the SAME objects train and
+            # (for MANUAL persistence) save, so trained state is what
+            # gets saved
+            algorithms = engine.make_algorithms(params)
+            timer = StepTimer()
+            for algo in algorithms:
+                algo.timer = timer
+            with timer.step("train/total"), trace():
+                models = engine.train(
+                    ctx, params, workflow, algorithms=algorithms
+                )
+            timer.log_summary(prefix=f"[{engine_id}] ")
+            # train-time telemetry joins the process registry: a trainer
+            # that also serves (or exposes /metrics) scrapes both as one
+            from predictionio_tpu.obs import get_registry
 
-        timer.publish(get_registry())
-        instance = dataclasses.replace(
-            instance, env={"timing": timer.to_json()}
-        )
-        if workflow.save_model:
-            blob = serialize_models(instance_id, algorithms, models)
-            storage.get_model_data_models().insert(
-                Model(id=instance_id, models=blob)
+            timer.publish(get_registry())
+            instance = dataclasses.replace(
+                instance, env={"timing": timer.to_json()}
             )
-            logger.info(
-                "persisted %d model(s) for instance %s (%d bytes)",
-                len(models),
-                instance_id,
-                len(blob),
+            if workflow.save_model:
+                with tracing.span("train/persist_model"):
+                    blob = serialize_models(
+                        instance_id, algorithms, models
+                    )
+                    storage.get_model_data_models().insert(
+                        Model(id=instance_id, models=blob)
+                    )
+                logger.info(
+                    "persisted %d model(s) for instance %s (%d bytes)",
+                    len(models),
+                    instance_id,
+                    len(blob),
+                )
+            instances.update(
+                dataclasses.replace(
+                    instance, status="COMPLETED", end_time=_now()
+                )
             )
-        instances.update(
-            dataclasses.replace(
-                instance, status="COMPLETED", end_time=_now()
-            )
-        )
         return instance_id
     except (StopAfterReadInterruption, StopAfterPrepareInterruption):
         instances.update(
@@ -142,6 +205,10 @@ def run_train(
             )
         )
         raise
+    finally:
+        # the root span finalized when the with-block unwound, so the
+        # trace is in the ring even when train raised
+        _write_train_trace(tracer, root_trace_id, instance_id)
 
 
 def run_evaluation(

@@ -36,6 +36,8 @@ import threading
 import urllib.parse
 from typing import Any
 
+from predictionio_tpu.obs import tracing
+from predictionio_tpu.obs.context import get_request_id
 from predictionio_tpu.data.storage.base import (
     AccessKey,
     AccessKeysBackend,
@@ -279,6 +281,7 @@ class HTTPStoreClient:
         raw_body: bytes | None = None,
     ) -> tuple[int, bytes]:
         """One HTTP round trip; returns (status, body bytes)."""
+        route = path  # pre-query-string, for bounded span cardinality
         if params:
             qs = urllib.parse.urlencode(
                 {k: v for k, v in params.items() if v is not None}
@@ -288,6 +291,12 @@ class HTTPStoreClient:
         headers = {}
         if self._key:
             headers["Authorization"] = f"Bearer {self._key}"
+        # the caller's request ID rides every store hop (even with
+        # tracing off) so event-server → store-server logs correlate;
+        # with a span open, the hop also joins the distributed trace
+        rid = get_request_id()
+        if rid:
+            headers["X-Request-ID"] = rid
         if json_body is not None:
             body = json.dumps(json_body).encode("utf-8")
             headers["Content-Type"] = "application/json"
@@ -296,6 +305,16 @@ class HTTPStoreClient:
             headers["Content-Type"] = "application/octet-stream"
         else:
             body = None
+        with tracing.span(
+            f"httpstore {method} {route}", host=self._host
+        ) as span:
+            if span is not None:
+                headers[tracing.PARENT_SPAN_HEADER] = span.span_id
+            return self._roundtrip(method, path, body, headers, span)
+
+    def _roundtrip(
+        self, method, path, body, headers, span
+    ) -> tuple[int, bytes]:
         for attempt in (0, 1):
             conn, reused = self._connection()
             sent = False
@@ -331,6 +350,8 @@ class HTTPStoreClient:
                     f"store server {self._host}:{self._port} unreachable: "
                     f"{e}"
                 ) from e
+            if span is not None:
+                span.set("status", resp.status)
             if resp.status in (401, 403):
                 raise StorageError(
                     "store server rejected the access key "

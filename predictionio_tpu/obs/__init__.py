@@ -28,6 +28,13 @@ from predictionio_tpu.obs.registry import (
     TRAIN_STEP_BUCKETS,
     get_registry,
 )
+from predictionio_tpu.obs.tracing import (
+    Span,
+    Tracer,
+    current_span,
+    get_tracer,
+    span,
+)
 
 __all__ = [
     "Counter",
@@ -35,9 +42,14 @@ __all__ = [
     "Histogram",
     "LATENCY_BUCKETS",
     "MetricRegistry",
+    "Span",
     "TRAIN_STEP_BUCKETS",
+    "Tracer",
+    "current_span",
     "get_registry",
     "get_request_id",
+    "get_tracer",
     "new_request_id",
     "set_request_id",
+    "span",
 ]

@@ -19,6 +19,7 @@ from __future__ import annotations
 import bisect
 import math
 import threading
+import time
 from typing import Callable, Iterable
 
 #: default latency buckets (seconds): sub-ms through 10 s, roughly
@@ -441,7 +442,33 @@ class MetricRegistry:
         return out
 
 
+#: telemetry-import wall clock — the uptime anchor for scrapes. (A
+#: /proc/self/stat read would be a few ms more precise but platform-
+#: bound; servers import telemetry within moments of process start.)
+_PROCESS_START_TIME = time.time()
+
+
+def _install_process_metrics(registry: MetricRegistry) -> None:
+    """Deploy-correlation gauges on the default registry:
+    ``pio_build_info{version=...} 1`` identifies which build answered a
+    scrape (regressions line up with deploys), and
+    ``pio_process_start_time_seconds`` lets dashboards compute uptime
+    (``time() - pio_process_start_time_seconds``)."""
+    from predictionio_tpu.version import __version__
+
+    registry.gauge(
+        "pio_build_info",
+        "Constant 1, labeled with the running package version",
+        ("version",),
+    ).labels(__version__).set(1)
+    registry.gauge(
+        "pio_process_start_time_seconds",
+        "Unix time this process's telemetry started",
+    ).set(_PROCESS_START_TIME)
+
+
 _default_registry = MetricRegistry()
+_install_process_metrics(_default_registry)
 
 
 def get_registry() -> MetricRegistry:

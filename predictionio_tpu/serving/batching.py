@@ -22,9 +22,10 @@ import queue
 import threading
 import time
 from concurrent.futures import Future
-from typing import Any, Callable, Sequence
+from typing import Any, Callable, NamedTuple, Sequence
 
 from predictionio_tpu.obs import MetricRegistry, get_request_id
+from predictionio_tpu.obs import tracing
 from predictionio_tpu.obs.context import log_json
 from predictionio_tpu.obs.registry import LATENCY_BUCKETS, OCCUPANCY_BUCKETS
 
@@ -38,6 +39,18 @@ class BatcherOverloaded(Exception):
     (client should back off, 503 fast) from a closed batcher mid-reload
     (retry against the fresh set).
     """
+
+
+class _Slot(NamedTuple):
+    """One queued submission: the payload, its Future, and the
+    submitting request's identity (ID + open span + submit time) for
+    dispatch logs and trace spans."""
+
+    item: Any
+    future: Future
+    request_id: str | None
+    parent_span: Any  # tracing.Span | None
+    submitted_mono: float
 
 
 class _NullMetrics:
@@ -172,9 +185,21 @@ class MicroBatcher:
                     f"batch queue at capacity ({self._max_queue})"
                 )
             future: Future = Future()
-            # the submitting request's ID rides the slot so dispatch
-            # logs can name the requests in a slow/failed batch
-            self._queue.put((item, future, get_request_id()))
+            # the submitting request's ID and span ride the slot so
+            # dispatch logs can name the requests in a slow/failed
+            # batch, and the dispatch span can link back to every query
+            # it coalesced. With tracing off the extra cost is exactly
+            # the current_span() contextvar read (parent is None).
+            parent_span = tracing.current_span()
+            self._queue.put(
+                _Slot(
+                    item,
+                    future,
+                    get_request_id(),
+                    parent_span,
+                    time.monotonic() if parent_span is not None else 0.0,
+                )
+            )
             self._metrics.queue_depth(self._queue.qsize())
             return future
 
@@ -235,15 +260,20 @@ class MicroBatcher:
         # HERE, before the device sees them — cancellation is how an
         # abandoning caller turns wasted dispatch into avoided dispatch
         live = [
-            entry
-            for entry in batch
-            if entry[1].set_running_or_notify_cancel()
+            slot
+            for slot in batch
+            if slot.future.set_running_or_notify_cancel()
         ]
         if dropped := len(batch) - len(live):
             self._metrics.cancelled(dropped)
         if not live:
             return
-        items = [item for item, _f, _rid in live]
+        items = [slot.item for slot in live]
+        # dispatch-span bookkeeping only when at least one slot was
+        # submitted under an open trace — untraced traffic pays nothing
+        traced = any(slot.parent_span is not None for slot in live)
+        start_wall = tracing.now() if traced else 0.0
+        start_mono = time.monotonic() if traced else 0.0
         t0 = time.perf_counter()
         try:
             results = self._batch_fn(items)
@@ -254,24 +284,78 @@ class MicroBatcher:
                 )
             elapsed = time.perf_counter() - t0
             self._metrics.dispatched(len(items), elapsed)
+            if traced:
+                self._record_dispatch_spans(
+                    live, start_wall, start_mono, elapsed
+                )
             log_json(
                 logger, logging.DEBUG, "batch_dispatch",
                 batcher=self.name, occupancy=len(items),
                 ms=round(elapsed * 1000, 3),
-                requestIds=[rid for _i, _f, rid in live if rid],
+                requestIds=[s.request_id for s in live if s.request_id],
             )
-            for (_item, future, _rid), result in zip(live, results):
-                future.set_result(result)
+            for slot, result in zip(live, results):
+                slot.future.set_result(result)
         except Exception as e:  # noqa: BLE001 - propagate to every waiter
             elapsed = time.perf_counter() - t0
             self._metrics.dispatched(len(items), elapsed)
+            if traced:
+                self._record_dispatch_spans(
+                    live, start_wall, start_mono, elapsed,
+                    error=f"{type(e).__name__}: {e}",
+                )
             log_json(
                 logger, logging.WARNING, "batch_dispatch_failed",
                 batcher=self.name, occupancy=len(items),
                 ms=round(elapsed * 1000, 3),
                 error=f"{type(e).__name__}: {e}",
-                requestIds=[rid for _i, _f, rid in live if rid],
+                requestIds=[s.request_id for s in live if s.request_id],
             )
-            for _item, future, _rid in live:
-                if not future.done():
-                    future.set_exception(e)
+            for slot in live:
+                if not slot.future.done():
+                    slot.future.set_exception(e)
+
+    def _record_dispatch_spans(
+        self, live, start_wall: float, start_mono: float,
+        elapsed: float, error: str | None = None,
+    ) -> None:
+        """One device dispatch, seen from every trace that rode in it.
+
+        The dispatch happens once but coalesces queries from many
+        requests (= many traces), so each DISTINCT submitting span gets
+        one child ``batch_dispatch`` span copy carrying the shared
+        timing plus its queue wait, with ``links`` naming every
+        coalesced query span — the cross-request join Perfetto can't
+        infer. Distinct matters: a batch-queries request submits many
+        slots under one span, and per-slot copies would overflow the
+        per-trace span cap with duplicates."""
+        parents: dict[str, tuple] = {}
+        for slot in live:
+            span = slot.parent_span
+            if span is not None and span.span_id not in parents:
+                parents[span.span_id] = (span, slot.submitted_mono)
+        links = [
+            f"{p.trace_id}:{p.span_id}" for p, _t in parents.values()
+        ]
+        for parent, submitted_mono in parents.values():
+            dispatch = tracing.Span(
+                parent.tracer,
+                parent.trace_id,
+                "batch_dispatch",
+                parent_id=parent.span_id,
+                trace_key=parent.trace_key,
+                attributes={
+                    "batcher": self.name,
+                    "occupancy": len(live),
+                    "queueWaitMs": round(
+                        max(0.0, start_mono - submitted_mono) * 1000, 3
+                    ),
+                    "deviceDispatchMs": round(elapsed * 1000, 3),
+                    "links": links,
+                },
+            )
+            if error is not None:
+                dispatch.attributes["error"] = error
+            dispatch.start = start_wall
+            dispatch.duration = elapsed
+            parent.tracer.record(dispatch)

@@ -51,6 +51,7 @@ from predictionio_tpu.data.datamap import DataMap
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage import Storage, get_storage
 from predictionio_tpu.obs import MetricRegistry, get_registry
+from predictionio_tpu.obs import tracing
 from predictionio_tpu.parallel.mesh import ComputeContext
 from predictionio_tpu.serving.batching import BatcherOverloaded, MicroBatcher
 from predictionio_tpu.serving.plugins import (
@@ -93,6 +94,7 @@ class EngineServer:
         log_url: str | None = None,
         log_prefix: str = "",
         registry: MetricRegistry | None = None,
+        tracer: tracing.Tracer | None = None,
     ):
         self._engine = engine
         self._params = params
@@ -144,6 +146,7 @@ class EngineServer:
         self._avg_serving_sec = 0.0
         self._start_time = _dt.datetime.now(_dt.timezone.utc)
         self._registry = registry if registry is not None else get_registry()
+        self._tracer = tracer if tracer is not None else tracing.get_tracer()
         self._shed_wasted = self._registry.counter(
             "pio_shed_wasted_dispatch_total",
             "Per-algorithm dispatches abandoned by partially-shed batch "
@@ -160,7 +163,10 @@ class EngineServer:
         )
         self.router.route("POST", "/reload", self._reload)
         self.router.route("POST", "/stop", self._stop)
-        install_metrics_routes(self.router, self._registry)
+        install_metrics_routes(
+            self.router, self._registry, self._tracer,
+            server_config=self._server_config,
+        )
         install_plugin_routes(self.router, self._plugins, OUTPUT_SNIFFER)
         self._http: HTTPServer | None = None
         if self._log_queue is not None:
@@ -697,7 +703,8 @@ class EngineServer:
             )
             app_id = self._feedback_app_id
             if app_id is not None:
-                self._storage.get_events().insert(event, app_id)
+                with tracing.span("store/insert_event", kind="feedback"):
+                    self._storage.get_events().insert(event, app_id)
         except Exception:  # noqa: BLE001 - feedback must not break serving
             logger.exception("feedback event failed")
         if isinstance(prediction, dict):
@@ -749,6 +756,7 @@ class EngineServer:
                     reuse_port=reuse_port,
                     service="engine",
                     registry=self._registry,
+                    tracer=self._tracer,
                 )
                 return self._http
             except OSError as exc:

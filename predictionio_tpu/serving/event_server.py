@@ -33,6 +33,7 @@ from predictionio_tpu.data.event import Event, EventValidationError
 from predictionio_tpu.data.storage import Storage, get_storage
 from predictionio_tpu.data.storage.base import PartialBatchError
 from predictionio_tpu.obs import MetricRegistry, get_registry
+from predictionio_tpu.obs import tracing
 from predictionio_tpu.serving.http import (
     HTTPError,
     HTTPServer,
@@ -70,9 +71,15 @@ class EventServer:
         input_blockers: list[InputBlocker] | None = None,
         plugins: PluginContext | None = None,
         registry: MetricRegistry | None = None,
+        tracer: tracing.Tracer | None = None,
+        server_config=None,
     ):
+        """``server_config`` (the server-key ServerConfig) key-auths
+        the ``/debug`` trace routes — the event API itself stays on
+        per-app access keys."""
         self._storage = storage or get_storage()
         self.registry = registry if registry is not None else get_registry()
+        self.tracer = tracer if tracer is not None else tracing.get_tracer()
         # the hourly /stats.json view stays opt-in; registry mirroring
         # happens in _count (not inside Stats) so nothing double-counts
         self._stats = Stats() if stats else None
@@ -85,7 +92,9 @@ class EventServer:
         self._plugins = plugins or PluginContext()
         self.router = Router()
         r = self.router
-        install_metrics_routes(r, self.registry)
+        install_metrics_routes(
+            r, self.registry, self.tracer, server_config=server_config
+        )
         r.route("GET", "/", self._status)
         r.route("POST", "/events.json", self._create_event)
         r.route("GET", "/events.json", self._find_events)
@@ -114,7 +123,8 @@ class EventServer:
                     key = None
         if not key:
             raise HTTPError(401, "Missing accessKey.")
-        access_key = self._storage.get_meta_data_access_keys().get(key)
+        with tracing.span("store/get_access_key"):
+            access_key = self._storage.get_meta_data_access_keys().get(key)
         if access_key is None:
             raise HTTPError(401, "Invalid accessKey.")
         channel_id = None
@@ -174,9 +184,10 @@ class EventServer:
 
     def _store(self, event: Event, app_id: int, channel_id, whitelist):
         event_json = self._validate(event, app_id, channel_id, whitelist)
-        event_id = self._storage.get_events().insert(
-            event, app_id, channel_id
-        )
+        with tracing.span("store/insert_event", appId=app_id):
+            event_id = self._storage.get_events().insert(
+                event, app_id, channel_id
+            )
         if event_json is not None:
             self._plugins.sniff_input(event_json, app_id, channel_id)
         return event_id
@@ -217,35 +228,38 @@ class EventServer:
             limit = int(q.get("limit", 20))
         except ValueError as e:
             raise HTTPError(400, f"bad limit: {e}") from e
-        events = self._storage.get_events().find(
-            app_id,
-            channel_id,
-            start_time=self._parse_time(q.get("startTime")),
-            until_time=self._parse_time(q.get("untilTime")),
-            entity_type=q.get("entityType"),
-            entity_id=q.get("entityId"),
-            event_names=[q["event"]] if "event" in q else None,
-            target_entity_type=tet,
-            target_entity_id=tei,
-            limit=limit,
-            reversed=q.get("reversed", "false").lower() == "true",
-        )
+        with tracing.span("store/find_events", appId=app_id):
+            events = self._storage.get_events().find(
+                app_id,
+                channel_id,
+                start_time=self._parse_time(q.get("startTime")),
+                until_time=self._parse_time(q.get("untilTime")),
+                entity_type=q.get("entityType"),
+                entity_id=q.get("entityId"),
+                event_names=[q["event"]] if "event" in q else None,
+                target_entity_type=tet,
+                target_entity_id=tei,
+                limit=limit,
+                reversed=q.get("reversed", "false").lower() == "true",
+            )
         return Response(200, [e.to_json_dict() for e in events])
 
     def _get_event(self, request: Request) -> Response:
         app_id, channel_id, _ = self._auth(request)
-        event = self._storage.get_events().get(
-            request.path_params["event_id"], app_id, channel_id
-        )
+        with tracing.span("store/get_event", appId=app_id):
+            event = self._storage.get_events().get(
+                request.path_params["event_id"], app_id, channel_id
+            )
         if event is None:
             raise HTTPError(404, "event not found")
         return Response(200, event.to_json_dict())
 
     def _delete_event(self, request: Request) -> Response:
         app_id, channel_id, _ = self._auth(request)
-        found = self._storage.get_events().delete(
-            request.path_params["event_id"], app_id, channel_id
-        )
+        with tracing.span("store/delete_event", appId=app_id):
+            found = self._storage.get_events().delete(
+                request.path_params["event_id"], app_id, channel_id
+            )
         if not found:
             raise HTTPError(404, "event not found")
         return Response(200, {"message": "deleted"})
@@ -282,9 +296,13 @@ class EventServer:
                 self._count(app_id, status)
         if accepted:
             try:
-                ids = self._storage.get_events().insert_batch(
-                    [e for _, e, _ in accepted], app_id, channel_id
-                )
+                with tracing.span(
+                    "store/insert_batch",
+                    appId=app_id, events=len(accepted),
+                ):
+                    ids = self._storage.get_events().insert_batch(
+                        [e for _, e, _ in accepted], app_id, channel_id
+                    )
             except Exception as exc:  # noqa: BLE001 - per-item contract
                 # storage failed mid-batch: keep the per-event status
                 # list (rejections already computed) instead of blowing
@@ -396,6 +414,7 @@ def create_event_server(
     server_config=None,
     reuse_port: bool = False,
     registry: MetricRegistry | None = None,
+    tracer: tracing.Tracer | None = None,
 ) -> HTTPServer:
     """Reference EventServer.createEventServer (default port 7070).
 
@@ -407,7 +426,8 @@ def create_event_server(
     if server_config is None:
         server_config = ServerConfig.from_env()
     server = EventServer(
-        storage=storage, stats=stats, plugins=plugins, registry=registry
+        storage=storage, stats=stats, plugins=plugins,
+        registry=registry, tracer=tracer, server_config=server_config,
     )
     return HTTPServer(
         server.router,
@@ -418,4 +438,5 @@ def create_event_server(
         reuse_port=reuse_port,
         service="eventserver",
         registry=server.registry,
+        tracer=server.tracer,
     )

@@ -22,7 +22,8 @@ from typing import Any, Callable
 from urllib.parse import parse_qs, urlparse
 
 from predictionio_tpu.obs import MetricRegistry, set_request_id
-from predictionio_tpu.obs.context import log_json
+from predictionio_tpu.obs import tracing
+from predictionio_tpu.obs.context import log_json, redact_keys
 
 logger = logging.getLogger(__name__)
 
@@ -148,11 +149,25 @@ class Router:
 
 
 def install_metrics_routes(
-    router: Router, registry: MetricRegistry
+    router: Router,
+    registry: MetricRegistry,
+    tracer: tracing.Tracer | None = None,
+    server_config=None,
 ) -> None:
     """The common telemetry surface every server mounts: Prometheus
     text at ``GET /metrics``, the same registry as JSON at
-    ``GET /metrics.json`` (histograms include derived p50/p95/p99)."""
+    ``GET /metrics.json`` (histograms include derived p50/p95/p99),
+    and the tracing flight recorder at ``GET /debug/traces`` (Chrome
+    trace-event JSON, loads directly in Perfetto) /
+    ``GET /debug/traces.json`` (raw span trees).
+
+    ``server_config`` key-auths the ``/debug`` routes (when its key
+    auth is enforced): traces carry PER-REQUEST data — request IDs, app
+    IDs, store hosts, per-hop latencies — which servers whose HTTP
+    layer is otherwise open (event server, engine server) must not
+    hand to anonymous clients once an operator configured a key.
+    ``/metrics`` stays as open as the server itself: aggregates only."""
+    tracer = tracer if tracer is not None else tracing.get_tracer()
 
     def _metrics(request: Request) -> Response:
         return Response(
@@ -164,8 +179,30 @@ def install_metrics_routes(
     def _metrics_json(request: Request) -> Response:
         return Response(200, registry.to_dict())
 
+    def _traces(request: Request) -> Response:
+        if server_config is not None:
+            server_config.check_key(request)
+        # serialize HERE with default=str: span attributes are caller-
+        # supplied, and Response.payload() runs outside the handler
+        # error boundary — one numpy scalar in a retained trace must
+        # not make the recorder unscrapeable
+        return Response(
+            200,
+            json.dumps(
+                tracer.chrome_trace(request.query.get("traceId")),
+                default=str,
+            ),
+        )
+
+    def _traces_json(request: Request) -> Response:
+        if server_config is not None:
+            server_config.check_key(request)
+        return Response(200, json.dumps(tracer.to_dict(), default=str))
+
     router.route("GET", "/metrics", _metrics)
     router.route("GET", "/metrics.json", _metrics_json)
+    router.route("GET", "/debug/traces", _traces)
+    router.route("GET", "/debug/traces.json", _traces_json)
 
 
 class HTTPServer:
@@ -182,6 +219,7 @@ class HTTPServer:
         reuse_port: bool = False,
         service: str = "http",
         registry: MetricRegistry | None = None,
+        tracer: tracing.Tracer | None = None,
     ):
         """``server_config`` (a
         :class:`~predictionio_tpu.serving.config.ServerConfig`) adds the
@@ -198,9 +236,16 @@ class HTTPServer:
         ``pio_http_request_seconds{service,route}``, counted into
         ``pio_http_requests_total{service,method,status}``, and emits a
         structured access-log line. Request-ID handling is always on —
-        only the metrics need a registry."""
+        only the metrics need a registry.
+
+        ``tracer`` (default: the process tracer) opens one root span
+        per request — trace ID = request ID, remote parent from
+        ``X-Parent-Span`` — so handlers, storage calls, and the
+        micro-batcher hang child spans off it; scrape/debug routes
+        themselves are not traced."""
         router_ref = router
         config_ref = server_config if enforce_key else None
+        tracer_ref = tracer if tracer is not None else tracing.get_tracer()
         if registry is not None:
             requests_total = registry.counter(
                 "pio_http_requests_total",
@@ -233,12 +278,7 @@ class HTTPServer:
                 super().setup()
 
             def log_message(self, fmt, *args):  # route through logging
-                line = fmt % args
-                # keys travel in query strings for reference parity;
-                # they must not land in logs
-                line = re.sub(
-                    r"(accessKey=)[^&\s\"]+", r"\1[redacted]", line
-                )
+                line = redact_keys(fmt % args)
                 logger.debug("%s %s", self.address_string(), line)
 
             def _handle(self):
@@ -261,24 +301,53 @@ class HTTPServer:
                 request.request_id = set_request_id(
                     self.headers.get("X-Request-ID")
                 )
-                t0 = time.perf_counter()
-                try:
-                    if config_ref is not None:
-                        # resolve the route label BEFORE key auth so a
-                        # 401 counts against the real route, not
-                        # "(unmatched)" alongside path-scan noise
-                        request.route = router_ref.match_route(request)
-                        config_ref.check_key(request)
-                    response = router_ref.dispatch(request)
-                except HTTPError as e:
-                    response = Response(
-                        e.status, {"message": e.message}
+                # root span: trace ID = request ID; a forwarded
+                # X-Parent-Span makes this request a child in a
+                # distributed trace. Scrapes of the telemetry surface
+                # itself would drown real traffic in the recorder; a
+                # disabled tracer skips even the name/attribute builds.
+                span_cm = (
+                    tracing.NOOP
+                    if not tracer_ref.enabled
+                    or parsed.path.startswith(("/metrics", "/debug/"))
+                    else tracer_ref.trace(
+                        f"{service} {self.command}",
+                        trace_id=request.request_id,
+                        parent_id=tracing.sanitize_id(
+                            self.headers.get(tracing.PARENT_SPAN_HEADER)
+                        ),
+                        attributes={
+                            "service": service,
+                            "method": self.command,
+                        },
                     )
-                except json.JSONDecodeError as e:
-                    response = Response(400, {"message": f"bad JSON: {e}"})
-                except Exception as e:  # noqa: BLE001 - server boundary
-                    logger.exception("handler error")
-                    response = Response(500, {"message": str(e)})
+                )
+                t0 = time.perf_counter()
+                with span_cm as root_span:
+                    try:
+                        if config_ref is not None:
+                            # resolve the route label BEFORE key auth so
+                            # a 401 counts against the real route, not
+                            # "(unmatched)" alongside path-scan noise
+                            request.route = router_ref.match_route(request)
+                            config_ref.check_key(request)
+                        response = router_ref.dispatch(request)
+                    except HTTPError as e:
+                        response = Response(
+                            e.status, {"message": e.message}
+                        )
+                    except json.JSONDecodeError as e:
+                        response = Response(
+                            400, {"message": f"bad JSON: {e}"}
+                        )
+                    except Exception as e:  # noqa: BLE001 - server boundary
+                        logger.exception("handler error")
+                        response = Response(500, {"message": str(e)})
+                    if root_span is not None:
+                        root_span.set(
+                            "route", request.route or "(unmatched)"
+                        )
+                        root_span.set("status", response.status)
                 elapsed = time.perf_counter() - t0
                 if response.status >= 400 and isinstance(
                     response.body, dict

@@ -52,6 +52,7 @@ from predictionio_tpu.data.storage.httpstore import (
     manifest_to_json,
 )
 from predictionio_tpu.obs import MetricRegistry, get_registry
+from predictionio_tpu.obs import tracing
 from predictionio_tpu.serving.config import ServerConfig
 from predictionio_tpu.serving.http import (
     HTTPError,
@@ -72,9 +73,11 @@ class StoreServer:
         self,
         storage: Storage | None = None,
         registry: MetricRegistry | None = None,
+        tracer: tracing.Tracer | None = None,
     ):
         self._storage = storage or get_storage()
         self.registry = registry if registry is not None else get_registry()
+        self.tracer = tracer if tracer is not None else tracing.get_tracer()
         s = self._storage
         #: <kind> -> (dao getter, to_json, from_json, id parser);
         #: getters defer DAO construction to request time
@@ -115,7 +118,7 @@ class StoreServer:
         }
         self.router = Router()
         r = self.router
-        install_metrics_routes(r, self.registry)
+        install_metrics_routes(r, self.registry, self.tracer)
         r.route("GET", "/", self._status)
         r.route("GET", "/meta/engine_manifests/<id>/<version>",
                 self._manifest_get)
@@ -181,7 +184,8 @@ class StoreServer:
             record = from_json(body)
         except (KeyError, TypeError, ValueError) as e:
             raise HTTPError(400, f"bad {kind} record: {e}") from e
-        out = dao.insert(record)
+        with tracing.span(f"dao/{kind}.insert"):
+            out = dao.insert(record)
         # insert contracts differ by DAO: apps/channels → id|None on
         # conflict; access_keys → key|None; instances → id; manifests →
         # None (keyed by the record itself). Normalize to {"id": ...}.
@@ -190,6 +194,10 @@ class StoreServer:
     def _list(self, request: Request) -> Response:
         kind, dao, to_json, _f, _ = self._kind(request)
         q = request.query
+        with tracing.span(f"dao/{kind}.list"):
+            return self._list_inner(kind, dao, to_json, q)
+
+    def _list_inner(self, kind, dao, to_json, q) -> Response:
         if kind == "apps" and "name" in q:
             app = dao.get_by_name(q["name"])
             return Response(200, [to_json(app)] if app else [])
@@ -220,7 +228,10 @@ class StoreServer:
     def _get(self, request: Request) -> Response:
         kind, dao, to_json, _f, id_parse = self._kind(request)
         self._reject_manifest_single_key(kind)
-        record = dao.get(self._parse_id(id_parse, request.path_params["id"]))
+        with tracing.span(f"dao/{kind}.get"):
+            record = dao.get(
+                self._parse_id(id_parse, request.path_params["id"])
+            )
         if record is None:
             raise HTTPError(404, "not found")
         return Response(200, to_json(record))
@@ -235,12 +246,16 @@ class StoreServer:
             record = from_json(body)
         except (KeyError, TypeError, ValueError) as e:
             raise HTTPError(400, f"bad {kind} record: {e}") from e
-        return Response(200, {"ok": bool(dao.update(record))})
+        with tracing.span(f"dao/{kind}.update"):
+            return Response(200, {"ok": bool(dao.update(record))})
 
     def _delete(self, request: Request) -> Response:
         kind, dao, _t, _f, id_parse = self._kind(request)
         self._reject_manifest_single_key(kind)
-        ok = dao.delete(self._parse_id(id_parse, request.path_params["id"]))
+        with tracing.span(f"dao/{kind}.delete"):
+            ok = dao.delete(
+                self._parse_id(id_parse, request.path_params["id"])
+            )
         return Response(200, {"ok": bool(ok)})
 
     # -- engine manifests (two-part key) ----------------------------------
@@ -294,12 +309,14 @@ class StoreServer:
 
     def _model_put(self, request: Request) -> Response:
         model_id = urllib.parse.unquote(request.path_params["id"])
-        self._models().insert(Model(id=model_id, models=request.body))
+        with tracing.span("dao/models.insert", bytes=len(request.body)):
+            self._models().insert(Model(id=model_id, models=request.body))
         return Response(201, {"id": model_id})
 
     def _model_get(self, request: Request) -> Response:
         model_id = urllib.parse.unquote(request.path_params["id"])
-        model = self._models().get(model_id)
+        with tracing.span("dao/models.get"):
+            model = self._models().get(model_id)
         if model is None:
             raise HTTPError(404, "not found")
         return Response(
@@ -317,8 +334,9 @@ def create_store_server(
     storage: Storage | None = None,
     server_config: ServerConfig | None = None,
     registry: MetricRegistry | None = None,
+    tracer: tracing.Tracer | None = None,
 ) -> HTTPServer:
-    server = StoreServer(storage, registry=registry)
+    server = StoreServer(storage, registry=registry, tracer=tracer)
     return HTTPServer(
         server.router,
         host=host,
@@ -326,4 +344,5 @@ def create_store_server(
         server_config=server_config,
         service="storeserver",
         registry=server.registry,
+        tracer=server.tracer,
     )

@@ -26,6 +26,8 @@ from collections import defaultdict
 
 import jax
 
+from predictionio_tpu.obs import tracing
+
 logger = logging.getLogger(__name__)
 
 
@@ -44,16 +46,20 @@ class StepTimer:
 
     @contextlib.contextmanager
     def step(self, name: str, sync_value=None):
+        # each step is also a tracing span (no-op outside an open
+        # trace), so `pio train` emits the same Perfetto timeline the
+        # serving stack does
         if not self.enabled:
             yield
             return
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            if sync_value is not None:
-                sync(sync_value)
-            self.records[name].append(time.perf_counter() - t0)
+        with tracing.span(name):
+            t0 = time.perf_counter()
+            try:
+                yield
+            finally:
+                if sync_value is not None:
+                    sync(sync_value)
+                self.records[name].append(time.perf_counter() - t0)
 
     def record(self, name: str, seconds: float) -> None:
         if self.enabled:

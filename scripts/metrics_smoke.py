@@ -1,5 +1,6 @@
 """Telemetry smoke test: deploy a fake engine in-process, scrape
-``/metrics``, and verify request-ID echo — run by ``scripts/check.sh``
+``/metrics``, verify request-ID echo, and pull ``/debug/traces`` to
+assert a non-empty Perfetto-valid trace — run by ``scripts/check.sh``
 so a telemetry regression fails fast without waiting on the full suite.
 """
 
@@ -125,6 +126,59 @@ def main() -> int:
         check(
             data.get("pio_train_step_seconds") is not None,
             "train-time StepTimer records joined the registry",
+        )
+        check(
+            data.get("pio_build_info") is not None
+            and data.get("pio_process_start_time_seconds") is not None,
+            "build info + process start time gauges exposed",
+        )
+
+        # the tracing flight recorder: the query above must have left a
+        # trace, and /debug/traces must be Perfetto-valid Chrome
+        # trace-event JSON (loads at ui.perfetto.dev as-is)
+        with urllib.request.urlopen(
+            f"{base}/debug/traces", timeout=10
+        ) as resp:
+            trace = json.load(resp)
+        events = trace.get("traceEvents")
+        check(
+            isinstance(events, list) and len(events) > 0,
+            "/debug/traces returns a non-empty trace",
+        )
+        spans = [e for e in (events or []) if e.get("ph") == "X"]
+        check(
+            bool(spans)
+            and all(
+                isinstance(e.get("name"), str)
+                and isinstance(e.get("ts"), (int, float))
+                and isinstance(e.get("dur"), (int, float))
+                and isinstance(e.get("pid"), int)
+                for e in spans
+            ),
+            "/debug/traces events are Perfetto-valid complete events",
+        )
+        check(
+            any(e["name"] == "batch_dispatch" for e in spans),
+            "trace contains the linked batch_dispatch span",
+        )
+        check(
+            any(
+                e.get("args", {}).get("traceId") == "smoke-1"
+                for e in spans
+            ),
+            "trace ID matches the forwarded X-Request-ID",
+        )
+
+        with urllib.request.urlopen(
+            f"{base}/debug/traces.json", timeout=10
+        ) as resp:
+            raw = json.load(resp)
+        check(
+            bool(raw.get("traces"))
+            and any(
+                t["traceId"] == "smoke-1" for t in raw["traces"]
+            ),
+            "/debug/traces.json retains the raw span tree",
         )
     finally:
         http.shutdown()
