@@ -108,6 +108,23 @@ def _mesh_ctx(args, variant: dict | None = None):
 # -- command implementations ----------------------------------------------
 
 
+def _serve_foreground(http) -> int:
+    """Block on a bound HTTPServer with the graceful-drain contract:
+    SIGTERM flips /healthz to draining, refuses new work with 503 +
+    Retry-After, lets in-flight requests (and the current device
+    batch) finish, then shuts the listener down — serve_forever
+    returns and the process exits cleanly (docs/robustness.md).
+    Ctrl-C stays an immediate stop."""
+    from predictionio_tpu.serving import resilience
+
+    resilience.install_signal_drain(http)
+    try:
+        http.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def cmd_version(args) -> int:
     print(__version__)
     return 0
@@ -546,11 +563,7 @@ def cmd_deploy(args) -> int:
             http, args.workers,
             _workers.rebuild_argv(args.raw_argv, http.port),
         )
-    try:
-        http.serve_forever()
-    except KeyboardInterrupt:
-        pass
-    return 0
+    return _serve_foreground(http)
 
 
 def cmd_undeploy(args) -> int:
@@ -592,11 +605,7 @@ def cmd_eventserver(args) -> int:
             http, args.workers,
             _workers.rebuild_argv(args.raw_argv, http.port),
         )
-    try:
-        http.serve_forever()
-    except KeyboardInterrupt:
-        pass
-    return 0
+    return _serve_foreground(http)
 
 
 def cmd_dashboard(args) -> int:
@@ -604,11 +613,7 @@ def cmd_dashboard(args) -> int:
 
     http = create_dashboard(host=args.ip, port=args.port)
     print(f"Dashboard is listening on {args.ip}:{http.port}")
-    try:
-        http.serve_forever()
-    except KeyboardInterrupt:
-        pass
-    return 0
+    return _serve_foreground(http)
 
 
 def cmd_adminserver(args) -> int:
@@ -616,11 +621,7 @@ def cmd_adminserver(args) -> int:
 
     http = create_admin_server(host=args.ip, port=args.port)
     print(f"Admin server is listening on {args.ip}:{http.port}")
-    try:
-        http.serve_forever()
-    except KeyboardInterrupt:
-        pass
-    return 0
+    return _serve_foreground(http)
 
 
 def cmd_storeserver(args) -> int:
@@ -650,11 +651,7 @@ def cmd_storeserver(args) -> int:
         host=args.ip, port=args.port, server_config=config
     )
     print(f"Store server is listening on {args.ip}:{http.port}")
-    try:
-        http.serve_forever()
-    except KeyboardInterrupt:
-        pass
-    return 0
+    return _serve_foreground(http)
 
 
 def _file_format(explicit: str, path: str) -> str:

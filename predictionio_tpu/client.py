@@ -5,6 +5,16 @@ example seed scripts use (``examples/*/data/import_eventserver.py`` /
 ``send_query.py``, SURVEY.md §2.8): a thin stdlib-only HTTP client for
 the Event Server (create/get/delete events, ``$set`` helpers, batch)
 and the Engine Server (``send_query``).
+
+Resilience (docs/robustness.md): every request mints an
+``X-PIO-Deadline`` header from its timeout so servers downstream can
+refuse or drop work the caller has already given up on; idempotent
+operations (GET/DELETE) retry with jittered exponential backoff inside
+that budget; and each target host sits behind a process-wide circuit
+breaker that fast-fails (:class:`~predictionio_tpu.serving.resilience
+.CircuitOpenError`) instead of piling timeouts onto a host that is
+down. Raised :class:`PIOClientError`\\ s carry the server-echoed
+``X-Request-ID`` as ``request_id`` for log/trace correlation.
 """
 
 from __future__ import annotations
@@ -18,19 +28,24 @@ from typing import Any, Mapping, Sequence
 
 from predictionio_tpu.obs.context import get_request_id
 from predictionio_tpu.obs.tracing import PARENT_SPAN_HEADER, current_span
+from predictionio_tpu.serving import resilience
 
 
 class PIOClientError(RuntimeError):
-    def __init__(self, status: int, message: str):
+    def __init__(
+        self, status: int, message: str, request_id: str | None = None
+    ):
         super().__init__(f"HTTP {status}: {message}")
         self.status = status
         self.message = message
+        #: the server-echoed X-Request-ID — join a client-side failure
+        #: to the server's logs and traces
+        self.request_id = request_id
 
 
-def _request(
-    url: str, method: str = "GET", body: Any = None, timeout: float = 10.0
+def _send_once(
+    url: str, method: str, data: bytes | None, deadline, timeout: float
 ) -> Any:
-    data = json.dumps(body).encode() if body is not None else None
     req = urllib.request.Request(url, data=data, method=method)
     if data is not None:
         req.add_header("Content-Type", "application/json")
@@ -44,16 +59,97 @@ def _request(
     parent = current_span()
     if parent is not None:
         req.add_header(PARENT_SPAN_HEADER, parent.span_id)
-    try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            raw = resp.read()
-            return json.loads(raw) if raw else None
-    except urllib.error.HTTPError as e:
+    # whatever budget is left NOW rides to the server, so a retry
+    # carries a smaller budget than the first attempt did
+    req.add_header(resilience.DEADLINE_HEADER, deadline.to_header())
+    with urllib.request.urlopen(
+        req, timeout=deadline.cap(timeout)
+    ) as resp:
+        raw = resp.read()
+        return json.loads(raw) if raw else None
+
+
+def _request(
+    url: str, method: str = "GET", body: Any = None, timeout: float = 10.0
+) -> Any:
+    data = json.dumps(body).encode() if body is not None else None
+    target = urllib.parse.urlsplit(url).netloc
+    breaker = resilience.get_breaker(target)
+    policy = resilience.RetryPolicy.from_env()
+    # inherit a tighter ambient deadline when running inside a server
+    # (feedback hop, tests); otherwise the timeout IS the budget.
+    # `inherited` records WHOSE clock the budget is: only an inherited
+    # budget expiring exempts a timeout from breaker accounting — a
+    # self-minted budget times out exactly when the socket does, and
+    # treating that as "our clock ran out" would mean a blackholed
+    # host could never trip the breaker
+    ambient = resilience.get_deadline()
+    deadline = resilience.Deadline.after(timeout)
+    inherited = (
+        ambient is not None
+        and ambient.expires_mono < deadline.expires_mono
+    )
+    if inherited:
+        deadline.expires_mono = ambient.expires_mono
+    idempotent = method in resilience.IDEMPOTENT_METHODS
+    attempt = 0
+    while True:
+        if not breaker.allow():
+            raise resilience.CircuitOpenError(target)
         try:
-            message = json.loads(e.read()).get("message", "")
-        except Exception:  # noqa: BLE001
-            message = ""
-        raise PIOClientError(e.code, message) from e
+            out = _send_once(url, method, data, deadline, timeout)
+            breaker.record_success()
+            return out
+        except urllib.error.HTTPError as e:
+            request_id = e.headers.get("X-Request-ID") if e.headers else None
+            try:
+                message = json.loads(e.read()).get("message", "")
+            except Exception:  # noqa: BLE001
+                message = ""
+            if e.code >= 500 and e.code != 504:
+                breaker.record_failure()
+                # retry only while the breaker stayed closed: when THIS
+                # failure tripped it, sleeping a backoff to then raise
+                # "circuit open" would waste the wait AND mask the real
+                # error the caller needs
+                if (
+                    idempotent
+                    and breaker.state == resilience.CLOSED
+                    and policy.sleep_before_retry(attempt, deadline)
+                ):
+                    attempt += 1
+                    continue
+            else:
+                # a 4xx — or a 504 refusing OUR expired budget — is the
+                # server ANSWERING: health, not failure, for breaker
+                # purposes
+                breaker.record_success()
+            raise PIOClientError(e.code, message, request_id) from e
+        except OSError:
+            # URLError (connection refused/reset, DNS, timeout) and
+            # friends: the server never answered
+            if inherited and deadline.expired:
+                # starved by an INHERITED budget tighter than our own
+                # timeout: the caller's clock ran out, which says
+                # nothing about the target — release any half-open
+                # probe slot instead of wedging the breaker
+                breaker.release()
+                raise
+            breaker.record_failure()
+            if (
+                idempotent
+                and breaker.state == resilience.CLOSED
+                and policy.sleep_before_retry(attempt, deadline)
+            ):
+                attempt += 1
+                continue
+            raise
+        except Exception:
+            # anything else escaping the admitted call (malformed JSON
+            # in a 200 body, a garbage status line) is no verdict on
+            # the target's reachability — release, don't leak the slot
+            breaker.release()
+            raise
 
 
 class EventClient:

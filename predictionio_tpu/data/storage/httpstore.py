@@ -38,6 +38,7 @@ from typing import Any
 
 from predictionio_tpu.obs import tracing
 from predictionio_tpu.obs.context import get_request_id
+from predictionio_tpu.serving import resilience
 from predictionio_tpu.data.storage.base import (
     AccessKey,
     AccessKeysBackend,
@@ -195,12 +196,38 @@ def _q(raw) -> str:
 # --------------------------------------------------------------------------
 
 
+class StoreCircuitOpen(StorageError, resilience.CircuitOpenError):
+    """The store target's breaker is open: fail fast, don't connect.
+
+    Doubly typed on purpose: DAO callers keep their ``StorageError``
+    contract, while the HTTP layer's
+    :class:`~predictionio_tpu.serving.resilience.CircuitOpenError`
+    mapping turns it into a retryable 503 instead of a 500."""
+
+    def __init__(self, target: str):
+        StorageError.__init__(
+            self,
+            f"store server {target} circuit open; "
+            "fast-failing without a request",
+        )
+        self.target = target
+
+
 class HTTPStoreClient:
     """Keep-alive JSON/HTTP client for one store server.
 
     One pooled connection per thread (serving and training code hit the
     DAOs from multiple threads); a request on a connection the server
     has since closed is retried once on a fresh socket.
+
+    Resilience (docs/robustness.md): hops forward the caller's
+    remaining ``X-PIO-Deadline`` budget (and cap their socket timeout
+    by it); idempotent operations (GET/HEAD/PUT/DELETE — every DAO
+    write here is a keyed upsert) retry transport errors and 5xx
+    responses with jittered exponential backoff inside that budget; and
+    the target sits behind a process-wide circuit breaker that
+    fast-fails with :class:`StoreCircuitOpen` while the store is known
+    to be down.
     """
 
     def __init__(self, config: dict):
@@ -240,6 +267,9 @@ class HTTPStoreClient:
                 self._ssl_context.check_hostname = False
                 self._ssl_context.verify_mode = ssl.CERT_NONE
         self._local = threading.local()
+        self._target = f"{self._host}:{self._port}"
+        self._retry = resilience.RetryPolicy.from_env()
+        self._breaker = resilience.get_breaker(self._target)
 
     def _connection(self) -> tuple[http.client.HTTPConnection, bool]:
         """Returns (connection, reused) — ``reused`` means the socket
@@ -315,36 +345,95 @@ class HTTPStoreClient:
     def _roundtrip(
         self, method, path, body, headers, span
     ) -> tuple[int, bytes]:
-        for attempt in (0, 1):
+        idempotent = method in resilience.IDEMPOTENT_METHODS
+        deadline = resilience.get_deadline()
+        attempt = 0  # budgeted (backed-off) retries consumed
+        stale_replayed = False
+        while True:
+            if deadline is not None and deadline.expired:
+                raise resilience.DeadlineExceeded(
+                    f"deadline expired before store hop {method} {path}"
+                )
+            breaker_state = self._breaker.state
+            if span is not None and breaker_state != resilience.CLOSED:
+                span.set("breaker", breaker_state)
+            if not self._breaker.allow():
+                raise StoreCircuitOpen(self._target)
+            if deadline is not None:
+                # the hop forwards what is LEFT of the budget — retries
+                # carry smaller budgets, and the server's admission
+                # check can reject work we would discard anyway
+                headers[resilience.DEADLINE_HEADER] = deadline.to_header()
             conn, reused = self._connection()
+            # cap the socket wait by the remaining budget (and restore
+            # the configured timeout on budget-less requests — the
+            # pooled connection outlives any one deadline)
+            capped = (
+                self._timeout
+                if deadline is None
+                else deadline.cap(self._timeout)
+            )
+            conn.timeout = capped
             sent = False
             try:
+                # a dead pooled socket raises EBADF right here — inside
+                # the try, so it takes the same stale-replay path as a
+                # send-phase failure
+                if conn.sock is not None:
+                    conn.sock.settimeout(capped)
                 conn.request(method, path, body=body, headers=headers)
                 sent = True
                 resp = conn.getresponse()
                 data = resp.read()
             except (OSError, http.client.HTTPException) as e:
                 self._drop_connection()
-                # Retry exactly once, and only when the server cannot
-                # have acted on the request: a send-phase failure on a
-                # reused socket (the stale keep-alive race — the request
-                # never arrived whole, any method), or
-                # RemoteDisconnected on a reused socket for an
-                # *idempotent* method. After a completed send,
-                # RemoteDisconnected is ambiguous — the server may have
-                # processed the request and died before emitting any
-                # response bytes, which for a POST insert would
-                # duplicate the row — so non-idempotent methods surface
-                # the error instead.
-                idempotent = method in ("GET", "HEAD", "PUT", "DELETE")
+                # Stale keep-alive replay (free — the server cannot
+                # have acted): a send-phase failure on a reused socket
+                # (the request never arrived whole, any method), or a
+                # response-phase disconnect/reset/garbage on a reused
+                # socket for an *idempotent* method — the classic
+                # first-request-after-server-restart race. After a
+                # completed send, a bare disconnect is ambiguous for a
+                # POST (the server may have committed the insert before
+                # dying, and a replay would duplicate the row), so
+                # non-idempotent methods surface the error instead.
                 stale = reused and (
                     not sent
                     or (
                         idempotent
-                        and isinstance(e, http.client.RemoteDisconnected)
+                        and isinstance(e, (
+                            http.client.RemoteDisconnected,
+                            http.client.BadStatusLine,
+                            ConnectionResetError,
+                        ))
                     )
                 )
-                if attempt == 0 and stale:
+                if stale and not stale_replayed:
+                    # no evidence about the target (the request never
+                    # arrived whole) — release any half-open probe slot
+                    # instead of leaving the breaker wedged half-open
+                    self._breaker.release()
+                    stale_replayed = True
+                    continue
+                if deadline is not None and deadline.expired:
+                    # budget-starved timeout: OUR clock ran out, which
+                    # says nothing about the target's health
+                    self._breaker.release()
+                    raise resilience.DeadlineExceeded(
+                        f"deadline expired during store hop "
+                        f"{method} {path}"
+                    ) from e
+                self._breaker.record_failure()
+                # retry only while the breaker stayed closed: when THIS
+                # failure tripped it, a backoff sleep followed by
+                # "circuit open" would waste the wait and mask the
+                # actual transport error
+                if (
+                    idempotent
+                    and self._breaker.state == resilience.CLOSED
+                    and self._retry.sleep_before_retry(attempt, deadline)
+                ):
+                    attempt += 1
                     continue
                 raise StorageError(
                     f"store server {self._host}:{self._port} unreachable: "
@@ -352,18 +441,40 @@ class HTTPStoreClient:
                 ) from e
             if span is not None:
                 span.set("status", resp.status)
+                if attempt or stale_replayed:
+                    span.set(
+                        "retries", attempt + (1 if stale_replayed else 0)
+                    )
+            if resp.status >= 500:
+                if resp.status == 504:
+                    # the server ANSWERED — refusing our (expired)
+                    # budget is the caller's fault, not the target's,
+                    # and retrying an exhausted budget is pointless
+                    self._breaker.record_success()
+                    raise StorageError(
+                        f"store server refused expired deadline "
+                        f"(HTTP 504): "
+                        f"{data[:200].decode('utf-8', 'replace')}"
+                    )
+                self._breaker.record_failure()
+                if (
+                    idempotent
+                    and self._breaker.state == resilience.CLOSED
+                    and self._retry.sleep_before_retry(attempt, deadline)
+                ):
+                    attempt += 1
+                    continue
+                raise StorageError(
+                    f"store server error HTTP {resp.status}: "
+                    f"{data[:200].decode('utf-8', 'replace')}"
+                )
+            self._breaker.record_success()
             if resp.status in (401, 403):
                 raise StorageError(
                     "store server rejected the access key "
                     f"(HTTP {resp.status})"
                 )
-            if resp.status >= 500:
-                raise StorageError(
-                    f"store server error HTTP {resp.status}: "
-                    f"{data[:200].decode('utf-8', 'replace')}"
-                )
             return resp.status, data
-        raise AssertionError("unreachable")
 
     def json(
         self,

@@ -28,6 +28,7 @@ from predictionio_tpu.obs import MetricRegistry, get_request_id
 from predictionio_tpu.obs import tracing
 from predictionio_tpu.obs.context import log_json
 from predictionio_tpu.obs.registry import LATENCY_BUCKETS, OCCUPANCY_BUCKETS
+from predictionio_tpu.serving import resilience
 
 logger = logging.getLogger(__name__)
 
@@ -42,15 +43,17 @@ class BatcherOverloaded(Exception):
 
 
 class _Slot(NamedTuple):
-    """One queued submission: the payload, its Future, and the
-    submitting request's identity (ID + open span + submit time) for
-    dispatch logs and trace spans."""
+    """One queued submission: the payload, its Future, the submitting
+    request's identity (ID + open span + submit time) for dispatch logs
+    and trace spans, and its deadline so expired work is dropped before
+    the device sees it."""
 
     item: Any
     future: Future
     request_id: str | None
     parent_span: Any  # tracing.Span | None
     submitted_mono: float
+    deadline: Any  # resilience.Deadline | None
 
 
 class _NullMetrics:
@@ -70,12 +73,18 @@ class _NullMetrics:
     def cancelled(self, n: int) -> None:
         pass
 
+    def expired(self, n: int) -> None:
+        pass
+
+    def leaked(self) -> None:
+        pass
+
 
 class _BatcherMetrics:
     """Bound registry children for one named batcher."""
 
     __slots__ = ("_depth", "_shed", "_occupancy", "_dispatch",
-                 "_batches", "_cancelled")
+                 "_batches", "_cancelled", "_expired", "_leaked")
 
     def __init__(self, registry: MetricRegistry, name: str):
         self._depth = registry.gauge(
@@ -110,6 +119,18 @@ class _BatcherMetrics:
             "Slots cancelled before dispatch (device work avoided)",
             ("batcher",),
         ).labels(name)
+        self._expired = registry.counter(
+            "pio_batch_deadline_expired_total",
+            "Slots dropped before device dispatch because their "
+            "deadline had already expired",
+            ("batcher",),
+        ).labels(name)
+        self._leaked = registry.counter(
+            "pio_batcher_leaked_threads_total",
+            "Worker threads still alive after close() timed out "
+            "joining them",
+            ("batcher",),
+        ).labels(name)
 
     def queue_depth(self, n: int) -> None:
         self._depth.set(n)
@@ -124,6 +145,12 @@ class _BatcherMetrics:
 
     def cancelled(self, n: int) -> None:
         self._cancelled.inc(n)
+
+    def expired(self, n: int) -> None:
+        self._expired.inc(n)
+
+    def leaked(self) -> None:
+        self._leaked.inc()
 
 
 class MicroBatcher:
@@ -151,10 +178,12 @@ class MicroBatcher:
         max_queue: int | None = None,
         registry: MetricRegistry | None = None,
         name: str = "default",
+        close_join_timeout_s: float = 30.0,
     ):
         self._batch_fn = batch_fn
         self._max_batch = max_batch
         self._max_wait = max_wait_ms / 1000.0
+        self._close_join_timeout_s = close_join_timeout_s
         self._max_queue = (
             max_queue if max_queue is not None else 8 * max_batch
         )
@@ -184,6 +213,15 @@ class MicroBatcher:
                 raise BatcherOverloaded(
                     f"batch queue at capacity ({self._max_queue})"
                 )
+            # a request whose budget already ran out must not take a
+            # queue slot at all — the 504 costs nothing here but would
+            # cost a dispatch slot at flush time
+            deadline = resilience.get_deadline()
+            if deadline is not None and deadline.expired:
+                self._metrics.expired(1)
+                raise resilience.DeadlineExceeded(
+                    "deadline expired before batch submit"
+                )
             future: Future = Future()
             # the submitting request's ID and span ride the slot so
             # dispatch logs can name the requests in a slow/failed
@@ -198,6 +236,7 @@ class MicroBatcher:
                     get_request_id(),
                     parent_span,
                     time.monotonic() if parent_span is not None else 0.0,
+                    deadline,
                 )
             )
             self._metrics.queue_depth(self._queue.qsize())
@@ -207,13 +246,23 @@ class MicroBatcher:
         return self.submit(item).result(timeout=timeout)
 
     def close(self) -> None:
-        """Graceful: already-submitted items are still processed."""
+        """Graceful: already-submitted items are still processed. A
+        worker stuck in a hung dispatch past the join timeout is
+        reported (structured warning + ``pio_batcher_leaked_threads_total``)
+        instead of silently leaked."""
         with self._submit_lock:
             if self._closed.is_set():
                 return
             self._closed.set()
             self._queue.put(None)  # wake the worker
-        self._thread.join(timeout=30)
+        self._thread.join(timeout=self._close_join_timeout_s)
+        if self._thread.is_alive():
+            self._metrics.leaked()
+            log_json(
+                logger, logging.WARNING, "batcher_thread_leaked",
+                batcher=self.name,
+                joinTimeoutS=self._close_join_timeout_s,
+            )
 
     # -- worker -----------------------------------------------------------
     def _drain_and_exit(self, batch) -> None:
@@ -258,14 +307,32 @@ class MicroBatcher:
             self._metrics.queue_depth(self._queue.qsize())
         # transition every slot to running; cancelled slots drop out
         # HERE, before the device sees them — cancellation is how an
-        # abandoning caller turns wasted dispatch into avoided dispatch
-        live = [
-            slot
-            for slot in batch
-            if slot.future.set_running_or_notify_cancel()
-        ]
-        if dropped := len(batch) - len(live):
+        # abandoning caller turns wasted dispatch into avoided dispatch.
+        # Expired-deadline slots drop out the same way: their waiter is
+        # already gone (or about to time out), so dispatching them
+        # would burn device time computing unreceivable answers.
+        live = []
+        expired = 0
+        for slot in batch:
+            if not slot.future.set_running_or_notify_cancel():
+                continue
+            if slot.deadline is not None and slot.deadline.expired:
+                slot.future.set_exception(
+                    resilience.DeadlineExceeded(
+                        "deadline expired while queued for dispatch"
+                    )
+                )
+                expired += 1
+                continue
+            live.append(slot)
+        if dropped := len(batch) - len(live) - expired:
             self._metrics.cancelled(dropped)
+        if expired:
+            self._metrics.expired(expired)
+            log_json(
+                logger, logging.DEBUG, "batch_slots_expired",
+                batcher=self.name, expired=expired,
+            )
         if not live:
             return
         items = [slot.item for slot in live]

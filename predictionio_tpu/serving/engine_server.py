@@ -43,6 +43,7 @@ import time
 import urllib.error
 import urllib.parse
 import urllib.request
+from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Any
 
 from predictionio_tpu.core.engine import Engine, EngineParams
@@ -53,6 +54,7 @@ from predictionio_tpu.data.storage import Storage, get_storage
 from predictionio_tpu.obs import MetricRegistry, get_registry
 from predictionio_tpu.obs import tracing
 from predictionio_tpu.parallel.mesh import ComputeContext
+from predictionio_tpu.serving import resilience
 from predictionio_tpu.serving.batching import BatcherOverloaded, MicroBatcher
 from predictionio_tpu.serving.plugins import (
     OUTPUT_SNIFFER,
@@ -476,6 +478,9 @@ class EngineServer:
                 # algorithms' accepted submits must not run for nothing.
                 self._abandon(futures)
                 raise HTTPError(503, "server overloaded; retry later")
+            except resilience.DeadlineExceeded:
+                self._abandon(futures)
+                raise HTTPError(504, "deadline expired before dispatch")
             except RuntimeError:
                 # /reload swapped+closed the batchers between our snapshot
                 # and submit — retry once against the fresh set
@@ -484,7 +489,14 @@ class EngineServer:
             break
         else:
             raise HTTPError(503, "server is reloading; retry")
-        prediction = self._serve_one(serving, query, supplemented, futures)
+        try:
+            prediction = self._serve_one(
+                serving, query, supplemented, futures
+            )
+        except resilience.DeadlineExceeded:
+            # the batcher dropped the slot pre-dispatch: the client's
+            # budget ran out while the query was queued
+            raise HTTPError(504, "deadline expired before device dispatch")
 
         elapsed = time.perf_counter() - t0
         with self._lock:
@@ -504,13 +516,27 @@ class EngineServer:
 
         ``deadline`` (a ``time.monotonic()`` value) bounds the TOTAL
         wait across all futures; default is one predict timeout from
-        now."""
+        now, further capped by the request's propagated X-PIO-Deadline
+        when one rode in."""
         if deadline is None:
             deadline = time.monotonic() + self._predict_timeout_s
-        predictions = [
-            f.result(timeout=max(0.001, deadline - time.monotonic()))
-            for f in futures
-        ]
+        request_deadline = resilience.get_deadline()
+        if request_deadline is not None:
+            deadline = min(deadline, request_deadline.expires_mono)
+        try:
+            predictions = [
+                f.result(timeout=max(0.001, deadline - time.monotonic()))
+                for f in futures
+            ]
+        except FuturesTimeout:
+            if request_deadline is not None and request_deadline.expired:
+                # the CLIENT's budget ran out while the query sat in
+                # the batch queue — a 504, not a server fault; the
+                # batcher will drop the still-queued slot pre-dispatch
+                raise resilience.DeadlineExceeded(
+                    "deadline expired while queued for dispatch"
+                ) from None
+            raise
         prediction = serving.serve(supplemented, predictions)
         if self._feedback:
             prediction = self._record_feedback(query, prediction)
@@ -588,6 +614,12 @@ class EngineServer:
                      "message": "server is reloading; retry"}
                 )
                 continue
+            if state == "expired":
+                results.append(
+                    {"status": 504,
+                     "message": "deadline expired before dispatch"}
+                )
+                continue
             if state == "error":
                 if self._log_queue is not None and not logged:
                     self._post_remote_log(data, request)
@@ -599,6 +631,11 @@ class EngineServer:
                     serving, q, data, futures, deadline=deadline
                 )
                 results.append({"status": 200, "prediction": prediction})
+            except resilience.DeadlineExceeded:
+                results.append(
+                    {"status": 504,
+                     "message": "deadline expired before device dispatch"}
+                )
             except Exception as exc:  # noqa: BLE001 - per-slot status
                 if self._log_queue is not None and not logged:
                     self._post_remote_log(exc, request)
@@ -636,7 +673,7 @@ class EngineServer:
         """Submit every query; returns (slots, any_submitted).
 
         Slots: ``("ok", supplemented, futures)`` |
-        ``("bad"|"shed"|"reloading", None, None)`` |
+        ``("bad"|"shed"|"reloading"|"expired", None, None)`` |
         ``("error", exc, None)``. ``any_submitted`` is True once ANY
         ``submit`` was accepted — including a partial multi-algorithm
         slot whose later batcher then raised — which is exactly the
@@ -671,6 +708,10 @@ class EngineServer:
             except BatcherOverloaded:
                 self._abandon(futures)
                 entries.append(("shed", None, None))
+                continue
+            except resilience.DeadlineExceeded:
+                self._abandon(futures)
+                entries.append(("expired", None, None))
                 continue
             except RuntimeError:
                 self._abandon(futures)
@@ -758,6 +799,10 @@ class EngineServer:
                     registry=self._registry,
                     tracer=self._tracer,
                 )
+                # graceful drain: after in-flight requests finish,
+                # close() the batchers so the current device batch
+                # completes before the process exits
+                self._http.add_drain_hook(self.close)
                 return self._http
             except OSError as exc:
                 last_exc = exc

@@ -429,7 +429,7 @@ def create_event_server(
         storage=storage, stats=stats, plugins=plugins,
         registry=registry, tracer=tracer, server_config=server_config,
     )
-    return HTTPServer(
+    http = HTTPServer(
         server.router,
         host=host,
         port=port,
@@ -440,3 +440,7 @@ def create_event_server(
         registry=server.registry,
         tracer=server.tracer,
     )
+    # graceful drain: release the plugin dispatcher once in-flight
+    # ingests have finished
+    http.add_drain_hook(server.close)
+    return http

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import re
 import socket
 import ssl
@@ -24,6 +25,7 @@ from urllib.parse import parse_qs, urlparse
 from predictionio_tpu.obs import MetricRegistry, set_request_id
 from predictionio_tpu.obs import tracing
 from predictionio_tpu.obs.context import log_json, redact_keys
+from predictionio_tpu.serving import resilience
 
 logger = logging.getLogger(__name__)
 
@@ -52,6 +54,9 @@ class Request:
         self.path_params = path_params
         #: set by the server wrapper (forwarded X-Request-ID or minted)
         self.request_id: str | None = None
+        #: remaining-budget deadline from X-PIO-Deadline (set by the
+        #: server wrapper; None when the request carried no budget)
+        self.deadline: resilience.Deadline | None = None
         #: the route PATTERN that matched (set by Router.dispatch) —
         #: bounded cardinality, unlike the raw path
         self.route: str | None = None
@@ -102,6 +107,9 @@ class Router:
 
     def __init__(self):
         self._routes: list[tuple[str, re.Pattern, Handler, str]] = []
+        #: fault injector applied before dispatch (attached by
+        #: install_metrics_routes when PIO_CHAOS is set)
+        self.chaos_middleware: resilience.ChaosMiddleware | None = None
 
     def route(self, method: str, pattern: str, handler: Handler) -> None:
         # escape literal segments so '.' in '.json' doesn't match anything
@@ -203,6 +211,10 @@ def install_metrics_routes(
     router.route("GET", "/metrics.json", _metrics_json)
     router.route("GET", "/debug/traces", _traces)
     router.route("GET", "/debug/traces.json", _traces_json)
+    # same seam, one more cross-cutting behavior: every server that
+    # mounts the telemetry surface also gains the env-driven fault
+    # injector (no-op unless PIO_CHAOS is set; docs/robustness.md)
+    router.chaos_middleware = resilience.ChaosMiddleware.from_env(registry)
 
 
 class HTTPServer:
@@ -246,6 +258,8 @@ class HTTPServer:
         router_ref = router
         config_ref = server_config if enforce_key else None
         tracer_ref = tracer if tracer is not None else tracing.get_tracer()
+        chaos_ref = router.chaos_middleware
+        state = resilience.DrainState()
         if registry is not None:
             requests_total = registry.counter(
                 "pio_http_requests_total",
@@ -257,8 +271,28 @@ class HTTPServer:
                 "HTTP request latency by service and route pattern",
                 ("service", "route"),
             )
+            rejected_total = registry.counter(
+                "pio_http_rejected_total",
+                "Requests refused at admission, by reason "
+                "(draining | deadline)",
+                ("service", "reason"),
+            )
+            # scrape-time functions: in a process that rebuilds servers
+            # (tests, reload), the latest server's state wins the label
+            registry.gauge(
+                "pio_http_inflight_requests",
+                "Requests currently being handled",
+                ("service",),
+            ).labels(service).set_function(lambda: float(state.inflight))
+            registry.gauge(
+                "pio_server_draining",
+                "1 while the server is draining (stopped accepting work)",
+                ("service",),
+            ).labels(service).set_function(
+                lambda: 1.0 if state.draining.is_set() else 0.0
+            )
         else:
-            requests_total = request_seconds = None
+            requests_total = request_seconds = rejected_total = None
 
         class _Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -281,7 +315,69 @@ class HTTPServer:
                 line = redact_keys(fmt % args)
                 logger.debug("%s %s", self.address_string(), line)
 
+            def _admission(
+                self, request, path, deadline, telemetry_path
+            ) -> Response | None:
+                """Work the server refuses before running any handler:
+                the /healthz probe itself, everything while draining,
+                and requests whose deadline already expired (admitting
+                them would spend handler + device time computing an
+                answer nobody is waiting for)."""
+                if path == "/healthz" and self.command == "GET":
+                    draining = state.draining.is_set()
+                    request.route = "/healthz"
+                    return Response(
+                        503 if draining else 200,
+                        {
+                            "status": "draining" if draining else "ok",
+                            "service": service,
+                            "pid": os.getpid(),
+                        },
+                    )
+                if self._draining_at_entry and not telemetry_path:
+                    request.route = "(draining)"
+                    if rejected_total is not None:
+                        rejected_total.labels(service, "draining").inc()
+                    return Response(
+                        503,
+                        {
+                            "message": "server is draining; "
+                            "retry against another instance"
+                        },
+                        headers={"Retry-After": "1"},
+                    )
+                if deadline is not None and deadline.expired:
+                    request.route = (
+                        router_ref.match_route(request) or "(unmatched)"
+                    )
+                    if rejected_total is not None:
+                        rejected_total.labels(service, "deadline").inc()
+                    return Response(
+                        504,
+                        {"message": "deadline already expired at admission"},
+                    )
+                return None
+
             def _handle(self):
+                # count the request in-flight for the WHOLE handler —
+                # until the response bytes are written, so the process
+                # does not exit mid-write. ORDER MATTERS: increment
+                # BEFORE snapshotting the draining flag. Drain sets the
+                # flag first and then samples inflight, so every
+                # request is either visible to the drain's inflight
+                # wait or sees the flag and is refused — there is no
+                # window where a just-admitted request is invisible to
+                # a concurrent drain. The snapshot (not a live read)
+                # also means a request whose body was still streaming
+                # when SIGTERM arrived is finished, not refused.
+                state.begin_request()
+                self._draining_at_entry = state.draining.is_set()
+                try:
+                    self._handle_inner()
+                finally:
+                    state.end_request()
+
+            def _handle_inner(self):
                 parsed = urlparse(self.path)
                 query = {
                     k: v[0] for k, v in parse_qs(parsed.query).items()
@@ -301,53 +397,109 @@ class HTTPServer:
                 request.request_id = set_request_id(
                     self.headers.get("X-Request-ID")
                 )
-                # root span: trace ID = request ID; a forwarded
-                # X-Parent-Span makes this request a child in a
-                # distributed trace. Scrapes of the telemetry surface
-                # itself would drown real traffic in the recorder; a
-                # disabled tracer skips even the name/attribute builds.
-                span_cm = (
-                    tracing.NOOP
-                    if not tracer_ref.enabled
-                    or parsed.path.startswith(("/metrics", "/debug/"))
-                    else tracer_ref.trace(
-                        f"{service} {self.command}",
-                        trace_id=request.request_id,
-                        parent_id=tracing.sanitize_id(
-                            self.headers.get(tracing.PARENT_SPAN_HEADER)
-                        ),
-                        attributes={
-                            "service": service,
-                            "method": self.command,
-                        },
-                    )
+                # the remaining-budget deadline rides the same context;
+                # set unconditionally — a keep-alive connection reuses
+                # this thread, and a stale deadline must not leak into
+                # the next request
+                deadline = resilience.Deadline.from_header(
+                    self.headers.get(resilience.DEADLINE_HEADER)
+                )
+                resilience.set_deadline(deadline)
+                request.deadline = deadline
+                # the operator's window into a sick server: never
+                # drain-refused, never chaos-faulted
+                telemetry_path = parsed.path == "/healthz" or (
+                    parsed.path.startswith(("/metrics", "/debug/"))
                 )
                 t0 = time.perf_counter()
-                with span_cm as root_span:
+                early = self._admission(request, parsed.path, deadline,
+                                        telemetry_path)
+                if early is not None:
+                    response = early
+                else:
+                    # root span: trace ID = request ID; a forwarded
+                    # X-Parent-Span makes this request a child in a
+                    # distributed trace. Scrapes of the telemetry surface
+                    # itself would drown real traffic in the recorder; a
+                    # disabled tracer skips even the name/attribute builds.
+                    span_cm = (
+                        tracing.NOOP
+                        if not tracer_ref.enabled
+                        or parsed.path.startswith(("/metrics", "/debug/"))
+                        else tracer_ref.trace(
+                            f"{service} {self.command}",
+                            trace_id=request.request_id,
+                            parent_id=tracing.sanitize_id(
+                                self.headers.get(tracing.PARENT_SPAN_HEADER)
+                            ),
+                            attributes={
+                                "service": service,
+                                "method": self.command,
+                            },
+                        )
+                    )
                     try:
-                        if config_ref is not None:
-                            # resolve the route label BEFORE key auth so
-                            # a 401 counts against the real route, not
-                            # "(unmatched)" alongside path-scan noise
-                            request.route = router_ref.match_route(request)
-                            config_ref.check_key(request)
-                        response = router_ref.dispatch(request)
-                    except HTTPError as e:
-                        response = Response(
-                            e.status, {"message": e.message}
+                        with span_cm as root_span:
+                            try:
+                                if (
+                                    chaos_ref is not None
+                                    and not telemetry_path
+                                ):
+                                    chaos_ref.apply(parsed.path)
+                                if config_ref is not None:
+                                    # resolve the route label BEFORE key
+                                    # auth so a 401 counts against the
+                                    # real route, not "(unmatched)"
+                                    # alongside path-scan noise
+                                    request.route = router_ref.match_route(
+                                        request
+                                    )
+                                    config_ref.check_key(request)
+                                response = router_ref.dispatch(request)
+                            except resilience.ChaosReset:
+                                raise  # handled below: slam the socket
+                            except HTTPError as e:
+                                response = Response(
+                                    e.status, {"message": e.message}
+                                )
+                            except resilience.DeadlineExceeded as e:
+                                response = Response(
+                                    504,
+                                    {"message": f"deadline exceeded: {e}"},
+                                )
+                            except resilience.ChaosError as e:
+                                response = Response(
+                                    e.status, {"message": e.message}
+                                )
+                            except resilience.CircuitOpenError as e:
+                                # a dependency's breaker is open: the
+                                # request CAN be retried elsewhere/later
+                                response = Response(
+                                    503,
+                                    {"message": str(e)},
+                                    headers={"Retry-After": "1"},
+                                )
+                            except json.JSONDecodeError as e:
+                                response = Response(
+                                    400, {"message": f"bad JSON: {e}"}
+                                )
+                            except Exception as e:  # noqa: BLE001 - server boundary
+                                logger.exception("handler error")
+                                response = Response(
+                                    500, {"message": str(e)}
+                                )
+                            if root_span is not None:
+                                root_span.set(
+                                    "route", request.route or "(unmatched)"
+                                )
+                                root_span.set("status", response.status)
+                    except resilience.ChaosReset:
+                        log_json(
+                            access_logger, logging.INFO, "chaos_reset",
+                            service=service, path=parsed.path,
                         )
-                    except json.JSONDecodeError as e:
-                        response = Response(
-                            400, {"message": f"bad JSON: {e}"}
-                        )
-                    except Exception as e:  # noqa: BLE001 - server boundary
-                        logger.exception("handler error")
-                        response = Response(500, {"message": str(e)})
-                    if root_span is not None:
-                        root_span.set(
-                            "route", request.route or "(unmatched)"
-                        )
-                        root_span.set("status", response.status)
+                        self.close_connection = True
+                        return
                 elapsed = time.perf_counter() - t0
                 if response.status >= 400 and isinstance(
                     response.body, dict
@@ -440,10 +592,73 @@ class HTTPServer:
 
         self._httpd = _Server((host, port), _Handler)
         self._thread: threading.Thread | None = None
+        self._state = state
+        self._service = service
+        self._drain_hooks: list[Callable[[], None]] = []
+        self.router = router
 
     @property
     def port(self) -> int:
         return self._httpd.server_address[1]
+
+    # -- graceful drain ---------------------------------------------------
+    @property
+    def draining(self) -> bool:
+        return self._state.draining.is_set()
+
+    @property
+    def inflight(self) -> int:
+        return self._state.inflight
+
+    def add_drain_hook(self, hook: Callable[[], None]) -> None:
+        """Run ``hook`` during drain, after in-flight requests finished
+        and before the listener closes — where an engine server closes
+        its micro-batchers so the current device batch completes."""
+        self._drain_hooks.append(hook)
+
+    def begin_drain(self) -> None:
+        """Stop accepting work NOW: /healthz answers ``draining`` (503)
+        and every non-telemetry request is refused with 503 +
+        ``Retry-After``. In-flight requests keep running."""
+        if not self._state.draining.is_set():
+            self._state.draining.set()
+            log_json(
+                logger, logging.INFO, "drain_begin",
+                service=self._service,
+            )
+
+    def drain(self, grace_s: float | None = None) -> bool:
+        """The full lossless-restart sequence: begin_drain, wait for
+        in-flight requests (bounded by ``grace_s`` /
+        ``PIO_DRAIN_GRACE_S``), run drain hooks, shut the listener
+        down. Returns True when every in-flight request finished
+        inside the grace window."""
+        grace = (
+            grace_s if grace_s is not None else resilience.drain_grace_s()
+        )
+        self.begin_drain()
+        deadline = time.monotonic() + grace
+        while self._state.inflight > 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        clean = self._state.inflight == 0
+        if not clean:
+            log_json(
+                logger, logging.WARNING, "drain_grace_exceeded",
+                service=self._service,
+                inflight=self._state.inflight,
+                graceS=grace,
+            )
+        for hook in self._drain_hooks:
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 - drain must reach shutdown
+                logger.exception("drain hook failed")
+        log_json(
+            logger, logging.INFO, "server_drained",
+            service=self._service, clean=clean,
+        )
+        self.shutdown()
+        return clean
 
     def start(self) -> None:
         self._thread = threading.Thread(

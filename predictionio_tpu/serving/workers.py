@@ -29,7 +29,6 @@ Caveats:
 from __future__ import annotations
 
 import logging
-import signal
 import subprocess
 import sys
 import threading
@@ -122,13 +121,12 @@ def serve_with_workers(
     watchdog = threading.Thread(target=supervise, daemon=True)
     watchdog.start()
 
-    def _terminate(*_sig) -> None:
-        raise KeyboardInterrupt
+    # the parent serves traffic too: SIGTERM drains it like any other
+    # server (docs/robustness.md) — serve_forever returns when the
+    # drain completes. Ctrl-C stays an immediate group teardown.
+    from predictionio_tpu.serving import resilience
 
-    try:
-        signal.signal(signal.SIGTERM, _terminate)
-    except ValueError:
-        pass  # not the main thread (tests)
+    resilience.install_signal_drain(http_server)
     try:
         http_server.serve_forever()
     except KeyboardInterrupt:
@@ -140,7 +138,12 @@ def serve_with_workers(
         watchdog.join(timeout=_RESPAWN_MAX_DELAY_S + 1.0)
         for slot in children:
             slot[0].terminate()
-        deadline = time.monotonic() + 5
+        # children drain on SIGTERM too — give them the drain grace
+        # (plus slack) before escalating to SIGKILL, or a slow device
+        # batch gets cut mid-drain and the lossless contract breaks
+        deadline = (
+            time.monotonic() + resilience.drain_grace_s() + 5.0
+        )
         for slot in children:
             try:
                 slot[0].wait(

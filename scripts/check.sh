@@ -49,4 +49,11 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
     rc=1
 fi
 
+echo "== chaos smoke test (resilience layer, docs/robustness.md) =="
+if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python scripts/chaos_smoke.py; then
+    echo "chaos smoke test FAILED"
+    rc=1
+fi
+
 exit $rc

@@ -1,0 +1,1110 @@
+"""The resilience layer (docs/robustness.md): deadline arithmetic and
+header round-trips, the circuit-breaker state machine, budgeted
+retry/backoff, expired-slot drops in the micro-batcher, SIGTERM
+graceful drain, and deterministic seed-driven chaos injection."""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import random
+import signal
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.obs import MetricRegistry
+from predictionio_tpu.serving import resilience
+from predictionio_tpu.serving.http import (
+    HTTPServer,
+    Response,
+    Router,
+    install_metrics_routes,
+)
+from predictionio_tpu.serving.resilience import (
+    BreakerConfig,
+    ChaosError,
+    ChaosMiddleware,
+    ChaosReset,
+    CircuitBreaker,
+    CircuitOpenError,
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_context():
+    """Deadlines must not leak between tests (the contextvar rides the
+    pytest thread), and breaker state is process-global by design."""
+    resilience.set_deadline(None)
+    yield
+    resilience.set_deadline(None)
+    resilience.reset_breakers()
+
+
+def _get(url, headers=None, timeout=5):
+    req = urllib.request.Request(url, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read() or b"null"), resp.headers
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null"), e.headers
+
+
+# --------------------------------------------------------------------------
+# deadlines
+# --------------------------------------------------------------------------
+
+
+class TestDeadline:
+    def test_after_and_remaining(self):
+        d = Deadline.after(1.0)
+        assert 0.9 < d.remaining_s() <= 1.0
+        assert not d.expired
+
+    def test_expired(self):
+        assert Deadline.after(-0.1).expired
+        assert Deadline.after(0.0).expired
+
+    def test_from_header_round_trip_decrements(self):
+        d = Deadline.from_header("500")
+        assert d is not None and 480 < d.remaining_ms() <= 500
+        time.sleep(0.05)
+        # the next hop's header carries what is LEFT, not the original
+        assert int(d.to_header()) <= 455
+
+    def test_from_header_absent_and_malformed(self):
+        assert Deadline.from_header(None) is None
+        assert Deadline.from_header("") is None
+        assert Deadline.from_header("not-a-number") is None
+
+    def test_from_header_nonfinite_treated_as_malformed(self):
+        # nan would bypass both the clamp and `expired`, and inf would
+        # pin the deadline forever — float()-parseable is not enough
+        assert Deadline.from_header("nan") is None
+        assert Deadline.from_header("inf") is None
+        assert Deadline.from_header("-inf") is None
+
+    def test_from_header_nonpositive_is_expired(self):
+        assert Deadline.from_header("0").expired
+        assert Deadline.from_header("-250").expired
+
+    def test_from_header_clamps_hostile_budget(self):
+        d = Deadline.from_header("1e300")
+        assert d.remaining_s() <= Deadline.MAX_BUDGET_S
+
+    def test_cap_bounds_timeouts(self):
+        d = Deadline.after(0.2)
+        assert d.cap(10.0) <= 0.2
+        assert d.cap(0.05) == pytest.approx(0.05, abs=0.01)
+        assert Deadline.after(-1.0).cap(10.0) == 0.001  # floor, not negative
+
+    def test_to_header_never_negative(self):
+        assert Deadline.after(-5.0).to_header() == "0"
+
+    def test_contextvar_round_trip(self):
+        d = Deadline.after(1.0)
+        resilience.set_deadline(d)
+        assert resilience.get_deadline() is d
+        resilience.set_deadline(None)
+        assert resilience.get_deadline() is None
+
+
+# --------------------------------------------------------------------------
+# retry policy
+# --------------------------------------------------------------------------
+
+
+class TestRetryPolicy:
+    def test_backoff_grows_exponentially_and_caps(self):
+        p = RetryPolicy(
+            max_attempts=6, base_backoff_s=0.1, multiplier=2.0,
+            max_backoff_s=0.5, jitter=0.0,
+        )
+        delays = [p.backoff_s(i) for i in range(5)]
+        assert delays == [0.1, 0.2, 0.4, 0.5, 0.5]
+
+    def test_jitter_is_bounded_and_seed_deterministic(self):
+        p = RetryPolicy(base_backoff_s=1.0, jitter=0.5)
+        rng = random.Random(7)
+        seen = [p.backoff_s(0, rng) for _ in range(50)]
+        assert all(0.5 <= d <= 1.0 for d in seen)
+        assert seen == [
+            p.backoff_s(0, random.Random(7)) for _ in range(1)
+        ][:1] + seen[1:]  # first draw reproduces under the same seed
+
+    def test_sleep_before_retry_respects_attempt_budget(self):
+        p = RetryPolicy(max_attempts=2, base_backoff_s=0.001)
+        assert p.sleep_before_retry(0, None) is True
+        assert p.sleep_before_retry(1, None) is False  # attempts exhausted
+
+    def test_sleep_before_retry_respects_deadline_budget(self):
+        p = RetryPolicy(max_attempts=5, base_backoff_s=0.2, jitter=0.0)
+        # 50 ms of budget cannot fit a 200 ms backoff: no sleep, no retry
+        t0 = time.monotonic()
+        assert p.sleep_before_retry(0, Deadline.after(0.05)) is False
+        assert time.monotonic() - t0 < 0.1
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv("PIO_RETRY_MAX_ATTEMPTS", "7")
+        monkeypatch.setenv("PIO_RETRY_BASE_MS", "125")
+        monkeypatch.setenv("PIO_RETRY_JITTER", "0.25")
+        p = RetryPolicy.from_env()
+        assert p.max_attempts == 7
+        assert p.base_backoff_s == pytest.approx(0.125)
+        assert p.jitter == 0.25
+
+
+# --------------------------------------------------------------------------
+# circuit breaker
+# --------------------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.now = 100.0
+
+    def __call__(self) -> float:
+        return self.now
+
+
+class TestCircuitBreaker:
+    def _breaker(self, **cfg) -> tuple[CircuitBreaker, _Clock, MetricRegistry]:
+        clock = _Clock()
+        registry = MetricRegistry()
+        breaker = CircuitBreaker(
+            "t:1",
+            config=BreakerConfig(**{
+                "failure_threshold": 3, "reset_after_s": 10.0, **cfg
+            }),
+            registry=registry,
+            clock=clock,
+        )
+        return breaker, clock, registry
+
+    def _gauge(self, registry) -> float:
+        [sample] = registry.to_dict()["pio_breaker_state"]["samples"]
+        return sample["value"]
+
+    def test_closed_until_threshold_consecutive_failures(self):
+        b, _, registry = self._breaker()
+        b.record_failure()
+        b.record_failure()
+        assert b.state == resilience.CLOSED and b.allow()
+        b.record_failure()
+        assert b.state == resilience.OPEN
+        assert not b.allow()
+        assert self._gauge(registry) == 1
+
+    def test_success_resets_consecutive_count(self):
+        b, _, _ = self._breaker()
+        for _ in range(10):  # never 3 in a row
+            b.record_failure()
+            b.record_failure()
+            b.record_success()
+        assert b.state == resilience.CLOSED
+
+    def test_open_to_half_open_after_reset_window(self):
+        b, clock, registry = self._breaker()
+        for _ in range(3):
+            b.record_failure()
+        assert not b.allow()
+        clock.now += 10.1
+        assert b.allow()  # the probe
+        assert b.state == resilience.HALF_OPEN
+        assert self._gauge(registry) == 2
+
+    def test_half_open_bounds_probes(self):
+        b, clock, _ = self._breaker(half_open_max=1)
+        for _ in range(3):
+            b.record_failure()
+        clock.now += 10.1
+        assert b.allow()
+        assert not b.allow()  # second concurrent probe refused
+
+    def test_probe_success_recloses(self):
+        b, clock, registry = self._breaker()
+        for _ in range(3):
+            b.record_failure()
+        clock.now += 10.1
+        assert b.allow()
+        b.record_success()
+        assert b.state == resilience.CLOSED
+        assert self._gauge(registry) == 0
+        # and the consecutive-failure count restarted
+        b.record_failure()
+        b.record_failure()
+        assert b.state == resilience.CLOSED
+
+    def test_probe_failure_retrips_and_restarts_clock(self):
+        b, clock, _ = self._breaker()
+        for _ in range(3):
+            b.record_failure()
+        clock.now += 10.1
+        assert b.allow()
+        b.record_failure()
+        assert b.state == resilience.OPEN
+        clock.now += 5.0  # clock restarted at the re-trip: still open
+        assert not b.allow()
+        clock.now += 5.1
+        assert b.allow()
+
+    def test_release_frees_half_open_probe_slot(self):
+        """A verdict-less probe (stale keep-alive replay, budget-starved
+        timeout) must release its slot — without release() the breaker
+        would wedge half-open forever."""
+        b, clock, _ = self._breaker(half_open_max=1)
+        for _ in range(3):
+            b.record_failure()
+        clock.now += 10.1
+        assert b.allow()  # probe admitted, slot consumed
+        b.release()       # ...but it produced no evidence
+        assert b.state == resilience.HALF_OPEN
+        assert b.allow()  # the slot is free again: not wedged
+        b.record_success()
+        assert b.state == resilience.CLOSED
+
+    def test_release_is_a_noop_when_closed(self):
+        b, _, _ = self._breaker()
+        b.release()
+        assert b.state == resilience.CLOSED and b.allow()
+
+    def test_stale_verdicts_ignored_in_half_open(self):
+        """A slow request admitted before the trip must not re-trip (or
+        close) the breaker while half-open when no probe is
+        outstanding — its verdict predates the episode."""
+        b, clock, _ = self._breaker()
+        for _ in range(3):
+            b.record_failure()
+        clock.now += 10.1
+        # half-open with no probe outstanding: allow() then release()
+        assert b.allow()
+        b.release()
+        assert b.state == resilience.HALF_OPEN
+        b.record_failure()    # stale CLOSED-era failure: ignored
+        assert b.state == resilience.HALF_OPEN
+        b.record_success()    # stale CLOSED-era success: ignored
+        assert b.state == resilience.HALF_OPEN
+        assert b.allow()      # the real probe still gets its slot
+        b.record_success()
+        assert b.state == resilience.CLOSED
+
+    def test_stale_failure_cannot_steal_an_outstanding_probe_slot(self):
+        """A slow pre-trip request failing WHILE a probe is outstanding
+        (different thread) must not consume the probe's slot or re-trip
+        the breaker — the probe's own verdict decides the episode."""
+        b, clock, _ = self._breaker()
+        for _ in range(3):
+            b.record_failure()
+        clock.now += 10.1
+        assert b.allow()  # probe admitted on THIS thread
+        # the old, pre-trip request's failure lands from another thread
+        t = threading.Thread(target=b.record_failure)
+        t.start()
+        t.join()
+        assert b.state == resilience.HALF_OPEN  # not re-tripped
+        b.record_success()  # the real probe's verdict
+        assert b.state == resilience.CLOSED
+
+    def test_transitions_counter(self):
+        b, clock, registry = self._breaker()
+        for _ in range(3):
+            b.record_failure()
+        clock.now += 10.1
+        b.allow()
+        b.record_success()
+        counts = {
+            s["labels"]["to"]: s["value"]
+            for s in registry.to_dict()[
+                "pio_breaker_transitions_total"
+            ]["samples"]
+        }
+        assert counts == {"open": 1, "half_open": 1, "closed": 1}
+
+    def test_get_breaker_shared_per_target(self):
+        registry = MetricRegistry()
+        a = resilience.get_breaker("shared:9", registry=registry)
+        assert resilience.get_breaker("shared:9") is a
+        assert resilience.get_breaker("other:9", registry=registry) is not a
+
+
+# --------------------------------------------------------------------------
+# micro-batcher deadline drops + leak detection
+# --------------------------------------------------------------------------
+
+
+class TestBatcherDeadlines:
+    def test_expired_slot_dropped_before_dispatch(self):
+        from predictionio_tpu.serving.batching import MicroBatcher
+
+        registry = MetricRegistry()
+        calls = []
+        batcher = MicroBatcher(
+            lambda items: calls.append(items) or [0] * len(items),
+            max_batch=8, max_wait_ms=120.0, registry=registry,
+            name="dl",
+        )
+        try:
+            resilience.set_deadline(Deadline.after(0.01))
+            future = batcher.submit({"q": 1})
+            with pytest.raises(DeadlineExceeded):
+                future.result(timeout=5)
+            assert calls == []  # the device never saw it
+            [expired] = [
+                s["value"]
+                for s in registry.to_dict()[
+                    "pio_batch_deadline_expired_total"
+                ]["samples"]
+            ]
+            assert expired == 1
+        finally:
+            resilience.set_deadline(None)
+            batcher.close()
+
+    def test_already_expired_submit_rejected(self):
+        from predictionio_tpu.serving.batching import MicroBatcher
+
+        batcher = MicroBatcher(lambda items: [0] * len(items))
+        try:
+            resilience.set_deadline(Deadline.after(-1.0))
+            with pytest.raises(DeadlineExceeded):
+                batcher.submit({"q": 1})
+        finally:
+            resilience.set_deadline(None)
+            batcher.close()
+
+    def test_live_slots_still_dispatch_alongside_expired(self):
+        from predictionio_tpu.serving.batching import MicroBatcher
+
+        batcher = MicroBatcher(
+            lambda items: [i["q"] for i in items],
+            max_batch=8, max_wait_ms=120.0,
+        )
+        try:
+            resilience.set_deadline(Deadline.after(0.01))
+            doomed = batcher.submit({"q": 1})
+            resilience.set_deadline(None)
+            alive = batcher.submit({"q": 2})
+            assert alive.result(timeout=5) == 2
+            with pytest.raises(DeadlineExceeded):
+                doomed.result(timeout=5)
+        finally:
+            resilience.set_deadline(None)
+            batcher.close()
+
+    def test_close_counts_leaked_worker_thread(self):
+        from predictionio_tpu.serving.batching import MicroBatcher
+
+        registry = MetricRegistry()
+        release = threading.Event()
+
+        def hung_dispatch(items):
+            release.wait(10)
+            return [0] * len(items)
+
+        batcher = MicroBatcher(
+            hung_dispatch, max_wait_ms=1.0, registry=registry,
+            name="hung", close_join_timeout_s=0.2,
+        )
+        try:
+            batcher.submit({"q": 1})
+            time.sleep(0.1)  # let the worker enter the hung dispatch
+            batcher.close()
+            [leaked] = [
+                s["value"]
+                for s in registry.to_dict()[
+                    "pio_batcher_leaked_threads_total"
+                ]["samples"]
+            ]
+            assert leaked == 1
+        finally:
+            release.set()
+
+    def test_clean_close_leaks_nothing(self):
+        from predictionio_tpu.serving.batching import MicroBatcher
+
+        registry = MetricRegistry()
+        batcher = MicroBatcher(
+            lambda items: [0] * len(items), registry=registry, name="ok"
+        )
+        batcher.submit({}).result(timeout=5)
+        batcher.close()
+        [leaked] = [
+            s["value"]
+            for s in registry.to_dict()[
+                "pio_batcher_leaked_threads_total"
+            ]["samples"]
+        ]
+        assert leaked == 0
+
+
+# --------------------------------------------------------------------------
+# HTTP layer: admission, healthz, drain
+# --------------------------------------------------------------------------
+
+
+def _make_server(registry=None, slow_s: float = 0.0):
+    router = Router()
+
+    def _echo(request):
+        if slow_s:
+            time.sleep(slow_s)
+        d = resilience.get_deadline()
+        return Response(
+            200,
+            {"remainingMs": None if d is None else d.remaining_ms()},
+        )
+
+    router.route("GET", "/echo", _echo)
+    if registry is not None:
+        # the production seam: mounts /metrics* and attaches the
+        # PIO_CHAOS middleware when the env is set
+        install_metrics_routes(router, registry)
+    http_server = HTTPServer(
+        router, host="127.0.0.1", port=0, service="t",
+        registry=registry,
+    )
+    http_server.start()
+    return http_server, f"http://127.0.0.1:{http_server.port}"
+
+
+class TestDeadlineOverHTTP:
+    def test_header_installs_contextvar_deadline(self):
+        server, base = _make_server()
+        try:
+            status, body, _ = _get(
+                f"{base}/echo", headers={"X-PIO-Deadline": "5000"}
+            )
+            assert status == 200
+            assert 4000 < body["remainingMs"] <= 5000
+            # and a request WITHOUT the header sees none (no leakage
+            # across keep-alive reuse of the handler thread)
+            status, body, _ = _get(f"{base}/echo")
+            assert body["remainingMs"] is None
+        finally:
+            server.shutdown()
+
+    def test_expired_deadline_rejected_at_admission(self):
+        registry = MetricRegistry()
+        server, base = _make_server(registry)
+        try:
+            status, body, headers = _get(
+                f"{base}/echo", headers={"X-PIO-Deadline": "0"}
+            )
+            assert status == 504
+            assert body["requestId"]  # still correlatable
+            rejected = {
+                s["labels"]["reason"]: s["value"]
+                for s in registry.to_dict()[
+                    "pio_http_rejected_total"
+                ]["samples"]
+            }
+            assert rejected == {"deadline": 1}
+        finally:
+            server.shutdown()
+
+
+class TestHealthzAndDrain:
+    def test_healthz_ok_then_draining(self):
+        server, base = _make_server()
+        try:
+            status, body, _ = _get(f"{base}/healthz")
+            assert (status, body["status"]) == (200, "ok")
+            server.begin_drain()
+            status, body, _ = _get(f"{base}/healthz")
+            assert (status, body["status"]) == (503, "draining")
+        finally:
+            server.shutdown()
+
+    def test_draining_refuses_work_but_not_telemetry(self):
+        registry = MetricRegistry()
+        server, base = _make_server(registry)
+        try:
+            server.begin_drain()
+            status, _, headers = _get(f"{base}/echo")
+            assert status == 503
+            assert headers.get("Retry-After")
+            # the operator's window stays open
+            status, _, _ = _get(f"{base}/metrics.json")
+            assert status == 200
+        finally:
+            server.shutdown()
+
+    def test_drain_waits_for_inflight_and_runs_hooks(self):
+        server, base = _make_server(slow_s=0.3)
+        hooks = []
+        server.add_drain_hook(lambda: hooks.append("closed"))
+        result = {}
+
+        def _slow():
+            result["resp"] = _get(f"{base}/echo", timeout=5)
+
+        t = threading.Thread(target=_slow)
+        t.start()
+        time.sleep(0.1)  # request is in flight
+        assert server.inflight == 1
+        clean = server.drain(grace_s=5)
+        t.join(timeout=5)
+        assert clean is True
+        assert result["resp"][0] == 200  # lossless
+        assert hooks == ["closed"]
+        with pytest.raises(OSError):
+            urllib.request.urlopen(f"{base}/healthz", timeout=1)
+
+    def test_request_mid_upload_at_drain_start_is_processed(self):
+        """The draining decision is snapshot at handler entry: a
+        request whose body was still streaming when drain began is
+        in-flight work to finish, not new work to refuse."""
+        router = Router()
+        router.route(
+            "POST", "/ingest",
+            lambda r: Response(200, {"bytes": len(r.body)}),
+        )
+        server = HTTPServer(router, host="127.0.0.1", port=0, service="t")
+        server.start()
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", server.port, timeout=5
+            )
+            body = b"y" * 4096
+            conn.putrequest("POST", "/ingest")
+            conn.putheader("Content-Length", str(len(body) * 2))
+            conn.endheaders()
+            conn.send(body)           # handler entered, body incomplete
+            time.sleep(0.1)
+            server.begin_drain()      # SIGTERM lands mid-upload
+            conn.send(body)
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert json.loads(resp.read())["bytes"] == len(body) * 2
+            conn.close()
+            # whereas a request STARTED after the flag is refused
+            status, _, _ = _get(f"http://127.0.0.1:{server.port}/healthz")
+            assert status == 503
+        finally:
+            server.shutdown()
+
+    def test_drain_grace_bounded_by_timeout(self):
+        server, base = _make_server(slow_s=1.5)
+        t = threading.Thread(
+            target=lambda: _get(f"{base}/echo", timeout=5)
+        )
+        t.start()
+        time.sleep(0.1)
+        t0 = time.monotonic()
+        clean = server.drain(grace_s=0.2)
+        assert clean is False
+        assert time.monotonic() - t0 < 1.0
+        t.join(timeout=5)
+
+    def test_sigterm_drains_losslessly(self):
+        """The e2e contract: SIGTERM → healthz flips → in-flight work
+        finishes → listener exits — driven by the real signal."""
+        server, base = _make_server(slow_s=0.4)
+        restore = resilience.install_signal_drain(server, grace_s=5)
+        result = {}
+        try:
+            t = threading.Thread(
+                target=lambda: result.update(
+                    resp=_get(f"{base}/echo", timeout=5)
+                )
+            )
+            t.start()
+            time.sleep(0.1)
+            os.kill(os.getpid(), signal.SIGTERM)
+            deadline = time.monotonic() + 2
+            seen_draining = False
+            while time.monotonic() < deadline:
+                try:
+                    status, body, _ = _get(f"{base}/healthz", timeout=1)
+                except OSError:
+                    break  # already shut down
+                if status == 503 and body.get("status") == "draining":
+                    seen_draining = True
+                    break
+                time.sleep(0.01)
+            assert seen_draining
+            t.join(timeout=5)
+            assert result["resp"][0] == 200
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                try:
+                    urllib.request.urlopen(f"{base}/healthz", timeout=1)
+                    time.sleep(0.02)
+                except OSError:
+                    break
+            else:
+                pytest.fail("listener still up after drain")
+        finally:
+            restore()
+            server.shutdown()
+
+
+# --------------------------------------------------------------------------
+# chaos middleware
+# --------------------------------------------------------------------------
+
+
+class TestChaos:
+    def test_parse(self):
+        rules = ChaosMiddleware.parse(
+            "latency:p=0.1,ms=200;error:p=0.05,status=502;reset:p=0.02"
+        )
+        assert [r.fault for r in rules] == ["latency", "error", "reset"]
+        assert rules[0].ms == 200.0
+        assert rules[1].status == 502
+
+    @pytest.mark.parametrize("spec", [
+        "explode:p=0.1",          # unknown fault
+        "error",                  # missing p
+        "error:p=2.0",            # p out of range
+        "error:p=0.1,zap=1",      # unknown arg
+        "latency:p=abc",          # malformed value
+        "",                       # no rules
+    ])
+    def test_parse_rejects_bad_specs(self, spec):
+        with pytest.raises(ValueError):
+            ChaosMiddleware.parse(spec)
+
+    def _schedule(self, seed, n=40):
+        chaos = ChaosMiddleware(
+            "error:p=0.3;reset:p=0.2", seed=seed,
+            registry=MetricRegistry(),
+        )
+        out = []
+        for _ in range(n):
+            try:
+                chaos.apply("/x")
+                out.append("pass")
+            except ChaosError:
+                out.append("error")
+            except ChaosReset:
+                out.append("reset")
+        return out
+
+    def test_seeded_schedule_is_deterministic(self):
+        assert self._schedule(42) == self._schedule(42)
+        assert self._schedule(42) != self._schedule(43)
+        assert {"error", "reset", "pass"} <= set(self._schedule(42, 200))
+
+    def test_disabled_is_a_noop(self):
+        chaos = ChaosMiddleware(
+            "error:p=1.0", registry=MetricRegistry()
+        )
+        chaos.enabled = False
+        chaos.apply("/x")  # no raise
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.delenv("PIO_CHAOS", raising=False)
+        assert ChaosMiddleware.from_env(MetricRegistry()) is None
+        monkeypatch.setenv("PIO_CHAOS", "error:p=1.0")
+        monkeypatch.setenv("PIO_CHAOS_SEED", "9")
+        chaos = ChaosMiddleware.from_env(MetricRegistry())
+        assert chaos is not None and chaos.rules[0].p == 1.0
+
+    def test_faults_injected_through_real_server(self, monkeypatch):
+        monkeypatch.setenv("PIO_CHAOS", "error:p=1.0,status=503")
+        registry = MetricRegistry()
+        server, base = _make_server(registry)
+        try:
+            status, body, _ = _get(f"{base}/echo")
+            assert status == 503
+            assert "chaos" in body["message"]
+            # telemetry is exempt: the operator can watch the burn
+            status, _, _ = _get(f"{base}/metrics.json")
+            assert status == 200
+            [count] = [
+                s["value"]
+                for s in registry.to_dict()[
+                    "pio_chaos_injected_total"
+                ]["samples"]
+            ]
+            assert count == 1
+        finally:
+            server.shutdown()
+
+    def test_reset_fault_slams_the_connection(self, monkeypatch):
+        monkeypatch.setenv("PIO_CHAOS", "reset:p=1.0")
+        server, base = _make_server(MetricRegistry())
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", server.port, timeout=5
+            )
+            conn.request("GET", "/echo")
+            with pytest.raises(
+                (http.client.BadStatusLine, ConnectionError, OSError)
+            ):
+                conn.getresponse()
+            conn.close()
+        finally:
+            server.shutdown()
+
+
+# --------------------------------------------------------------------------
+# client SDK retries, breaker, request-ID on errors
+# --------------------------------------------------------------------------
+
+
+class _FlakyServer:
+    """Route GET /flaky: N failures (500) then success; POST /boom:
+    always 500; GET /teapot: 404 with a body."""
+
+    def __init__(self, fail_first: int = 2):
+        self.calls = {"flaky": 0, "boom": 0}
+        router = Router()
+
+        def _flaky(request):
+            self.calls["flaky"] += 1
+            if self.calls["flaky"] <= fail_first:
+                return Response(500, {"message": "transient"})
+            return Response(200, {"ok": True})
+
+        def _boom(request):
+            self.calls["boom"] += 1
+            return Response(500, {"message": "kaput"})
+
+        def _teapot(request):
+            return Response(404, {"message": "no such pot"})
+
+        router.route("GET", "/flaky", _flaky)
+        router.route("POST", "/boom", _boom)
+        router.route("GET", "/teapot", _teapot)
+        self.http = HTTPServer(router, host="127.0.0.1", port=0)
+        self.http.start()
+        self.base = f"http://127.0.0.1:{self.http.port}"
+
+    def shutdown(self):
+        self.http.shutdown()
+
+
+class TestClientResilience:
+    def test_idempotent_get_retries_5xx_to_success(self, monkeypatch):
+        from predictionio_tpu.client import _request
+
+        monkeypatch.setenv("PIO_RETRY_BASE_MS", "5")
+        srv = _FlakyServer(fail_first=2)
+        try:
+            assert _request(f"{srv.base}/flaky") == {"ok": True}
+            assert srv.calls["flaky"] == 3
+        finally:
+            srv.shutdown()
+
+    def test_post_is_never_retried(self, monkeypatch):
+        from predictionio_tpu.client import PIOClientError, _request
+
+        monkeypatch.setenv("PIO_RETRY_BASE_MS", "5")
+        srv = _FlakyServer()
+        try:
+            with pytest.raises(PIOClientError) as e:
+                _request(f"{srv.base}/boom", "POST", {"x": 1})
+            assert e.value.status == 500
+            assert srv.calls["boom"] == 1
+        finally:
+            srv.shutdown()
+
+    def test_retry_budget_exhaustion_surfaces_last_error(
+        self, monkeypatch
+    ):
+        from predictionio_tpu.client import PIOClientError, _request
+
+        monkeypatch.setenv("PIO_RETRY_BASE_MS", "5")
+        monkeypatch.setenv("PIO_RETRY_MAX_ATTEMPTS", "3")
+        srv = _FlakyServer(fail_first=99)
+        try:
+            with pytest.raises(PIOClientError) as e:
+                _request(f"{srv.base}/flaky")
+            assert e.value.status == 500
+            assert srv.calls["flaky"] == 3  # max_attempts, then give up
+        finally:
+            srv.shutdown()
+
+    def test_deadline_budget_stops_retries_early(self, monkeypatch):
+        from predictionio_tpu.client import PIOClientError, _request
+
+        # backoff (200 ms) cannot fit the 100 ms budget → one attempt
+        monkeypatch.setenv("PIO_RETRY_BASE_MS", "200")
+        monkeypatch.setenv("PIO_RETRY_JITTER", "0")
+        srv = _FlakyServer(fail_first=99)
+        try:
+            with pytest.raises(PIOClientError):
+                _request(f"{srv.base}/flaky", timeout=0.1)
+            assert srv.calls["flaky"] == 1
+        finally:
+            srv.shutdown()
+
+    def test_504_is_not_a_breaker_failure(self, monkeypatch):
+        """A 504 refusing the caller's expired budget is the server
+        ANSWERING — five slow clients must not open the breaker for a
+        healthy target."""
+        from predictionio_tpu.client import PIOClientError, _request
+
+        monkeypatch.setenv("PIO_RETRY_MAX_ATTEMPTS", "1")
+        router = Router()
+        router.route(
+            "GET", "/x",
+            lambda r: Response(504, {"message": "deadline expired"}),
+        )
+        server = HTTPServer(router, host="127.0.0.1", port=0)
+        server.start()
+        target = f"127.0.0.1:{server.port}"
+        resilience.get_breaker(
+            target, config=BreakerConfig(failure_threshold=2)
+        )
+        try:
+            for _ in range(5):
+                with pytest.raises(PIOClientError) as e:
+                    _request(f"http://{target}/x")
+                assert e.value.status == 504
+            assert (
+                resilience.get_breaker(target).state == resilience.CLOSED
+            )
+        finally:
+            server.shutdown()
+
+    def test_http_error_carries_request_id(self):
+        from predictionio_tpu.client import PIOClientError, _request
+
+        srv = _FlakyServer()
+        try:
+            with pytest.raises(PIOClientError) as e:
+                _request(f"{srv.base}/teapot")
+            assert e.value.status == 404
+            assert e.value.request_id  # echoed X-Request-ID attached
+        finally:
+            srv.shutdown()
+
+    def test_breaker_opens_after_consecutive_transport_failures(
+        self, monkeypatch
+    ):
+        from predictionio_tpu.client import _request
+
+        monkeypatch.setenv("PIO_RETRY_MAX_ATTEMPTS", "1")
+        with socket.socket() as s:  # a port nothing listens on
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        resilience.get_breaker(
+            f"127.0.0.1:{port}",
+            config=BreakerConfig(failure_threshold=2, reset_after_s=60),
+        )
+        for _ in range(2):
+            with pytest.raises(OSError):
+                _request(f"http://127.0.0.1:{port}/x", timeout=0.5)
+        with pytest.raises(CircuitOpenError):
+            _request(f"http://127.0.0.1:{port}/x", timeout=0.5)
+
+    def test_blackholed_host_timeouts_trip_the_breaker(self, monkeypatch):
+        """A host that accepts but never answers is the classic
+        down-host mode: its timeouts must count as failures (the
+        self-minted budget expiring is the TARGET failing to answer in
+        time, not 'our clock ran out')."""
+        from predictionio_tpu.client import _request
+
+        monkeypatch.setenv("PIO_RETRY_MAX_ATTEMPTS", "1")
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(4)
+        port = srv.getsockname()[1]
+        threading.Thread(
+            target=lambda: [srv.accept() for _ in range(4)],
+            daemon=True,
+        ).start()
+        resilience.get_breaker(
+            f"127.0.0.1:{port}",
+            config=BreakerConfig(failure_threshold=2, reset_after_s=60),
+        )
+        try:
+            for _ in range(2):
+                with pytest.raises(OSError):
+                    _request(f"http://127.0.0.1:{port}/x", timeout=0.3)
+            with pytest.raises(CircuitOpenError):
+                _request(f"http://127.0.0.1:{port}/x", timeout=0.3)
+        finally:
+            srv.close()
+
+    def test_deadline_header_reaches_the_server(self):
+        from predictionio_tpu.client import _request
+
+        server, base = _make_server()
+        try:
+            out = _request(f"{base}/echo", timeout=3.0)
+            assert out["remainingMs"] is not None
+            assert out["remainingMs"] <= 3000
+        finally:
+            server.shutdown()
+
+
+# --------------------------------------------------------------------------
+# httpstore retries, breaker, stale keep-alive replay
+# --------------------------------------------------------------------------
+
+
+def _raw_server(script):
+    """A socket-level fake store server; ``script`` is a list of
+    callables(conn, request_bytes) handling one request each per
+    connection acceptance loop."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    seen = []
+
+    def _serve():
+        for handle in script:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            conn.settimeout(5)
+            try:
+                handle(conn, seen)
+            finally:
+                conn.close()
+
+    threading.Thread(target=_serve, daemon=True).start()
+    return srv, srv.getsockname()[1], seen
+
+
+def _ok(payload=b"[]"):
+    return (
+        b"HTTP/1.1 200 OK\r\nContent-Length: "
+        + str(len(payload)).encode()
+        + b"\r\nContent-Type: application/json\r\n\r\n"
+        + payload
+    )
+
+
+class TestHTTPStoreResilience:
+    def _client(self, port, **extra):
+        from predictionio_tpu.data.storage.httpstore import HTTPStoreClient
+
+        return HTTPStoreClient(
+            {"URL": f"http://127.0.0.1:{port}", "TIMEOUT": 5, **extra}
+        )
+
+    def test_stale_keepalive_garbage_replayed_for_idempotent(
+        self, monkeypatch
+    ):
+        """BadStatusLine on a reused socket (restarted server wrote
+        garbage / proxy hiccup): the GET is replayed once on a fresh
+        connection instead of failing the caller."""
+        monkeypatch.setenv("PIO_RETRY_MAX_ATTEMPTS", "1")
+
+        def first(conn, seen):
+            seen.append(conn.recv(65536))
+            conn.sendall(_ok())  # request 1 fine, keep-alive
+            seen.append(conn.recv(65536))  # request 2 arrives...
+            conn.sendall(b"garbage\r\n\r\n")  # ...answered with junk
+
+        def second(conn, seen):
+            seen.append(conn.recv(65536))
+            conn.sendall(_ok(b'{"replayed": true}'))
+
+        srv, port, seen = _raw_server([first, second])
+        try:
+            client = self._client(port)
+            assert client.json("GET", "/meta/apps") == []
+            assert client.json("GET", "/meta/apps") == {"replayed": True}
+            assert len(seen) == 3
+        finally:
+            srv.close()
+
+    def test_5xx_retried_with_backoff_for_idempotent(self, monkeypatch):
+        monkeypatch.setenv("PIO_RETRY_BASE_MS", "5")
+        monkeypatch.setenv("PIO_RETRY_MAX_ATTEMPTS", "3")
+
+        def failing(conn, seen):
+            seen.append(conn.recv(65536))
+            body = b'{"message": "boom"}'
+            conn.sendall(
+                b"HTTP/1.1 503 Service Unavailable\r\nContent-Length: "
+                + str(len(body)).encode() + b"\r\n\r\n" + body
+            )
+
+        def healthy(conn, seen):
+            seen.append(conn.recv(65536))
+            conn.sendall(_ok())
+
+        srv, port, seen = _raw_server([failing, healthy])
+        try:
+            client = self._client(port)
+            assert client.json("GET", "/meta/apps") == []
+            assert len(seen) == 2
+        finally:
+            srv.close()
+
+    def test_5xx_not_retried_for_post(self, monkeypatch):
+        from predictionio_tpu.data.storage import StorageError
+
+        monkeypatch.setenv("PIO_RETRY_BASE_MS", "5")
+
+        def failing(conn, seen):
+            seen.append(conn.recv(65536))
+            conn.sendall(
+                b"HTTP/1.1 500 Oops\r\nContent-Length: 0\r\n\r\n"
+            )
+
+        srv, port, seen = _raw_server([failing, failing])
+        try:
+            client = self._client(port)
+            with pytest.raises(StorageError, match="HTTP 500"):
+                client.json("POST", "/meta/apps", json_body={"x": 1})
+            assert len(seen) == 1
+        finally:
+            srv.close()
+
+    def test_expired_deadline_refuses_the_hop(self):
+        client = self._client(1)  # never reached
+        resilience.set_deadline(Deadline.after(-1.0))
+        try:
+            with pytest.raises(DeadlineExceeded):
+                client.request("GET", "/meta/apps")
+        finally:
+            resilience.set_deadline(None)
+
+    def test_deadline_header_forwarded_on_the_hop(self):
+        def handler(conn, seen):
+            seen.append(conn.recv(65536))
+            conn.sendall(_ok())
+
+        srv, port, seen = _raw_server([handler])
+        try:
+            client = self._client(port)
+            resilience.set_deadline(Deadline.after(2.0))
+            client.json("GET", "/meta/apps")
+            assert b"X-PIO-Deadline:" in seen[0]
+        finally:
+            resilience.set_deadline(None)
+            srv.close()
+
+    def test_open_breaker_fast_fails_as_storage_error(self, monkeypatch):
+        from predictionio_tpu.data.storage import StorageError
+        from predictionio_tpu.data.storage.httpstore import (
+            StoreCircuitOpen,
+        )
+
+        # one attempt per call, so the first call surfaces the
+        # transport error (tripping the breaker) and the second hits
+        # the open breaker
+        monkeypatch.setenv("PIO_RETRY_MAX_ATTEMPTS", "1")
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        resilience.get_breaker(
+            f"127.0.0.1:{port}",
+            config=BreakerConfig(failure_threshold=1, reset_after_s=60),
+        )
+        client = self._client(port)
+        with pytest.raises(StorageError, match="unreachable"):
+            client.request("GET", "/meta/apps")
+        with pytest.raises(StoreCircuitOpen) as e:
+            client.request("GET", "/meta/apps")
+        # doubly typed: DAO callers see StorageError, the HTTP layer
+        # maps CircuitOpenError to a retryable 503
+        assert isinstance(e.value, StorageError)
+        assert isinstance(e.value, CircuitOpenError)
