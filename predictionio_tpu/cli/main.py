@@ -512,6 +512,13 @@ def cmd_deploy(args) -> int:
             file=sys.stderr,
         )
         return 1
+    if args.pipeline_depth < 0:
+        print(
+            f"error: --pipeline-depth must be >= 0, "
+            f"got {args.pipeline_depth}",
+            file=sys.stderr,
+        )
+        return 1
 
     engine, params, engine_id, variant, variant_dict = _resolve(args)
     feedback_app_id = None
@@ -538,6 +545,8 @@ def cmd_deploy(args) -> int:
         log_prefix=args.log_prefix,
         max_batch=args.max_batch,
         max_wait_ms=args.max_wait_ms,
+        pipeline_depth=args.pipeline_depth,
+        adaptive_wait=not args.no_adaptive_wait,
     )
     multi = args.workers > 1
     if multi and (err := _reuseport_unsupported()):
@@ -1191,6 +1200,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--max-wait-ms", dest="max_wait_ms", type=float, default=2.0,
         help="micro-batcher fill window in milliseconds",
+    )
+    p.add_argument(
+        "--pipeline-depth", dest="pipeline_depth", type=int, default=2,
+        help="batches in flight between device enqueue and collected "
+             "results (2 = double buffering; 0 = serial dispatch)",
+    )
+    p.add_argument(
+        "--no-adaptive-wait", dest="no_adaptive_wait",
+        action="store_true",
+        help="disable the self-tuning fill window (full batches shrink "
+             "the next wait toward 0; idle traffic restores it)",
     )
     p.add_argument(
         "--workers", type=int, default=1,

@@ -186,6 +186,29 @@ class Algorithm(_Controller, Generic[PD, M, Q, P], abc.ABC):
         Default loops; algorithms override with a vmapped/jitted path."""
         return [self.predict(model, q) for q in queries]
 
+    # -- two-phase serving hooks (pipelined micro-batching) --------------
+    def batch_predict_launch(self, model: M, queries: Sequence[Q]) -> Any:
+        """Enqueue the device work for ``queries`` and return an opaque
+        handle WITHOUT blocking on the device (JAX async dispatch: run
+        the jitted program, return the un-fetched device arrays plus
+        whatever host metadata the decode needs). Pairs with
+        :meth:`batch_predict_collect`; the serving micro-batcher uses
+        the pair to overlap batch N+1's enqueue with batch N's barrier
+        (docs/serving.md "Pipelined dispatch"). Algorithms that don't
+        override this serve single-phase through ``batch_predict``."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement two-phase predict"
+        )
+
+    def batch_predict_collect(
+        self, model: M, handle: Any, queries: Sequence[Q]
+    ) -> list[P]:
+        """Pay the device barrier for a :meth:`batch_predict_launch`
+        handle and materialize one result per query, in order."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement two-phase predict"
+        )
+
     def stage_model(self, ctx: ComputeContext, model: M) -> M:
         """Deploy-time hook: place model state onto the device(s) ONCE so
         serving never re-uploads it per request (the reference keeps the

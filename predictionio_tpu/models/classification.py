@@ -197,10 +197,26 @@ class NaiveBayesAlgorithm(
     def batch_predict(self, model: NaiveBayesModel, queries) -> list[dict]:
         if not queries:
             return []
+        return self.batch_predict_collect(
+            model, self.batch_predict_launch(model, queries), queries
+        )
+
+    def batch_predict_launch(self, model: NaiveBayesModel, queries):
+        """Two-phase serving: upload features + enqueue the jitted
+        scorer, return the un-fetched class indices."""
+        if not queries:
+            return None
         x = jnp.asarray(
             [q["features"] for q in queries], dtype=model.nb.theta.dtype
         )
-        best = np.asarray(nb.predict_classes(model.nb, x))
+        return nb.predict_classes(model.nb, x)
+
+    def batch_predict_collect(
+        self, model: NaiveBayesModel, handle, queries
+    ) -> list[dict]:
+        if handle is None:
+            return []
+        best = np.asarray(handle)  # the device barrier
         return [
             {"label": model.label_map.inverse(int(b))} for b in best
         ]

@@ -224,6 +224,16 @@ class ALSAlgorithm(Algorithm[RecTrainingData, ALSRecModel, dict, dict]):
     def batch_predict(self, model: ALSRecModel, queries) -> list[dict]:
         if not queries:
             return []
+        return self.batch_predict_collect(
+            model, self.batch_predict_launch(model, queries), queries
+        )
+
+    def batch_predict_launch(self, model: ALSRecModel, queries):
+        """Host prep + device enqueue, no barrier: the returned handle
+        holds un-fetched device arrays, so the serving pipeline can
+        enqueue the next batch while this one computes."""
+        if not queries:
+            return None
         num = max(int(q.get("num", 10)) for q in queries)
         num = min(num, len(model.item_factors))
         # bucket the jit-static shapes (top-k size and batch rows) to
@@ -246,6 +256,16 @@ class ALSAlgorithm(Algorithm[RecTrainingData, ALSRecModel, dict, dict]):
         scores, items = similarity.gather_top_k_dot(
             model.user_factors, idx, model.item_factors, num_bucket
         )
+        return scores, items, user_idx, num
+
+    def batch_predict_collect(
+        self, model: ALSRecModel, handle, queries
+    ) -> list[dict]:
+        """Device barrier + per-query JSON materialization for a
+        :meth:`batch_predict_launch` handle."""
+        if handle is None:
+            return []
+        scores, items, user_idx, num = handle
         # one parallel device_get: through remote-TPU transports each
         # separate fetch pays a full round trip (~70 ms on the tunnel)
         scores, items = jax.device_get((scores, items))

@@ -216,6 +216,15 @@ class TextNBAlgorithm(Algorithm[TextPrepared, TextNBModel, dict, dict]):
     def batch_predict(self, model: TextNBModel, queries) -> list[dict]:
         if not queries:
             return []
+        return self.batch_predict_collect(
+            model, self.batch_predict_launch(model, queries), queries
+        )
+
+    def batch_predict_launch(self, model: TextNBModel, queries):
+        """Two-phase serving: featurize on host, enqueue the jitted
+        scorer, return the un-fetched log-probabilities."""
+        if not queries:
+            return None
         x = np.stack([
             hash_counts(
                 tokenize(str(q.get("text", ""))), model.n_features
@@ -228,9 +237,14 @@ class TextNBAlgorithm(Algorithm[TextPrepared, TextNBModel, dict, dict]):
         # compiles mid-traffic (recommendation.py does the same)
         bucket = 1 << (len(queries) - 1).bit_length()
         x = np.pad(x, ((0, bucket - len(queries)), (0, 0)))
-        logp = np.asarray(nb.log_scores(model.nb_model, x))[
-            : len(queries)
-        ]
+        return nb.log_scores(model.nb_model, x)
+
+    def batch_predict_collect(
+        self, model: TextNBModel, handle, queries
+    ) -> list[dict]:
+        if handle is None:
+            return []
+        logp = np.asarray(handle)[: len(queries)]  # the device barrier
         best = logp.argmax(axis=1)
         out = []
         for row, b in zip(logp, best):

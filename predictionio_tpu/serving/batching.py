@@ -7,12 +7,38 @@ requests are coalesced into one fixed-shape batch dispatched to a
 pre-compiled jitted program — XLA dispatch overhead amortizes across
 the batch, which is what makes the ≥1k QPS target reachable.
 
+Pipelined dispatch: the batcher is a two-stage pipeline (the Sebulba
+move from the Podracer line of work — never let the accelerator wait
+on host bookkeeping). A **collector** thread assembles batches
+(max_batch/max_wait coalescing, cancellation, deadline drops) and
+*enqueues* them to the device; a **completer** thread syncs the device
+barrier and materializes results. ``pipeline_depth`` bounds how many
+batches may be in flight past their enqueue (default 2 = double
+buffering): batch N+1 is assembled and enqueued while batch N is still
+computing, so the device never idles on host-side assembly/JSON work
+and the host never idles on device compute.
+
+``batch_fn`` comes in two shapes:
+
+* a plain callable ``(items) -> results`` — the single-phase form.
+  It runs exactly once per batch, in the completer stage, with no
+  extra device barriers added around it; assembly of the next batch
+  still overlaps its compute.
+* a two-phase object with ``dispatch(items) -> handle`` (enqueue
+  device work, return immediately — lean on JAX async dispatch) and
+  ``collect(handle) -> results`` (device barrier + host decode) —
+  see :class:`TwoPhaseBatchFn`. This is the form that overlaps the
+  *enqueue* of batch N+1 with the *barrier* of batch N.
+
 Telemetry: when built with a :class:`~predictionio_tpu.obs.MetricRegistry`
-the batcher records batch occupancy, queue depth, device-dispatch time,
-dispatched/shed/cancelled counts — the queue instrumentation the
-Podracer line of work treats as a prerequisite for scaling. Each slot
-carries the submitting request's ID (from the obs contextvar), so a
-slow or failing dispatch logs exactly which requests rode in it.
+the batcher records batch occupancy, queue depth, device-dispatch time
+(now split into ``pio_device_enqueue_seconds`` and
+``pio_device_sync_seconds`` around the end-to-end
+``pio_device_dispatch_seconds``), dispatched/shed/cancelled counts —
+the queue instrumentation the Podracer line of work treats as a
+prerequisite for scaling. Each slot carries the submitting request's
+ID (from the obs contextvar), so a slow or failing dispatch logs
+exactly which requests rode in it.
 """
 
 from __future__ import annotations
@@ -42,6 +68,30 @@ class BatcherOverloaded(Exception):
     """
 
 
+class TwoPhaseBatchFn:
+    """The pipelined ``batch_fn`` protocol: enqueue now, sync later.
+
+    ``dispatch(items) -> handle`` must enqueue the device work and
+    return without blocking on it (JAX async dispatch makes this the
+    natural shape: launch the jitted program, return the un-fetched
+    device arrays). ``collect(handle) -> results`` pays the device
+    barrier and materializes one result per item, in order.
+
+    The batcher duck-types on ``dispatch``/``collect`` attributes, so
+    any object with both works; this class is the explicit spelling.
+    """
+
+    __slots__ = ("dispatch", "collect")
+
+    def __init__(
+        self,
+        dispatch: Callable[[Sequence[Any]], Any],
+        collect: Callable[[Any], Sequence[Any]],
+    ):
+        self.dispatch = dispatch
+        self.collect = collect
+
+
 class _Slot(NamedTuple):
     """One queued submission: the payload, its Future, the submitting
     request's identity (ID + open span + submit time) for dispatch logs
@@ -54,6 +104,18 @@ class _Slot(NamedTuple):
     parent_span: Any  # tracing.Span | None
     submitted_mono: float
     deadline: Any  # resilience.Deadline | None
+
+
+class _Inflight(NamedTuple):
+    """One enqueued batch riding the collector→completer handoff."""
+
+    live: list  # [_Slot]
+    handle: Any
+    start_wall: float
+    start_mono: float
+    t0: float  # perf_counter at dispatch entry
+    enqueue_s: float
+    traced: bool
 
 
 class _NullMetrics:
@@ -70,6 +132,12 @@ class _NullMetrics:
     def dispatched(self, occupancy: int, seconds: float) -> None:
         pass
 
+    def enqueued(self, seconds: float) -> None:
+        pass
+
+    def synced(self, seconds: float) -> None:
+        pass
+
     def cancelled(self, n: int) -> None:
         pass
 
@@ -84,7 +152,8 @@ class _BatcherMetrics:
     """Bound registry children for one named batcher."""
 
     __slots__ = ("_depth", "_shed", "_occupancy", "_dispatch",
-                 "_batches", "_cancelled", "_expired", "_leaked")
+                 "_enqueue", "_sync", "_batches", "_cancelled",
+                 "_expired", "_leaked")
 
     def __init__(self, registry: MetricRegistry, name: str):
         self._depth = registry.gauge(
@@ -105,7 +174,23 @@ class _BatcherMetrics:
         ).labels(name)
         self._dispatch = registry.histogram(
             "pio_device_dispatch_seconds",
-            "Wall clock of one batch_fn dispatch (device-synced)",
+            "End-to-end wall clock of one batch: device enqueue "
+            "through collected results",
+            ("batcher",),
+            buckets=LATENCY_BUCKETS,
+        ).labels(name)
+        self._enqueue = registry.histogram(
+            "pio_device_enqueue_seconds",
+            "Host time enqueuing one batch to the device (two-phase "
+            "dispatch(); ~0 for single-phase batch_fns)",
+            ("batcher",),
+            buckets=LATENCY_BUCKETS,
+        ).labels(name)
+        self._sync = registry.histogram(
+            "pio_device_sync_seconds",
+            "Device barrier + host result materialization of one "
+            "batch (two-phase collect(), or the whole single-phase "
+            "batch_fn)",
             ("batcher",),
             buckets=LATENCY_BUCKETS,
         ).labels(name)
@@ -143,6 +228,12 @@ class _BatcherMetrics:
         self._occupancy.observe(occupancy)
         self._dispatch.observe(seconds)
 
+    def enqueued(self, seconds: float) -> None:
+        self._enqueue.observe(seconds)
+
+    def synced(self, seconds: float) -> None:
+        self._sync.observe(seconds)
+
     def cancelled(self, n: int) -> None:
         self._cancelled.inc(n)
 
@@ -156,11 +247,21 @@ class _BatcherMetrics:
 class MicroBatcher:
     """Coalesce submit()-ed items into batches for ``batch_fn``.
 
-    A batch is dispatched when ``max_batch`` items are waiting or
-    ``max_wait_ms`` elapsed since the first queued item — the classic
-    latency/throughput knob. ``max_queue`` bounds queued items: beyond
-    it, ``submit`` raises :class:`BatcherOverloaded` so overload turns
-    into fast shedding rather than client-side timeout hangs.
+    A batch is dispatched when ``max_batch`` items are waiting or the
+    coalescing wait elapsed since the first queued item — the classic
+    latency/throughput knob. With ``adaptive_wait`` (default on) the
+    wait self-tunes: each batch that fills to ``max_batch`` halves the
+    next window toward 0 (a hot queue refills instantly from backlog —
+    waiting only adds latency), and the first non-full batch restores
+    the full ``max_wait_ms`` (idle traffic keeps the whole window to
+    coalesce). ``max_queue`` bounds queued items: beyond it, ``submit``
+    raises :class:`BatcherOverloaded` so overload turns into fast
+    shedding rather than client-side timeout hangs.
+
+    ``pipeline_depth`` bounds batches in flight between device enqueue
+    and collected results (default 2 = double buffering; 0 = the
+    pre-pipeline serial behavior, everything inline on one thread —
+    the baseline ``scripts/serving_bench.py`` measures against).
 
     Returned futures support ``cancel()`` up to the moment their batch
     is dispatched: a cancelled slot is dropped from the batch (its
@@ -172,17 +273,32 @@ class MicroBatcher:
 
     def __init__(
         self,
-        batch_fn: Callable[[Sequence[Any]], Sequence[Any]],
+        batch_fn: Callable[[Sequence[Any]], Sequence[Any]] | TwoPhaseBatchFn,
         max_batch: int = 64,
         max_wait_ms: float = 2.0,
         max_queue: int | None = None,
         registry: MetricRegistry | None = None,
         name: str = "default",
         close_join_timeout_s: float = 30.0,
+        pipeline_depth: int = 2,
+        adaptive_wait: bool = True,
     ):
-        self._batch_fn = batch_fn
+        if hasattr(batch_fn, "dispatch") and hasattr(batch_fn, "collect"):
+            self._dispatch_fn = batch_fn.dispatch
+            self._collect_fn = batch_fn.collect
+        else:
+            # single-phase compatibility: the whole batch_fn runs as
+            # the collect stage (so next-batch assembly still overlaps
+            # its compute) and is called exactly once per batch — no
+            # wrapper barriers
+            self._dispatch_fn = None
+            self._collect_fn = batch_fn
         self._max_batch = max_batch
         self._max_wait = max_wait_ms / 1000.0
+        self._adaptive = adaptive_wait
+        #: the live coalescing window (introspectable; updated by the
+        #: collector after every batch when adaptive_wait is on)
+        self._current_wait = self._max_wait
         self._close_join_timeout_s = close_join_timeout_s
         self._max_queue = (
             max_queue if max_queue is not None else 8 * max_batch
@@ -196,6 +312,15 @@ class MicroBatcher:
         self._queue: queue.Queue = queue.Queue()
         self._closed = threading.Event()
         self._submit_lock = threading.Lock()
+        self._pipeline_depth = max(0, pipeline_depth)
+        self._completer: threading.Thread | None = None
+        if self._pipeline_depth > 0:
+            self._pending: queue.Queue = queue.Queue()
+            self._inflight = threading.Semaphore(self._pipeline_depth)
+            self._completer = threading.Thread(
+                target=self._complete_loop, daemon=True
+            )
+            self._completer.start()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -243,20 +368,47 @@ class MicroBatcher:
             return future
 
     def __call__(self, item: Any, timeout: float | None = 30.0) -> Any:
+        # the waiter must never outlive the budget it was admitted
+        # under: a request deadline in context caps the result wait, so
+        # an expired budget surfaces as a timeout now, not 30 s later
+        deadline = resilience.get_deadline()
+        if deadline is not None:
+            timeout = deadline.cap(
+                timeout
+                if timeout is not None
+                else resilience.Deadline.MAX_BUDGET_S
+            )
         return self.submit(item).result(timeout=timeout)
 
     def close(self) -> None:
-        """Graceful: already-submitted items are still processed. A
-        worker stuck in a hung dispatch past the join timeout is
-        reported (structured warning + ``pio_batcher_leaked_threads_total``)
+        """Graceful, in pipeline order: the collector sentinel drains
+        queued items through dispatch, in-flight dispatches complete,
+        their futures resolve, then both threads exit. A worker stuck
+        in a hung dispatch past the join timeout is reported
+        (structured warning + ``pio_batcher_leaked_threads_total``)
         instead of silently leaked."""
         with self._submit_lock:
             if self._closed.is_set():
                 return
             self._closed.set()
-            self._queue.put(None)  # wake the worker
+            self._queue.put(None)  # wake the collector
+        join_deadline = time.monotonic() + self._close_join_timeout_s
         self._thread.join(timeout=self._close_join_timeout_s)
-        if self._thread.is_alive():
+        leaked = self._thread.is_alive()
+        if self._completer is not None:
+            # the completer sentinel is sent by the collector alone
+            # (end of _drain_and_exit). If the collector is hung we do
+            # NOT inject one here: it could overtake a batch the stuck
+            # collector is still about to hand off, and an exited
+            # completer would strand that batch's futures forever. Both
+            # threads are daemons — if the collector ever unblocks it
+            # drains, sends the real sentinel, and the futures resolve
+            # late instead of never.
+            self._completer.join(
+                timeout=max(0.1, join_deadline - time.monotonic())
+            )
+            leaked = leaked or self._completer.is_alive()
+        if leaked:
             self._metrics.leaked()
             log_json(
                 logger, logging.WARNING, "batcher_thread_leaked",
@@ -264,7 +416,7 @@ class MicroBatcher:
                 joinTimeoutS=self._close_join_timeout_s,
             )
 
-    # -- worker -----------------------------------------------------------
+    # -- collector stage ---------------------------------------------------
     def _drain_and_exit(self, batch) -> None:
         """Sentinel seen: serve everything already queued, then stop."""
         while True:
@@ -275,7 +427,9 @@ class MicroBatcher:
             if nxt is not None:
                 batch.append(nxt)
         if batch:
-            self._flush(batch)
+            self._dispatch_batch(batch)
+        if self._completer is not None:
+            self._pending.put(None)  # completer drains in order, then exits
 
     def _loop(self) -> None:
         while True:
@@ -284,33 +438,60 @@ class MicroBatcher:
                 self._drain_and_exit([])
                 return
             batch = [first]
-            deadline = time.monotonic() + self._max_wait
+            wait = self._current_wait
+            deadline = time.monotonic() + wait
             while len(batch) < self._max_batch:
                 remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
                 try:
-                    nxt = self._queue.get(timeout=remaining)
+                    # a spent window still drains backlog without
+                    # blocking — a hot (adaptively shrunk) wait must
+                    # not cap occupancy at 1
+                    nxt = (
+                        self._queue.get(timeout=remaining)
+                        if remaining > 0
+                        else self._queue.get_nowait()
+                    )
                 except queue.Empty:
                     break
                 if nxt is None:
                     self._drain_and_exit(batch)
                     return
                 batch.append(nxt)
-            self._flush(batch)
+            full = len(batch) >= self._max_batch
+            self._dispatch_batch(batch)
+            if self._adaptive:
+                # hot: a full batch means backlog is doing the
+                # coalescing — halve the window toward 0 so queue wait
+                # stops taxing p50. The first non-full batch restores
+                # the whole window for idle-traffic coalescing.
+                if full:
+                    wait *= 0.5
+                    if wait < self._max_wait / 64:
+                        wait = 0.0
+                    self._current_wait = wait
+                else:
+                    self._current_wait = self._max_wait
 
-    def _flush(self, batch) -> None:
+    def _dispatch_batch(self, batch) -> None:
         # a closed batcher is a draining OLD generation — after /reload
         # its replacement shares the same gauge child (same name), and
         # a final set() here would overwrite the live queue depth
         if not self._closed.is_set():
             self._metrics.queue_depth(self._queue.qsize())
+        # backpressure BEFORE the cancellation/deadline cutoff: while
+        # the collector waits for a pipeline slot (device slow, depth
+        # exhausted) waiters can still cancel and budgets can still
+        # expire — the cutoff below must be the last word before the
+        # device sees the work
+        if self._completer is not None:
+            self._inflight.acquire()
         # transition every slot to running; cancelled slots drop out
         # HERE, before the device sees them — cancellation is how an
         # abandoning caller turns wasted dispatch into avoided dispatch.
-        # Expired-deadline slots drop out the same way: their waiter is
-        # already gone (or about to time out), so dispatching them
-        # would burn device time computing unreceivable answers.
+        # Expired-deadline slots drop out the same way (the deadline
+        # re-check at dispatch entry): their waiter is already gone (or
+        # about to time out), so dispatching them would burn device
+        # time computing unreceivable answers.
         live = []
         expired = 0
         for slot in batch:
@@ -334,57 +515,165 @@ class MicroBatcher:
                 batcher=self.name, expired=expired,
             )
         if not live:
+            if self._completer is not None:
+                self._inflight.release()
             return
-        items = [slot.item for slot in live]
         # dispatch-span bookkeeping only when at least one slot was
         # submitted under an open trace — untraced traffic pays nothing
         traced = any(slot.parent_span is not None for slot in live)
         start_wall = tracing.now() if traced else 0.0
         start_mono = time.monotonic() if traced else 0.0
+        if self._completer is None:
+            self._flush_serial(live, start_wall, start_mono, traced)
+            return
+        items = [slot.item for slot in live]
         t0 = time.perf_counter()
+        if self._dispatch_fn is None:
+            # single-phase: the handle is the items; batch_fn runs once
+            # in the completer
+            handle, enqueue_s = items, 0.0
+        else:
+            try:
+                handle = self._dispatch_fn(items)
+            except Exception as e:  # noqa: BLE001 - propagate to waiters
+                self._inflight.release()
+                enqueue_s = time.perf_counter() - t0
+                self._metrics.enqueued(enqueue_s)
+                self._settle_failure(
+                    live, e, time.perf_counter() - t0,
+                    start_wall, start_mono, traced,
+                    enqueue_s=enqueue_s, sync_s=0.0, phase="dispatch",
+                )
+                return
+            enqueue_s = time.perf_counter() - t0
+            self._metrics.enqueued(enqueue_s)
+        self._pending.put(
+            _Inflight(
+                live, handle, start_wall, start_mono, t0, enqueue_s,
+                traced,
+            )
+        )
+
+    # -- completer stage ---------------------------------------------------
+    def _complete_loop(self) -> None:
+        while True:
+            rec = self._pending.get()
+            if rec is None:
+                return
+            try:
+                t1 = time.perf_counter()
+                try:
+                    results = self._collect_fn(rec.handle)
+                    sync_s = time.perf_counter() - t1
+                    self._metrics.synced(sync_s)
+                    if len(results) != len(rec.live):
+                        raise RuntimeError(
+                            f"batch_fn returned {len(results)} results "
+                            f"for {len(rec.live)} items"
+                        )
+                except Exception as e:  # noqa: BLE001 - to every waiter
+                    self._settle_failure(
+                        rec.live, e, time.perf_counter() - rec.t0,
+                        rec.start_wall, rec.start_mono, rec.traced,
+                        enqueue_s=rec.enqueue_s,
+                        sync_s=time.perf_counter() - t1,
+                        phase="collect",
+                    )
+                    continue
+                self._settle_success(
+                    rec.live, results, time.perf_counter() - rec.t0,
+                    rec.start_wall, rec.start_mono, rec.traced,
+                    enqueue_s=rec.enqueue_s, sync_s=sync_s,
+                )
+            finally:
+                self._inflight.release()
+
+    # -- serial fallback (pipeline_depth=0) --------------------------------
+    def _flush_serial(
+        self, live, start_wall: float, start_mono: float, traced: bool
+    ) -> None:
+        """The pre-pipeline inline path: enqueue + sync back to back on
+        the collector thread. Kept for apples-to-apples benchmarking
+        and as an escape hatch (``pipeline_depth=0``)."""
+        items = [slot.item for slot in live]
+        t0 = time.perf_counter()
+        enqueue_s = 0.0
         try:
-            results = self._batch_fn(items)
+            if self._dispatch_fn is None:
+                handle = items
+            else:
+                handle = self._dispatch_fn(items)
+                enqueue_s = time.perf_counter() - t0
+                self._metrics.enqueued(enqueue_s)
+            t1 = time.perf_counter()
+            results = self._collect_fn(handle)
+            sync_s = time.perf_counter() - t1
+            self._metrics.synced(sync_s)
             if len(results) != len(items):
                 raise RuntimeError(
                     f"batch_fn returned {len(results)} results for "
                     f"{len(items)} items"
                 )
-            elapsed = time.perf_counter() - t0
-            self._metrics.dispatched(len(items), elapsed)
-            if traced:
-                self._record_dispatch_spans(
-                    live, start_wall, start_mono, elapsed
-                )
-            log_json(
-                logger, logging.DEBUG, "batch_dispatch",
-                batcher=self.name, occupancy=len(items),
-                ms=round(elapsed * 1000, 3),
-                requestIds=[s.request_id for s in live if s.request_id],
-            )
-            for slot, result in zip(live, results):
-                slot.future.set_result(result)
         except Exception as e:  # noqa: BLE001 - propagate to every waiter
-            elapsed = time.perf_counter() - t0
-            self._metrics.dispatched(len(items), elapsed)
-            if traced:
-                self._record_dispatch_spans(
-                    live, start_wall, start_mono, elapsed,
-                    error=f"{type(e).__name__}: {e}",
-                )
-            log_json(
-                logger, logging.WARNING, "batch_dispatch_failed",
-                batcher=self.name, occupancy=len(items),
-                ms=round(elapsed * 1000, 3),
-                error=f"{type(e).__name__}: {e}",
-                requestIds=[s.request_id for s in live if s.request_id],
+            self._settle_failure(
+                live, e, time.perf_counter() - t0, start_wall,
+                start_mono, traced, enqueue_s=enqueue_s, sync_s=0.0,
+                phase="serial",
             )
-            for slot in live:
-                if not slot.future.done():
-                    slot.future.set_exception(e)
+            return
+        self._settle_success(
+            live, results, time.perf_counter() - t0, start_wall,
+            start_mono, traced, enqueue_s=enqueue_s, sync_s=sync_s,
+        )
+
+    # -- shared settlement -------------------------------------------------
+    def _settle_success(
+        self, live, results, elapsed: float, start_wall: float,
+        start_mono: float, traced: bool, enqueue_s: float, sync_s: float,
+    ) -> None:
+        self._metrics.dispatched(len(live), elapsed)
+        if traced:
+            self._record_dispatch_spans(
+                live, start_wall, start_mono, elapsed,
+                enqueue_s=enqueue_s, sync_s=sync_s,
+            )
+        log_json(
+            logger, logging.DEBUG, "batch_dispatch",
+            batcher=self.name, occupancy=len(live),
+            ms=round(elapsed * 1000, 3),
+            enqueueMs=round(enqueue_s * 1000, 3),
+            requestIds=[s.request_id for s in live if s.request_id],
+        )
+        for slot, result in zip(live, results):
+            slot.future.set_result(result)
+
+    def _settle_failure(
+        self, live, exc: Exception, elapsed: float, start_wall: float,
+        start_mono: float, traced: bool, enqueue_s: float, sync_s: float,
+        phase: str,
+    ) -> None:
+        self._metrics.dispatched(len(live), elapsed)
+        if traced:
+            self._record_dispatch_spans(
+                live, start_wall, start_mono, elapsed,
+                enqueue_s=enqueue_s, sync_s=sync_s,
+                error=f"{type(exc).__name__}: {exc}",
+            )
+        log_json(
+            logger, logging.WARNING, "batch_dispatch_failed",
+            batcher=self.name, occupancy=len(live), phase=phase,
+            ms=round(elapsed * 1000, 3),
+            error=f"{type(exc).__name__}: {exc}",
+            requestIds=[s.request_id for s in live if s.request_id],
+        )
+        for slot in live:
+            if not slot.future.done():
+                slot.future.set_exception(exc)
 
     def _record_dispatch_spans(
         self, live, start_wall: float, start_mono: float,
-        elapsed: float, error: str | None = None,
+        elapsed: float, enqueue_s: float = 0.0, sync_s: float = 0.0,
+        error: str | None = None,
     ) -> None:
         """One device dispatch, seen from every trace that rode in it.
 
@@ -418,6 +707,8 @@ class MicroBatcher:
                         max(0.0, start_mono - submitted_mono) * 1000, 3
                     ),
                     "deviceDispatchMs": round(elapsed * 1000, 3),
+                    "hostEnqueueMs": round(enqueue_s * 1000, 3),
+                    "deviceMs": round(sync_s * 1000, 3),
                     "links": links,
                 },
             )

@@ -46,6 +46,7 @@ import urllib.request
 from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Any
 
+from predictionio_tpu.core.controller import Algorithm
 from predictionio_tpu.core.engine import Engine, EngineParams
 from predictionio_tpu.core.workflow import load_deployment
 from predictionio_tpu.data.datamap import DataMap
@@ -55,7 +56,11 @@ from predictionio_tpu.obs import MetricRegistry, get_registry
 from predictionio_tpu.obs import tracing
 from predictionio_tpu.parallel.mesh import ComputeContext
 from predictionio_tpu.serving import resilience
-from predictionio_tpu.serving.batching import BatcherOverloaded, MicroBatcher
+from predictionio_tpu.serving.batching import (
+    BatcherOverloaded,
+    MicroBatcher,
+    TwoPhaseBatchFn,
+)
 from predictionio_tpu.serving.plugins import (
     OUTPUT_SNIFFER,
     PluginContext,
@@ -89,6 +94,8 @@ class EngineServer:
         max_batch: int = 64,
         max_wait_ms: float = 2.0,
         max_queue: int | None = None,
+        pipeline_depth: int = 2,
+        adaptive_wait: bool = True,
         predict_timeout_s: float = 30.0,
         plugins: PluginContext | None = None,
         server_config=None,
@@ -112,6 +119,8 @@ class EngineServer:
         self._max_batch = max_batch
         self._max_wait_ms = max_wait_ms
         self._max_queue = max_queue
+        self._pipeline_depth = pipeline_depth
+        self._adaptive_wait = adaptive_wait
         self._predict_timeout_s = predict_timeout_s
         self._plugins = plugins or PluginContext()
         self._warmup = warmup
@@ -190,22 +199,59 @@ class EngineServer:
             storage=self._storage,
         )
         old = self._batchers
-        if self._warmup:
-            self._precompile(algorithms, models)
+        warmed = self._registry.gauge(
+            "pio_warmup_complete",
+            "1 once the newest generation's warmup compiled every "
+            "attempted bucket; 0 while cold (warmup running, disabled, "
+            "or every compile failed)",
+        )
+        warmed.set(0)
+        if self._warmup and self._precompile(algorithms, models):
+            warmed.set(1)
 
         def batch_fn(a, m):
-            def dispatch(qs):
+            has_launch = (
+                type(a).batch_predict_launch
+                is not Algorithm.batch_predict_launch
+            )
+            has_collect = (
+                type(a).batch_predict_collect
+                is not Algorithm.batch_predict_collect
+            )
+            if has_launch != has_collect:
+                # wiring half a protocol into the pipeline would fail
+                # every request at serve time with NotImplementedError;
+                # fall back to single-phase and say so at load
+                logger.warning(
+                    "%s overrides only one of batch_predict_launch/"
+                    "batch_predict_collect — serving single-phase",
+                    type(a).__name__,
+                )
+            if has_launch and has_collect:
+                # two-phase: the collector enqueues batch N+1's device
+                # work while the completer is still inside batch N's
+                # barrier + per-query JSON materialization
+                def dispatch(qs):
+                    return a.batch_predict_launch(m, qs), qs
+
+                def collect(state):
+                    handle, qs = state
+                    return a.batch_predict_collect(m, handle, qs)
+
+                return TwoPhaseBatchFn(dispatch, collect)
+
+            def single(qs):
                 out = a.batch_predict(m, qs)
-                # device barrier before the batcher stops its dispatch
+                # device barrier before the batcher stops its sync
                 # clock: async dispatch would otherwise make
-                # pio_device_dispatch_seconds measure enqueue, not work
+                # pio_device_sync_seconds measure enqueue, not work
                 if isinstance(out, (list, tuple)) and out:
                     profiling.sync(out[-1])
                 else:
                     profiling.sync(out)
                 return out
 
-            return dispatch
+            return single
 
         batchers = [
             MicroBatcher(
@@ -213,6 +259,8 @@ class EngineServer:
                 max_batch=self._max_batch,
                 max_wait_ms=self._max_wait_ms,
                 max_queue=self._max_queue,
+                pipeline_depth=self._pipeline_depth,
+                adaptive_wait=self._adaptive_wait,
                 registry=self._registry,
                 name=f"{self._engine_id}/algo{i}",
             )
@@ -230,7 +278,7 @@ class EngineServer:
             len(batchers),
         )
 
-    def _precompile(self, algorithms, models) -> None:
+    def _precompile(self, algorithms, models) -> bool:
         """Compile every power-of-two batch bucket before traffic hits.
 
         XLA compiles per static shape; without this, each new bucket
@@ -244,10 +292,26 @@ class EngineServer:
         is broken at that shape (WARNING). One failing bucket does not
         skip the rest — larger buckets may compile fine — but repeated
         failures cap out rather than burn the whole reload window.
+
+        Returns True when every attempted bucket compiled (cold-by-
+        design algorithms don't count against it) — the condition for
+        ``pio_warmup_complete`` to read 1; an all-failures warmup must
+        not advertise a warm server to traffic gates.
         """
         t0 = time.perf_counter()
-        for algo, model in zip(algorithms, models):
+        # per-bucket wall time lands in the registry so a scrape
+        # (`pio-tpu status --metrics-url`) shows exactly which compile
+        # buckets a freshly deployed server has paid for already
+        bucket_gauge = self._registry.gauge(
+            "pio_warmup_seconds",
+            "Wall time spent warming one power-of-two compile bucket "
+            "(set whether the compile succeeded or failed)",
+            ("batcher", "bucket"),
+        )
+        total_failures = 0
+        for i, (algo, model) in enumerate(zip(algorithms, models)):
             name = type(algo).__name__
+            batcher_name = f"{self._engine_id}/algo{i}"
             query = getattr(algo, "warmup_query", lambda: {})()
             if query is None:
                 # the algorithm declares no neutral query exists (e.g.
@@ -257,10 +321,17 @@ class EngineServer:
                 continue
             bucket, failures, compiled = 1, 0, 0
             while True:
+                b0 = time.perf_counter()
                 try:
                     algo.batch_predict(model, [query] * bucket)
                     compiled += 1
+                    bucket_gauge.labels(batcher_name, str(bucket)).set(
+                        time.perf_counter() - b0
+                    )
                 except Exception as e:  # noqa: BLE001 - warmup best-effort
+                    bucket_gauge.labels(batcher_name, str(bucket)).set(
+                        time.perf_counter() - b0
+                    )
                     failures += 1
                     if compiled == 0:
                         logger.info(
@@ -282,6 +353,7 @@ class EngineServer:
                     # max_batch rounds up into at predict time
                     break
                 bucket *= 2
+            total_failures += failures
             logger.info(
                 "%s: warmup compiled %d bucket(s)%s",
                 name, compiled,
@@ -290,6 +362,7 @@ class EngineServer:
         logger.info(
             "warmup finished in %.1fs", time.perf_counter() - t0
         )
+        return total_failures == 0
 
     # -- routes -----------------------------------------------------------
     def _status_data(self) -> dict:

@@ -56,4 +56,12 @@ if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
     rc=1
 fi
 
+echo "== serving pipeline bench (pipelined vs serial dispatch) =="
+# BENCH-format JSON lands on stdout so the perf trajectory is tracked
+if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python scripts/serving_bench.py --smoke; then
+    echo "serving pipeline bench FAILED"
+    rc=1
+fi
+
 exit $rc

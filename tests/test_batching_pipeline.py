@@ -1,0 +1,439 @@
+"""Pipelined micro-batching: two-phase dispatch, failure modes, the
+adaptive coalescing window, and the single-phase compatibility path
+(docs/serving.md "Pipelined dispatch").
+
+The pipeline's invariants under failure matter more than its happy
+path: a dispatch-stage error must only poison its own batch (the one
+already enqueued behind it still resolves), close() must drain
+in-flight dispatches in order, and cancellation racing the
+collector→dispatch handoff must end in exactly one terminal state.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import TimeoutError as FuturesTimeout
+
+import pytest
+
+from predictionio_tpu.obs import MetricRegistry
+from predictionio_tpu.serving import resilience
+from predictionio_tpu.serving.batching import (
+    MicroBatcher,
+    TwoPhaseBatchFn,
+)
+
+
+class _TwoPhase:
+    """Scriptable two-phase batch_fn: blockable collect, per-batch
+    dispatch failure injection, full call logs."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.release.set()
+        self.dispatched: list[list] = []
+        self.collected: list[list] = []
+        self.lock = threading.Lock()
+
+    def dispatch(self, items):
+        if items and items[0] == "boom-dispatch":
+            raise ValueError("injected dispatch failure")
+        with self.lock:
+            self.dispatched.append(list(items))
+        return list(items)
+
+    def collect(self, handle):
+        if not self.release.wait(timeout=10):
+            raise RuntimeError("collect never released")
+        if handle and handle[0] == "boom-collect":
+            raise ValueError("injected collect failure")
+        with self.lock:
+            self.collected.append(list(handle))
+        return [str(i).upper() for i in handle]
+
+
+class TestTwoPhaseProtocol:
+    def test_results_in_order_through_both_stages(self):
+        fn = _TwoPhase()
+        b = MicroBatcher(
+            TwoPhaseBatchFn(fn.dispatch, fn.collect),
+            max_batch=4, max_wait_ms=5,
+        )
+        try:
+            futures = [b.submit(f"q{i}") for i in range(10)]
+            assert [f.result(5) for f in futures] == [
+                f"Q{i}" for i in range(10)
+            ]
+            assert sum(len(d) for d in fn.dispatched) == 10
+            assert fn.dispatched == fn.collected
+        finally:
+            b.close()
+
+    def test_enqueue_overlaps_inflight_collect(self):
+        """The pipelining claim itself: batch B's dispatch happens
+        while batch A is still inside collect. Proved by deadlock
+        avoidance — A's collect only unblocks once B has dispatched,
+        so a serial batcher would hang here."""
+        b_dispatched = threading.Event()
+
+        class Fn:
+            def dispatch(self, items):
+                if items[0] == "b":
+                    b_dispatched.set()
+                return items
+
+            def collect(self, handle):
+                if handle[0] == "a":
+                    assert b_dispatched.wait(timeout=5), (
+                        "batch B never dispatched while A was in "
+                        "collect — the stages are not overlapping"
+                    )
+                return [i * 2 for i in handle]
+
+        fn = Fn()
+        b = MicroBatcher(
+            TwoPhaseBatchFn(fn.dispatch, fn.collect),
+            max_batch=1, max_wait_ms=0.1, pipeline_depth=2,
+        )
+        try:
+            fa = b.submit("a")
+            fb = b.submit("b")
+            assert fa.result(5) == "aa"
+            assert fb.result(5) == "bb"
+        finally:
+            b.close()
+
+    def test_pipeline_depth_bounds_inflight(self):
+        """No more than pipeline_depth batches may sit between
+        dispatch and collected results."""
+        inflight = []
+        peak = []
+        lock = threading.Lock()
+        gate = threading.Event()
+
+        class Fn:
+            def dispatch(self, items):
+                with lock:
+                    inflight.append(1)
+                    peak.append(len(inflight))
+                return items
+
+            def collect(self, handle):
+                gate.wait(10)
+                with lock:
+                    inflight.pop()
+                return handle
+
+        fn = Fn()
+        b = MicroBatcher(
+            TwoPhaseBatchFn(fn.dispatch, fn.collect),
+            max_batch=1, max_wait_ms=0.1, pipeline_depth=2,
+        )
+        try:
+            futures = [b.submit(i) for i in range(6)]
+            time.sleep(0.3)  # give the collector every chance to overrun
+            assert max(peak) <= 2
+            gate.set()
+            for f in futures:
+                f.result(5)
+            assert max(peak) <= 2
+        finally:
+            gate.set()
+            b.close()
+
+
+class TestPipelineFailureModes:
+    def test_dispatch_raise_with_next_batch_enqueued(self):
+        """A dispatch-stage error while another batch is already in
+        flight: the failed batch's futures get the error immediately,
+        the in-flight batch still resolves normally."""
+        fn = _TwoPhase()
+        fn.release.clear()  # hold batch A inside collect
+        b = MicroBatcher(
+            TwoPhaseBatchFn(fn.dispatch, fn.collect),
+            max_batch=1, max_wait_ms=0.1, pipeline_depth=2,
+        )
+        try:
+            fa = b.submit("a")
+            # wait until A is dispatched (in flight, uncollected)
+            deadline = time.monotonic() + 5
+            while not fn.dispatched and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert fn.dispatched == [["a"]]
+            fboom = b.submit("boom-dispatch")
+            with pytest.raises(ValueError, match="injected dispatch"):
+                fboom.result(5)  # fails while A is STILL blocked
+            assert not fa.done()
+            fn.release.set()
+            assert fa.result(5) == "A"
+        finally:
+            fn.release.set()
+            b.close()
+
+    def test_collect_raise_only_poisons_its_batch(self):
+        fn = _TwoPhase()
+        b = MicroBatcher(
+            TwoPhaseBatchFn(fn.dispatch, fn.collect),
+            max_batch=1, max_wait_ms=0.1, pipeline_depth=2,
+        )
+        try:
+            fboom = b.submit("boom-collect")
+            fok = b.submit("ok")
+            with pytest.raises(ValueError, match="injected collect"):
+                fboom.result(5)
+            assert fok.result(5) == "OK"
+        finally:
+            b.close()
+
+    def test_close_during_inflight_dispatch(self):
+        """close() while a batch is inside collect: the batch resolves,
+        both threads join, nothing leaks."""
+        registry = MetricRegistry()
+        fn = _TwoPhase()
+        fn.release.clear()
+        b = MicroBatcher(
+            TwoPhaseBatchFn(fn.dispatch, fn.collect),
+            max_batch=1, max_wait_ms=0.1, pipeline_depth=2,
+            registry=registry, name="closing",
+        )
+        f1 = b.submit("x")
+        f2 = b.submit("y")  # queued behind the blocked collect
+        closed = threading.Event()
+
+        def close():
+            b.close()
+            closed.set()
+
+        t = threading.Thread(target=close)
+        t.start()
+        time.sleep(0.1)
+        assert not closed.is_set()  # close is draining, not abandoning
+        fn.release.set()
+        t.join(timeout=10)
+        assert closed.is_set()
+        assert f1.result(1) == "X"
+        assert f2.result(1) == "Y"
+        leaked = registry.counter(
+            "pio_batcher_leaked_threads_total", "", ("batcher",)
+        ).labels("closing")
+        assert leaked.value == 0
+
+    def test_deadline_expiring_during_backpressure_wait_is_honored(self):
+        """A budget that dies while the collector is blocked on the
+        pipeline-depth semaphore must still drop the slot before the
+        device sees it — the cutoff is the last word before dispatch."""
+        gate = threading.Event()
+        dispatched = []
+
+        class Fn:
+            def dispatch(self, items):
+                dispatched.append(list(items))
+                return items
+
+            def collect(self, handle):
+                gate.wait(10)
+                return list(handle)
+
+        b = MicroBatcher(
+            TwoPhaseBatchFn(Fn().dispatch, Fn().collect),
+            max_batch=1, max_wait_ms=0.1, pipeline_depth=1,
+        )
+        try:
+            fa = b.submit("a")  # occupies the only pipeline slot
+            deadline = time.monotonic() + 5
+            while not dispatched and time.monotonic() < deadline:
+                time.sleep(0.005)
+            resilience.set_deadline(resilience.Deadline.after(0.15))
+            fb = b.submit("b")
+            resilience.set_deadline(None)
+            time.sleep(0.4)  # budget dies while collector waits on slot
+            gate.set()
+            assert fa.result(5) == "a"
+            with pytest.raises(resilience.DeadlineExceeded):
+                fb.result(5)
+            assert dispatched == [["a"]]  # "b" never reached the device
+        finally:
+            resilience.set_deadline(None)
+            gate.set()
+            b.close()
+
+    def test_cancel_racing_the_handoff(self):
+        """cancel() racing the collector→dispatch handoff: every
+        future ends in exactly one terminal state, and a won cancel
+        means the item NEVER reached dispatch."""
+        fn = _TwoPhase()
+        b = MicroBatcher(
+            TwoPhaseBatchFn(fn.dispatch, fn.collect),
+            max_batch=2, max_wait_ms=0.5, pipeline_depth=2,
+        )
+        try:
+            outcomes = {"cancelled": 0, "served": 0}
+            for i in range(60):
+                f = b.submit(f"r{i}")
+                if i % 2:
+                    time.sleep(0.0005)  # land some cancels mid-handoff
+                won = f.cancel()
+                if won:
+                    outcomes["cancelled"] += 1
+                    assert f.cancelled()
+                else:
+                    assert f.result(5) == f"R{i}"
+                    outcomes["served"] += 1
+            with fn.lock:
+                dispatched = [i for batch in fn.dispatched for i in batch]
+            # a won cancel is a promise the device never saw the item
+            assert len(dispatched) == outcomes["served"]
+            assert outcomes["cancelled"] + outcomes["served"] == 60
+        finally:
+            b.close()
+
+
+class TestSinglePhaseCompat:
+    def test_zero_extra_barriers_exactly_one_call_per_batch(self):
+        """The compat path must not add barriers around a plain
+        batch_fn: exactly one call per dispatched batch, no wrapper
+        invocations, counts matching pio_batches_total."""
+        registry = MetricRegistry()
+        calls: list[list] = []
+
+        def batch_fn(items):
+            calls.append(list(items))
+            return [i * 2 for i in items]
+
+        b = MicroBatcher(
+            batch_fn, max_batch=8, max_wait_ms=5,
+            registry=registry, name="compat",
+        )
+        try:
+            futures = [b.submit(i) for i in range(24)]
+            assert [f.result(5) for f in futures] == [
+                i * 2 for i in range(24)
+            ]
+        finally:
+            b.close()
+        batches = registry.counter(
+            "pio_batches_total", "", ("batcher",)
+        ).labels("compat").value
+        assert len(calls) == batches
+        assert sum(len(c) for c in calls) == 24
+
+    def test_serial_depth_zero_still_works(self):
+        calls = []
+
+        def batch_fn(items):
+            calls.append(list(items))
+            return [i + 1 for i in items]
+
+        b = MicroBatcher(
+            batch_fn, max_batch=4, max_wait_ms=1, pipeline_depth=0,
+        )
+        try:
+            futures = [b.submit(i) for i in range(9)]
+            assert [f.result(5) for f in futures] == [
+                i + 1 for i in range(9)
+            ]
+            assert sum(len(c) for c in calls) == 9
+        finally:
+            b.close()
+
+
+class TestAdaptiveWait:
+    def test_full_batch_shrinks_wait_idle_restores_it(self):
+        release = threading.Event()
+        release.set()
+        b = MicroBatcher(
+            lambda items: list(items), max_batch=2, max_wait_ms=50,
+        )
+        try:
+            full = b._max_wait  # seconds
+            assert b._current_wait == full
+            # a full batch must shrink the next window
+            fs = [b.submit(1), b.submit(2)]
+            [f.result(5) for f in fs]
+            deadline = time.monotonic() + 2
+            while b._current_wait >= full and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert b._current_wait < full
+            # a partial (idle-traffic) batch restores it
+            b.submit(3).result(5)
+            deadline = time.monotonic() + 2
+            while b._current_wait != full and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert b._current_wait == full
+        finally:
+            b.close()
+
+    def test_adaptive_off_keeps_the_window(self):
+        b = MicroBatcher(
+            lambda items: list(items), max_batch=2, max_wait_ms=50,
+            adaptive_wait=False,
+        )
+        try:
+            fs = [b.submit(1), b.submit(2)]
+            [f.result(5) for f in fs]
+            b.submit(3).result(5)
+            assert b._current_wait == b._max_wait
+        finally:
+            b.close()
+
+
+class TestPipelineTelemetry:
+    def test_enqueue_and_sync_histograms_recorded(self):
+        registry = MetricRegistry()
+        fn = _TwoPhase()
+        b = MicroBatcher(
+            TwoPhaseBatchFn(fn.dispatch, fn.collect),
+            max_batch=4, max_wait_ms=2, registry=registry, name="tele",
+        )
+        try:
+            futures = [b.submit(i) for i in range(8)]
+            [f.result(5) for f in futures]
+        finally:
+            b.close()
+        data = registry.to_dict()
+        for metric in (
+            "pio_device_enqueue_seconds",
+            "pio_device_sync_seconds",
+            "pio_device_dispatch_seconds",
+        ):
+            [sample] = [
+                s for s in data[metric]["samples"]
+                if s["labels"] == {"batcher": "tele"}
+            ]
+            assert sample["count"] >= 1, metric
+        # end-to-end dispatch time covers both phases
+        total = data["pio_device_dispatch_seconds"]["samples"][0]["sum"]
+        enq = data["pio_device_enqueue_seconds"]["samples"][0]["sum"]
+        assert total >= enq
+
+
+class TestCallDeadlineCap:
+    def test_call_timeout_capped_by_context_deadline(self):
+        """MicroBatcher.__call__ must not wait its full default 30 s
+        when the admitting request's budget is smaller."""
+        gate = threading.Event()
+        b = MicroBatcher(
+            lambda items: (gate.wait(10), list(items))[1],
+            max_batch=1, max_wait_ms=0.1,
+        )
+        resilience.set_deadline(resilience.Deadline.after(0.3))
+        try:
+            t0 = time.perf_counter()
+            with pytest.raises(FuturesTimeout):
+                b({"q": 1})  # default timeout would be 30 s
+            assert time.perf_counter() - t0 < 2.0
+        finally:
+            resilience.set_deadline(None)
+            gate.set()
+            b.close()
+
+    def test_call_without_deadline_keeps_explicit_timeout(self):
+        b = MicroBatcher(
+            lambda items: [i * 2 for i in items],
+            max_batch=1, max_wait_ms=0.1,
+        )
+        try:
+            assert b(21, timeout=5) == 42
+        finally:
+            b.close()

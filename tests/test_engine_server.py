@@ -674,3 +674,175 @@ class TestRemoteErrorLog:
         payload = es._log_queue.get_nowait()
         assert len(payload) < 8192
         assert b'"queryTruncated": true' in payload
+
+
+class TestWarmupTelemetry:
+    """Warmup visibility (docs/observability.md): per-bucket compile
+    wall time + a cold/warm gauge a scrape can read."""
+
+    def test_warmup_records_bucket_times_and_complete_gauge(
+        self, ctx, memory_storage
+    ):
+        from predictionio_tpu.obs import MetricRegistry
+
+        run_train(
+            _engine(), _params(), engine_id="srv-warm", ctx=ctx,
+            storage=memory_storage,
+        )
+        registry = MetricRegistry()
+        es = EngineServer(
+            _engine(), _params(), engine_id="srv-warm",
+            storage=memory_storage, ctx=ctx, warmup=True,
+            max_batch=8, registry=registry,
+        )
+        try:
+            data = registry.to_dict()
+            assert (
+                data["pio_warmup_complete"]["samples"][0]["value"] == 1
+            )
+            samples = [
+                s for s in data["pio_warmup_seconds"]["samples"]
+                if s["labels"]["batcher"] == "srv-warm/algo0"
+            ]
+            assert {s["labels"]["bucket"] for s in samples} == {
+                "1", "2", "4", "8"
+            }
+            for s in samples:
+                assert s["value"] >= 0
+        finally:
+            es.close()
+
+    def test_warmup_disabled_reports_cold(self, ctx, memory_storage):
+        from predictionio_tpu.obs import MetricRegistry
+
+        run_train(
+            _engine(), _params(), engine_id="srv-cold", ctx=ctx,
+            storage=memory_storage,
+        )
+        registry = MetricRegistry()
+        es = EngineServer(
+            _engine(), _params(), engine_id="srv-cold",
+            storage=memory_storage, ctx=ctx, warmup=False,
+            registry=registry,
+        )
+        try:
+            data = registry.to_dict()
+            assert (
+                data["pio_warmup_complete"]["samples"][0]["value"] == 0
+            )
+        finally:
+            es.close()
+
+
+class TwoPhaseDictAlgorithm(DictQueryAlgorithm):
+    """Dict-query algorithm speaking the two-phase serving protocol."""
+
+    launches = 0
+    collects = 0
+
+    def batch_predict_launch(self, model, queries):
+        type(self).launches += 1
+        return [self.predict(model, q) for q in queries]
+
+    def batch_predict_collect(self, model, handle, queries):
+        type(self).collects += 1
+        assert len(handle) == len(queries)
+        return handle
+
+
+class TestTwoPhaseServing:
+    def test_two_phase_algorithm_rides_the_pipeline(
+        self, ctx, memory_storage
+    ):
+        """An algorithm overriding batch_predict_launch must be served
+        through dispatch/collect, not the single-phase fallback."""
+        engine = Engine(
+            FakeDataSource, FakePreparator, TwoPhaseDictAlgorithm,
+            DictServing,
+        )
+        run_train(
+            engine, _params(), engine_id="srv-2p", ctx=ctx,
+            storage=memory_storage,
+        )
+        es = EngineServer(
+            engine, _params(), engine_id="srv-2p",
+            storage=memory_storage, ctx=ctx, warmup=False,
+        )
+        TwoPhaseDictAlgorithm.launches = 0
+        TwoPhaseDictAlgorithm.collects = 0
+        try:
+            out = es._batchers[0].submit({"x": 4}).result(5)
+            assert out == {"result": 34}
+            assert TwoPhaseDictAlgorithm.launches >= 1
+            assert TwoPhaseDictAlgorithm.collects >= 1
+        finally:
+            es.close()
+
+    def test_half_override_falls_back_to_single_phase(
+        self, ctx, memory_storage, caplog
+    ):
+        """Overriding only batch_predict_launch must not wire a broken
+        half-protocol into the pipeline — single-phase fallback with a
+        load-time warning instead of per-request NotImplementedError."""
+
+        class HalfAlgorithm(DictQueryAlgorithm):
+            def batch_predict_launch(self, model, queries):
+                return queries
+
+        engine = Engine(
+            FakeDataSource, FakePreparator, HalfAlgorithm, DictServing
+        )
+        run_train(
+            engine, _params(), engine_id="srv-half", ctx=ctx,
+            storage=memory_storage,
+        )
+        import logging
+
+        with caplog.at_level(
+            logging.WARNING, "predictionio_tpu.serving.engine_server"
+        ):
+            es = EngineServer(
+                engine, _params(), engine_id="srv-half",
+                storage=memory_storage, ctx=ctx, warmup=False,
+            )
+        try:
+            assert any(
+                "single-phase" in r.message for r in caplog.records
+            )
+            out = es._batchers[0].submit({"x": 2}).result(5)
+            assert out == {"result": 32}
+        finally:
+            es.close()
+
+
+class TestWarmupFailureGauge:
+    def test_all_failed_warmup_reports_cold(self, ctx, memory_storage):
+        """pio_warmup_complete must stay 0 when every bucket compile
+        failed — a traffic gate reading 1 would route load to a fully
+        cold server."""
+        from predictionio_tpu.obs import MetricRegistry
+
+        class BrokenWarmup(DictQueryAlgorithm):
+            def batch_predict(self, model, queries):
+                raise RuntimeError("no shape compiles")
+
+        engine = Engine(
+            FakeDataSource, FakePreparator, BrokenWarmup, DictServing
+        )
+        run_train(
+            engine, _params(), engine_id="srv-broken", ctx=ctx,
+            storage=memory_storage,
+        )
+        registry = MetricRegistry()
+        es = EngineServer(
+            engine, _params(), engine_id="srv-broken",
+            storage=memory_storage, ctx=ctx, warmup=True, max_batch=4,
+            registry=registry,
+        )
+        try:
+            data = registry.to_dict()
+            assert (
+                data["pio_warmup_complete"]["samples"][0]["value"] == 0
+            )
+        finally:
+            es.close()
