@@ -100,6 +100,17 @@ _RETRYABLE = (
 
 _CACHE = os.path.join(os.path.dirname(__file__), ".bench_cpu_baseline.json")
 
+#: phase-line fragments proving a worker's backend initialized — a
+#: failed round carrying none of these lost its backend (or never got
+#: one), so the retry must re-run the cheap preflight probe first
+_ALIVE_MARKERS = (
+    "backend up",
+    "in-worker preflight ok",
+    "pack done",
+    "compile+warmup done",
+    "round 1/",
+)
+
 
 def _scale() -> str:
     if "--large" in sys.argv:
@@ -312,37 +323,73 @@ def measure_tpu(
     measurement if the "TPU" worker silently ran on the cpu backend.
     Injectable ``run_worker``/``sleep``/``monotonic`` so the retry logic
     is unit-testable without subprocesses (tests/test_bench_retry.py).
+
+    Preflight hardening (ROADMAP: BENCH_r04/r05 regressed to
+    cpu-fallback purely on 180 s preflight timeouts):
+
+    * **fall forward, not back** — a preflight that TIMES OUT doubles
+      the next attempt's window (capped by the remaining budget)
+      instead of burning fixed-size attempts toward cpu-fallback: a
+      platform that is merely slow to initialize eventually passes,
+      and the run is annotated ``slow_init`` rather than silently
+      degraded;
+    * **reuse a warm backend between rounds** — once any preflight has
+      proven the platform, retry rounds skip the separate probe
+      process (each probe pays a full backend init); the TPU worker
+      itself re-verifies the dispatch path on its own already-warm
+      backend before the workload;
+    * a timed-out FULL worker also widens the next round's window,
+      since a hang past 900 s on a loaded tunnel is the same
+      slow-platform signature.
     """
     run_worker = run_worker or _run_worker
     errors: list[str] = []
     cpu_clean = None
     t_start = monotonic()
+    preflight_proven = False
+    slow_init = False
+    preflight_window = float(PREFLIGHT_TIMEOUT_S)
+    worker_window = float(WORKER_TIMEOUT_S)
     for attempt in range(MAX_TPU_ATTEMPTS):
         remaining = TOTAL_TPU_BUDGET_S - (monotonic() - t_start)
         if remaining < 60:
             errors.append("tpu retry budget exhausted")
             break
-        # cheap probe first: a dead tunnel fails here in ≤90s instead of
-        # hanging the full 900s workload timeout
-        probe, probe_err = run_worker(
-            "preflight", scale, timeout=min(PREFLIGHT_TIMEOUT_S, remaining)
-        )
-        if probe is None or not probe.get("ok"):
-            err = probe_err or f"preflight returned {probe}"
-            errors.append(f"attempt {attempt + 1}: preflight: {err}")
-            if not _retryable(err) or attempt == MAX_TPU_ATTEMPTS - 1:
-                break
-            sleep(RETRY_BACKOFF_S[min(attempt, len(RETRY_BACKOFF_S) - 1)])
-            continue
-        if probe.get("backend") == "cpu":
-            errors.append(
-                f"attempt {attempt + 1}: tpu worker ran on cpu backend"
+        if not preflight_proven:
+            # cheap probe first: a dead tunnel fails here in minutes
+            # instead of hanging the full workload timeout
+            probe, probe_err = run_worker(
+                "preflight", scale,
+                timeout=min(preflight_window, remaining),
             )
-            break
+            if probe is None or not probe.get("ok"):
+                err = probe_err or f"preflight returned {probe}"
+                errors.append(f"attempt {attempt + 1}: preflight: {err}")
+                if "timed out" in (err or ""):
+                    slow_init = True
+                    preflight_window = min(
+                        preflight_window * 2.0,
+                        max(remaining, preflight_window),
+                    )
+                if not _retryable(err) or attempt == MAX_TPU_ATTEMPTS - 1:
+                    break
+                sleep(
+                    RETRY_BACKOFF_S[min(attempt, len(RETRY_BACKOFF_S) - 1)]
+                )
+                continue
+            if probe.get("backend") == "cpu":
+                errors.append(
+                    f"attempt {attempt + 1}: tpu worker ran on cpu backend"
+                )
+                break
+            # the platform is proven alive: later rounds go straight to
+            # the measurement worker, whose in-process re-verify runs on
+            # the backend it just initialized anyway
+            preflight_proven = True
 
         remaining = TOTAL_TPU_BUDGET_S - (monotonic() - t_start)
         result, err = run_worker(
-            "tpu", scale, timeout=min(WORKER_TIMEOUT_S, max(remaining, 60))
+            "tpu", scale, timeout=min(worker_window, max(remaining, 60))
         )
         if result is not None and result.get("backend") == "cpu":
             # the TPU plugin failed to register mid-run and JAX fell
@@ -354,8 +401,23 @@ def measure_tpu(
             )
             break
         if result is not None:
+            if slow_init:
+                result["slow_init"] = True
             return result, errors, cpu_clean
         errors.append(f"attempt {attempt + 1}: {err}")
+        if "timed out" in (err or ""):
+            slow_init = True
+            worker_window = min(
+                worker_window * 2.0, max(remaining, worker_window)
+            )
+        if not any(m in (err or "") for m in _ALIVE_MARKERS):
+            # the failed round shows NO evidence its backend ever came
+            # up (no phase line past init): the platform may have died
+            # since it was proven — re-probe with the CHEAP preflight
+            # next round instead of burning another full worker window
+            # on a dead tunnel. A failure mid-workload (markers
+            # present) keeps the skip: the backend was alive.
+            preflight_proven = False
         if not _retryable(err) or attempt == MAX_TPU_ATTEMPTS - 1:
             break
         sleep(RETRY_BACKOFF_S[min(attempt, len(RETRY_BACKOFF_S) - 1)])
@@ -396,6 +458,17 @@ def main() -> None:
         if side == "preflight":
             print(json.dumps(run_preflight()))
             return
+        if side == "tpu":
+            # re-verify the dispatch path on the backend THIS process
+            # just initialized — the warm backend the workload reuses
+            # (retry rounds skip the separate probe process entirely)
+            t0 = time.perf_counter()
+            probe = run_preflight()
+            _phase(
+                f"in-worker preflight {'ok' if probe['ok'] else 'FAILED'} "
+                f"backend={probe['backend']} "
+                f"in {time.perf_counter() - t0:.1f}s"
+            )
         print(json.dumps(run_epoch_bench(scale)))
         return
 
@@ -423,8 +496,13 @@ def main() -> None:
                 "peak_hbm_gib": result.get("peak_hbm_gib"),
                 "cpu_epoch_seconds": round(baseline, 4) if baseline else None,
                 "attempts": len(errors) + 1,
+                # the platform initialized slower than the base window
+                # but the measurement is REAL — annotated, not degraded
+                "slow_init": bool(result.get("slow_init")),
             },
         }
+        if errors:
+            record["extra"]["retried_errors"] = errors
         print(json.dumps(record))
         return
 
@@ -444,6 +522,12 @@ def main() -> None:
                     "unit": "s",
                     "vs_baseline": 1.0,
                     "degraded": "cpu-fallback",
+                    # distinguish "the platform never initialized inside
+                    # the whole budget" from a hard failure — the former
+                    # is the slow-init signature ROADMAP calls out
+                    "slow_init": any(
+                        "timed out" in e for e in errors
+                    ),
                     "error": errors,
                     "extra": {
                         "backend": "cpu",

@@ -2,8 +2,8 @@
 
 Verbs: version, status, trace, app (new/list/show/delete/data-delete/
 channel-new/channel-delete), accesskey (new/list/delete), build, train,
-eval, deploy, undeploy, eventserver, dashboard, adminserver, export,
-import, template (list/get), run.
+eval, deploy, undeploy, router, eventserver, dashboard, adminserver,
+export, import, template (list/get), run.
 
 Where the reference shells out to spark-submit (Runner.scala:92-210),
 this console runs workflows in-process: multi-host TPU runs launch this
@@ -572,6 +572,43 @@ def cmd_deploy(args) -> int:
             http, args.workers,
             _workers.rebuild_argv(args.raw_argv, http.port),
         )
+    return _serve_foreground(http)
+
+
+def cmd_router(args) -> int:
+    """Scale-out front tier: least-inflight + consistent-hash dispatch
+    across N engine replicas, health-probed via their /healthz +
+    warmup gauges, with breaker-guarded single-retry failover and
+    rolling generation swaps (docs/scale_out.md). Pure HTTP — never
+    imports jax; the replicas own the devices."""
+    from predictionio_tpu.serving.config import ServerConfig
+    from predictionio_tpu.serving.router import create_router
+
+    config = ServerConfig.from_env()
+    if args.admin_key:
+        config = dataclasses.replace(
+            config, key_auth_enforced=True, access_key=args.admin_key
+        )
+    if not config.key_auth_enforced:
+        print(
+            "WARNING: /admin/* routes are OPEN — anyone who can reach "
+            "the router can register or retire replicas. Pass "
+            "--admin-key (or set PIO_SERVER_ACCESS_KEY with "
+            "PIO_SERVER_KEY_AUTH_ENFORCED=true).",
+            file=sys.stderr,
+        )
+    _router, http = create_router(
+        args.replica or [],
+        host=args.ip,
+        port=args.port,
+        probe_interval_s=args.probe_interval,
+        failover_retries=args.failover_retries,
+        proxy_timeout_s=args.proxy_timeout,
+        server_config=config,
+    )
+    print(f"Router is listening on {args.ip}:{http.port}")
+    if args.replica:
+        print(f"Routing across {len(args.replica)} replica(s)")
     return _serve_foreground(http)
 
 
@@ -1226,6 +1263,35 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ip", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000)
     p.set_defaults(func=cmd_undeploy)
+
+    p = sub.add_parser("router")
+    p.add_argument("--ip", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8100)
+    p.add_argument(
+        "--replica", action="append", default=[],
+        help="replica base URL, optionally 'url#generation'; repeat "
+             "per replica (more can be registered live via "
+             "POST /admin/replicas)",
+    )
+    p.add_argument(
+        "--probe-interval", dest="probe_interval", type=float,
+        default=0.5, help="seconds between replica health probes",
+    )
+    p.add_argument(
+        "--failover-retries", dest="failover_retries", type=int,
+        default=1,
+        help="retries against a DIFFERENT replica after a transport "
+             "error or 5xx (inside the request's deadline budget)",
+    )
+    p.add_argument(
+        "--proxy-timeout", dest="proxy_timeout", type=float,
+        default=30.0, help="per-attempt upstream timeout in seconds",
+    )
+    p.add_argument(
+        "--admin-key", dest="admin_key", default="",
+        help="require this key on /admin/* (register/retire/swap)",
+    )
+    p.set_defaults(func=cmd_router)
 
     p = sub.add_parser("eventserver")
     p.add_argument("--ip", default="0.0.0.0")

@@ -60,6 +60,10 @@ class Request:
         #: the route PATTERN that matched (set by Router.dispatch) —
         #: bounded cardinality, unlike the raw path
         self.route: str | None = None
+        #: "host:port" of the connecting client (set by the server
+        #: wrapper) — the serving router hashes this for consistent
+        #: affinity when a request carries no explicit affinity key
+        self.client_addr: str = ""
 
     def json(self) -> Any:
         if not self.body:
@@ -392,6 +396,10 @@ class HTTPServer:
                     body=body,
                     path_params={},
                 )
+                try:
+                    request.client_addr = "%s:%s" % self.client_address[:2]
+                except (TypeError, IndexError):  # AF_UNIX and friends
+                    request.client_addr = str(self.client_address)
                 # forwarded or minted; installed in the thread context so
                 # the batcher and log lines downstream can read it
                 request.request_id = set_request_id(

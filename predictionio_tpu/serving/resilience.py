@@ -160,6 +160,17 @@ class Deadline:
         blocking-forever)."""
         return max(0.001, min(timeout_s, self.remaining_s()))
 
+    def reserved(self, seconds: float) -> "Deadline":
+        """A deadline ending ``seconds`` earlier — the slice a caller
+        holds back for one more hop (the router reserves failover
+        budget this way). When the budget is already too tight to
+        slice (less than twice the reservation), the full deadline is
+        returned: starving the FIRST attempt to protect a retry that
+        could never fit anyway helps nobody."""
+        if self.remaining_s() <= seconds * 2.0:
+            return self
+        return Deadline(self.expires_mono - seconds)
+
 
 _deadline: contextvars.ContextVar[Deadline | None] = contextvars.ContextVar(
     "pio_deadline", default=None

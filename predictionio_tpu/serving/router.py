@@ -1,0 +1,923 @@
+"""Scale-out serving tier: a model-aware router over engine replicas.
+
+One ``EngineServer`` process is one GIL and (at most) one accelerator;
+the ROADMAP's "millions of users" need N of them. This module is the
+front tier that makes N replicas look like one server — the Podracer
+shape (PAPERS.md): inference servers are cattle behind a thin router,
+and model generations roll through them without a dropped request.
+
+The router consumes exactly the per-replica signals PRs 1–4 built and
+nothing else, so any process that mounts the common telemetry surface
+(:func:`~predictionio_tpu.serving.http.install_metrics_routes`) can
+stand behind it:
+
+* ``GET /healthz`` — alive vs ``draining`` (the SIGTERM drain path);
+* ``GET /metrics.json`` — ``pio_warmup_complete`` (a new generation is
+  admitted only after every compile bucket warmed) and
+  ``pio_server_draining``;
+* per-replica :class:`~predictionio_tpu.serving.resilience
+  .CircuitBreaker` state from proxy outcomes (5xx / transport errors),
+  so a sick replica is excluded and probed back in half-open;
+* ``X-PIO-Deadline`` decrements across the router hop, and a
+  transport-error/5xx failover retries ONCE against a different
+  replica only while budget remains;
+* ``X-Request-ID`` / ``X-Parent-Span`` forwarding, so one distributed
+  trace spans client → router → replica → store.
+
+Dispatch is least-inflight with consistent-hash affinity as the
+tiebreaker: the replica with the least router-tracked in-flight work
+wins; ties break on a stable hash ring keyed by ``X-PIO-Affinity``
+(falling back to the query body, then the client address), so identical
+queries keep landing on the same replica's warm caches without ever
+overriding load.
+
+Rolling deploys (``POST /admin/swap``): register a new-generation
+replica, admit it only once its warmup gauge reads 1, then drain the
+old generation — excluded from selection immediately, in-flight
+requests finish, and locally-supervised replicas (registered with a
+``pid``) receive SIGTERM so their own graceful drain runs. Zero
+requests are dropped; ``scripts/router_smoke.py`` proves it under
+replica SIGKILL chaos.
+
+Metrics (docs/scale_out.md): ``pio_router_replica_healthy{replica}``,
+``pio_router_inflight{replica}``, ``pio_router_failovers_total``,
+``pio_router_requests_total{replica,status}``,
+``pio_router_swaps_total{outcome}``.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import json
+import logging
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+from typing import Callable, Iterable
+
+from predictionio_tpu.obs import MetricRegistry, get_registry
+from predictionio_tpu.obs import tracing
+from predictionio_tpu.obs.context import log_json
+from predictionio_tpu.serving import resilience
+from predictionio_tpu.serving.http import (
+    HTTPError,
+    HTTPServer,
+    Request,
+    Response,
+    Router,
+    install_metrics_routes,
+)
+
+logger = logging.getLogger(__name__)
+
+# -- replica lifecycle states ----------------------------------------------
+#: registered, waiting for healthz ok + pio_warmup_complete=1
+WARMING = "warming"
+#: in the selection pool
+HEALTHY = "healthy"
+#: excluded from selection; in-flight work finishing (admin retire or
+#: the replica's own /healthz says draining)
+DRAINING = "draining"
+#: probes failing — excluded until a probe succeeds again
+UNHEALTHY = "unhealthy"
+#: terminal: removed from the active pool by a retire/swap
+RETIRED = "retired"
+
+#: affinity header clients may set to pin related queries together
+AFFINITY_HEADER = "X-PIO-Affinity"
+
+#: vnodes per replica on the consistent-hash ring — enough that
+#: removing one replica only remaps ~1/N of the key space
+_RING_VNODES = 32
+
+
+def _hash64(data: bytes) -> int:
+    return int.from_bytes(hashlib.sha1(data).digest()[:8], "big")
+
+
+class Replica:
+    """One engine-server replica the router knows about."""
+
+    def __init__(
+        self,
+        replica_id: str,
+        url: str,
+        generation: str = "",
+        pid: int | None = None,
+        registry: MetricRegistry | None = None,
+        breaker_config: resilience.BreakerConfig | None = None,
+    ):
+        self.replica_id = replica_id
+        self.url = url.rstrip("/")
+        self.generation = generation
+        #: local supervision: a pid lets the router SIGTERM the replica
+        #: during a rolling swap so its own graceful drain runs
+        self.pid = pid
+        self.state = WARMING
+        #: set by an admin retire/swap: the drain is STICKY — probes
+        #: must not readmit this replica even while its process still
+        #: answers ok (the router, not the replica, decided to drain)
+        self.admin_draining = False
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self.probe_failures = 0
+        self.last_probe: str = "never"
+        # NOT the process-global get_breaker map: two routers (or a
+        # test building many) must not share breaker state for
+        # same-named targets
+        self.breaker = resilience.CircuitBreaker(
+            f"replica:{replica_id}",
+            config=breaker_config,
+            registry=registry,
+        )
+        #: vnode points on the consistent-hash ring, precomputed once —
+        #: selection must not pay 32 SHA1s per replica per request
+        self.ring_points: tuple[int, ...] = tuple(
+            sorted(
+                _hash64(f"{replica_id}#{v}".encode())
+                for v in range(_RING_VNODES)
+            )
+        )
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def begin(self) -> None:
+        with self._lock:
+            self._inflight += 1
+
+    def end(self) -> None:
+        with self._lock:
+            self._inflight -= 1
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.replica_id,
+            "url": self.url,
+            "generation": self.generation,
+            "state": self.state,
+            "inflight": self.inflight,
+            "breaker": self.breaker.state,
+            "lastProbe": self.last_probe,
+            "pid": self.pid,
+        }
+
+
+def _metric_sample(data: dict, name: str, **labels) -> float | None:
+    """Pull one sample value out of a ``/metrics.json`` payload."""
+    try:
+        for sample in data.get(name, {}).get("samples", ()):
+            if all(
+                sample.get("labels", {}).get(k) == v
+                for k, v in labels.items()
+            ):
+                return float(sample.get("value", sample.get("count")))
+    except (AttributeError, TypeError, ValueError):
+        return None
+    return None
+
+
+class ServingRouter:
+    """HTTP front tier dispatching queries across engine replicas.
+
+    Mount with :meth:`serve` (or the ``pio-tpu router`` CLI verb).
+    Thread-safety: the replica map is guarded by one lock; the probe
+    loop, proxy handlers, and admin routes all go through it.
+    """
+
+    def __init__(
+        self,
+        replicas: Iterable[Replica] = (),
+        *,
+        probe_interval_s: float = 0.5,
+        probe_timeout_s: float = 2.0,
+        unhealthy_after: int = 2,
+        failover_retries: int = 1,
+        proxy_timeout_s: float = 30.0,
+        drain_poll_s: float = 0.05,
+        registry: MetricRegistry | None = None,
+        tracer: tracing.Tracer | None = None,
+        server_config=None,
+        breaker_config: resilience.BreakerConfig | None = None,
+    ):
+        self._registry = registry if registry is not None else get_registry()
+        self._tracer = tracer if tracer is not None else tracing.get_tracer()
+        if server_config is None:
+            from predictionio_tpu.serving.config import ServerConfig
+
+            server_config = ServerConfig.from_env()
+        self._server_config = server_config
+        self._breaker_config = breaker_config
+        self._probe_interval_s = probe_interval_s
+        self._probe_timeout_s = probe_timeout_s
+        self._unhealthy_after = max(1, unhealthy_after)
+        self._failover_retries = max(0, failover_retries)
+        self._proxy_timeout_s = proxy_timeout_s
+        self._drain_poll_s = drain_poll_s
+
+        self._lock = threading.Lock()
+        self._replicas: dict[str, Replica] = {}
+        self._retired: list[dict] = []
+        #: tied-id tuple -> (sorted vnode points, matching replica ids)
+        self._ring_cache: dict[tuple, tuple[list, list]] = {}
+        self._swaps: dict[str, dict] = {}
+        self._closed = threading.Event()
+        self._start_time = time.time()
+
+        self._healthy_gauge = self._registry.gauge(
+            "pio_router_replica_healthy",
+            "1 while the replica is admitted to the selection pool",
+            ("replica",),
+        )
+        self._inflight_gauge = self._registry.gauge(
+            "pio_router_inflight",
+            "Router-tracked in-flight requests per replica",
+            ("replica",),
+        )
+        self._failovers_total = self._registry.counter(
+            "pio_router_failovers_total",
+            "Requests retried against a different replica after a "
+            "transport error or 5xx",
+        )
+        self._requests_total = self._registry.counter(
+            "pio_router_requests_total",
+            "Requests proxied, by replica and upstream status "
+            "(status=error for transport failures)",
+            ("replica", "status"),
+        )
+        self._swaps_total = self._registry.counter(
+            "pio_router_swaps_total",
+            "Rolling generation swaps, by outcome",
+            ("outcome",),
+        )
+
+        for replica in replicas:
+            self._install(replica)
+
+        self.router = Router()
+        self.router.route("GET", "/", self._status)
+        self.router.route("POST", "/queries.json", self._proxy)
+        self.router.route("POST", "/batch/queries.json", self._proxy)
+        self.router.route("GET", "/admin/replicas", self._admin_list)
+        self.router.route("POST", "/admin/replicas", self._admin_register)
+        self.router.route(
+            "DELETE", "/admin/replicas/<rid>", self._admin_retire
+        )
+        self.router.route("POST", "/admin/swap", self._admin_swap)
+        self.router.route("GET", "/admin/swap/<sid>", self._admin_swap_get)
+        install_metrics_routes(
+            self.router, self._registry, self._tracer,
+            server_config=self._server_config,
+        )
+        self._http: HTTPServer | None = None
+        self._prober = threading.Thread(
+            target=self._probe_loop, name="pio-router-probe", daemon=True
+        )
+        self._prober.start()
+
+    # -- replica registry --------------------------------------------------
+    def _install(self, replica: Replica) -> None:
+        with self._lock:
+            if replica.replica_id in self._replicas:
+                raise ValueError(
+                    f"replica id {replica.replica_id!r} already registered"
+                )
+            self._replicas[replica.replica_id] = replica
+        rid = replica.replica_id
+        self._healthy_gauge.labels(rid).set(0)
+        self._inflight_gauge.labels(rid).set_function(
+            lambda r=replica: float(r.inflight)
+        )
+        log_json(
+            logger, logging.INFO, "router_replica_registered",
+            replica=rid, url=replica.url, generation=replica.generation,
+        )
+
+    def add_replica(
+        self,
+        url: str,
+        replica_id: str | None = None,
+        generation: str = "",
+        pid: int | None = None,
+    ) -> Replica:
+        """Register a replica; it enters the pool WARMING and is
+        admitted by the probe loop once its ``/healthz`` answers ok and
+        its ``pio_warmup_complete`` gauge (when exported) reads 1."""
+        replica = Replica(
+            replica_id or f"r-{uuid.uuid4().hex[:8]}",
+            url,
+            generation=generation,
+            pid=pid,
+            registry=self._registry,
+            breaker_config=self._breaker_config,
+        )
+        self._install(replica)
+        return replica
+
+    def retire(
+        self,
+        replica_id: str,
+        wait: bool = False,
+        on_drained: Callable[[Replica], None] | None = None,
+    ) -> bool:
+        """Drain a replica out of the pool: selection stops NOW,
+        in-flight requests finish, then ``on_drained`` runs (default:
+        SIGTERM a locally-supervised replica's ``pid`` so its own
+        graceful drain path completes) and the replica is dropped from
+        the active map. Returns False when the id is unknown."""
+        with self._lock:
+            replica = self._replicas.get(replica_id)
+            if replica is None:
+                return False
+            if replica.admin_draining and not wait:
+                return True  # a drain is already in flight
+            replica.admin_draining = True
+            replica.state = DRAINING
+        self._healthy_gauge.labels(replica_id).set(0)
+        log_json(
+            logger, logging.INFO, "router_replica_draining",
+            replica=replica_id,
+        )
+
+        def _finish():
+            while replica.inflight > 0 and not self._closed.is_set():
+                time.sleep(self._drain_poll_s)
+            try:
+                if on_drained is not None:
+                    on_drained(replica)
+                elif replica.pid:
+                    os.kill(replica.pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass  # already gone — retiring a dead replica is fine
+            except Exception:  # noqa: BLE001 - retire must complete
+                logger.exception("retire hook failed for %s", replica_id)
+            with self._lock:
+                replica.state = RETIRED
+                self._replicas.pop(replica_id, None)
+                self._retired.append(replica.to_dict())
+                del self._retired[:-20]
+            # the registry has no series-removal API, so park the dead
+            # replica's series at constant 0 — replacing the scrape
+            # closure is what lets the Replica (and its breaker) be
+            # garbage-collected instead of pinned for process life
+            self._inflight_gauge.labels(replica_id).set_function(
+                lambda: 0.0
+            )
+            self._healthy_gauge.labels(replica_id).set(0)
+            log_json(
+                logger, logging.INFO, "router_replica_retired",
+                replica=replica_id,
+            )
+
+        if wait:
+            _finish()
+        else:
+            threading.Thread(
+                target=_finish,
+                name=f"pio-router-retire-{replica_id}",
+                daemon=True,
+            ).start()
+        return True
+
+    def replica_states(self) -> dict[str, str]:
+        with self._lock:
+            return {
+                rid: r.state for rid, r in self._replicas.items()
+            }
+
+    # -- health probing ----------------------------------------------------
+    def _probe_loop(self) -> None:
+        while not self._closed.wait(self._probe_interval_s):
+            with self._lock:
+                targets = list(self._replicas.values())
+            for replica in targets:
+                try:
+                    self._probe_one(replica)
+                except Exception:  # noqa: BLE001 - prober must survive
+                    logger.exception(
+                        "probe crashed for %s", replica.replica_id
+                    )
+
+    def _fetch_json(self, url: str):
+        with urllib.request.urlopen(
+            urllib.request.Request(url), timeout=self._probe_timeout_s
+        ) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+
+    def _probe_one(self, replica: Replica) -> None:
+        if replica.state == RETIRED:
+            return
+        try:
+            try:
+                status, body = self._fetch_json(replica.url + "/healthz")
+            except urllib.error.HTTPError as e:
+                status, body = e.code, json.loads(e.read() or b"{}")
+            draining = (
+                status == 503
+                and isinstance(body, dict)
+                and body.get("status") == "draining"
+            )
+            warm = True
+            if not draining:
+                # scrape warmup + drain gauges; a server that exports
+                # neither (non-engine replica) counts as warm
+                _, metrics = self._fetch_json(
+                    replica.url + "/metrics.json"
+                )
+                warm_v = _metric_sample(metrics, "pio_warmup_complete")
+                warm = warm_v is None or warm_v >= 1.0
+                drain_v = _metric_sample(
+                    metrics, "pio_server_draining"
+                )
+                draining = draining or (
+                    drain_v is not None and drain_v >= 1.0
+                )
+        except (OSError, ValueError):
+            replica.probe_failures += 1
+            replica.last_probe = "unreachable"
+            if (
+                replica.probe_failures >= self._unhealthy_after
+                and replica.state in (HEALTHY, DRAINING)
+            ):
+                self._set_state(replica, UNHEALTHY)
+            return
+        replica.probe_failures = 0
+        if draining:
+            replica.last_probe = "draining"
+            # the replica itself says draining (SIGTERM landed on it):
+            # stop routing, but an ADMIN-initiated drain stays sticky
+            if replica.state in (HEALTHY, WARMING, UNHEALTHY):
+                self._set_state(replica, DRAINING)
+            return
+        replica.last_probe = "ok" if warm else "cold"
+        if (
+            warm
+            and not replica.admin_draining
+            and replica.state in (WARMING, UNHEALTHY, DRAINING)
+        ):
+            # DRAINING→HEALTHY covers a replica that reported draining
+            # because its OLD process was exiting and a fresh process
+            # now answers ok on the same port (kill + respawn in
+            # place). Admin-initiated drains are sticky: the ROUTER
+            # decided to drain, so a still-answering process must not
+            # probe its way back into the pool mid-retire.
+            self._set_state(replica, HEALTHY)
+
+    def _set_state(self, replica: Replica, state: str) -> None:
+        with self._lock:
+            if replica.state == RETIRED:
+                return
+            if state == HEALTHY and replica.admin_draining:
+                # the probe read admin_draining BEFORE retire() set it
+                # (its check runs outside this lock): rechecking here
+                # keeps the sticky drain sticky — a readmission racing
+                # a retire must lose
+                return
+            previous, replica.state = replica.state, state
+        self._healthy_gauge.labels(replica.replica_id).set(
+            1 if state == HEALTHY else 0
+        )
+        if previous != state:
+            log_json(
+                logger,
+                logging.WARNING if state == UNHEALTHY else logging.INFO,
+                "router_replica_state",
+                replica=replica.replica_id,
+                previous=previous, state=state,
+            )
+
+    # -- selection ---------------------------------------------------------
+    def _candidates(self, affinity_key: bytes, exclude: set[str]):
+        """Healthy replicas in selection order: recovering breakers
+        first (their ``allow()`` is the half-open probe — skipping them
+        would strand an open breaker forever behind healthier peers),
+        then least-inflight with the consistent-hash ring breaking
+        ties."""
+        with self._lock:
+            pool = [
+                r
+                for r in self._replicas.values()
+                if r.state == HEALTHY and r.replica_id not in exclude
+            ]
+        if not pool:
+            return []
+        recovering = [r for r in pool if r.breaker.state != resilience.CLOSED]
+        closed = [r for r in pool if r.breaker.state == resilience.CLOSED]
+        ordered: list[Replica] = sorted(
+            recovering, key=lambda r: r.inflight
+        )
+        remaining = sorted(closed, key=lambda r: r.inflight)
+        while remaining:
+            least = remaining[0].inflight
+            tied = [r for r in remaining if r.inflight == least]
+            if len(tied) == 1:
+                pick = tied[0]
+            else:
+                pick = self._ring_pick(tied, affinity_key)
+            ordered.append(pick)
+            remaining.remove(pick)
+        return ordered
+
+    def _ring_pick(
+        self, tied: list[Replica], affinity_key: bytes
+    ) -> Replica:
+        """Consistent-hash pick among tied replicas: the first vnode at
+        or after the key's point on the ring. Stable as replicas come
+        and go — only ~1/N of the key space remaps per change. The
+        merged ring per tied-id set is cached (ids only, so a cached
+        entry cannot pin a retired Replica): the steady state — every
+        replica idle, all tied — costs one key hash + one bisect per
+        request, not a ring rebuild."""
+        key = tuple(sorted(r.replica_id for r in tied))
+        ring = self._ring_cache.get(key)
+        if ring is None:
+            merged = sorted(
+                (point, r.replica_id)
+                for r in tied
+                for point in r.ring_points
+            )
+            ring = ([p for p, _ in merged], [rid for _, rid in merged])
+            if len(self._ring_cache) >= 64:
+                self._ring_cache.clear()  # membership churn: start over
+            self._ring_cache[key] = ring
+        points, ids = ring
+        by_id = {r.replica_id: r for r in tied}
+        idx = bisect.bisect_left(points, _hash64(affinity_key))
+        return by_id[ids[idx % len(ids)]]
+
+    def _acquire(
+        self, affinity_key: bytes, exclude: set[str]
+    ) -> Replica | None:
+        """The selected replica with its breaker slot held (the caller
+        MUST record success/failure/release on ``replica.breaker``)."""
+        for replica in self._candidates(affinity_key, exclude):
+            if replica.breaker.allow():
+                return replica
+        return None
+
+    # -- proxying ----------------------------------------------------------
+    def _affinity_key(self, request: Request) -> bytes:
+        explicit = request.headers.get(AFFINITY_HEADER)
+        if explicit:
+            return explicit.encode("utf-8", "replace")
+        if request.body:
+            return request.body
+        return (getattr(request, "client_addr", "") or "").encode()
+
+    def _proxy(self, request: Request) -> Response:
+        deadline = resilience.get_deadline()
+        affinity_key = self._affinity_key(request)
+        tried: set[str] = set()
+        attempts = 1 + self._failover_retries
+        last_failure: str | None = None
+        parent = tracing.current_span()
+        for attempt in range(attempts):
+            if deadline is not None and deadline.expired:
+                raise resilience.DeadlineExceeded(
+                    "budget exhausted routing to a replica"
+                )
+            replica = self._acquire(affinity_key, tried)
+            if replica is None:
+                break
+            if last_failure is not None:
+                # a sibling IS taking over the failed attempt's work —
+                # this, not the failure itself, is the failover
+                self._failovers_total.inc()
+                log_json(
+                    logger, logging.WARNING, "router_failover",
+                    to=replica.replica_id, error=last_failure,
+                )
+            tried.add(replica.replica_id)
+            span_cm = (
+                self._tracer.child(
+                    parent,
+                    f"router/forward {replica.replica_id}",
+                    attributes={
+                        "replica": replica.replica_id,
+                        "attempt": attempt,
+                    },
+                )
+                if parent is not None and self._tracer.enabled
+                else tracing.NOOP
+            )
+            replica.begin()
+            try:
+                with span_cm as span:
+                    outcome = self._forward(
+                        replica, request, deadline, span
+                    )
+            except BaseException:
+                # _forward pairs the breaker verdict with every normal
+                # outcome; anything escaping it produced none — release
+                # so a half-open probe slot cannot wedge
+                replica.breaker.release()
+                raise
+            finally:
+                replica.end()
+            if isinstance(outcome, Response):
+                return outcome
+            # transport error or retryable 5xx
+            last_failure = outcome
+            if attempt + 1 >= attempts or (
+                deadline is not None and deadline.expired
+            ):
+                break
+        if last_failure is not None:
+            # every allowed attempt failed — a gateway error the client
+            # may retry (the replicas themselves stayed consistent)
+            raise HTTPError(502, f"all routed replicas failed: {last_failure}")
+        states = set(self.replica_states().values())
+        if states and states <= {DRAINING, RETIRED}:
+            return Response(
+                503,
+                {"message": "all replicas are draining; retry shortly"},
+                headers={"Retry-After": "1"},
+            )
+        return Response(
+            503,
+            {
+                "message": "no healthy replica available"
+                + (" (all tried)" if tried else "")
+            },
+            headers={"Retry-After": "1"},
+        )
+
+    def _forward(
+        self,
+        replica: Replica,
+        request: Request,
+        deadline: resilience.Deadline | None,
+        span,
+    ) -> Response | str:
+        """One proxied attempt. Returns the upstream Response (success
+        — including 4xx/504, which are the replica ANSWERING), or an
+        error string when the attempt is failover-eligible (transport
+        error / retryable 5xx)."""
+        url = replica.url + request.path
+        req = urllib.request.Request(
+            url, data=request.body, method=request.method
+        )
+        ctype = request.headers.get("Content-Type")
+        req.add_header("Content-Type", ctype or "application/json")
+        if request.request_id:
+            req.add_header("X-Request-ID", request.request_id)
+        # nest the replica's root span under the forward span (or the
+        # router's root when tracing the forward itself is disabled)
+        parent = span if span is not None else tracing.current_span()
+        if parent is not None:
+            req.add_header(tracing.PARENT_SPAN_HEADER, parent.span_id)
+        timeout = self._proxy_timeout_s
+        if deadline is not None:
+            # reserve a slice of budget for one failover hop, and
+            # re-mint the header from what is left NOW so the budget
+            # decrements across the router hop
+            hop = deadline.reserved(
+                min(1.0, self._proxy_timeout_s / 4.0)
+            )
+            req.add_header(resilience.DEADLINE_HEADER, hop.to_header())
+            timeout = hop.cap(timeout)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                body = resp.read()
+                status = resp.status
+                resp_ctype = resp.headers.get(
+                    "Content-Type", "application/json"
+                )
+        except urllib.error.HTTPError as e:
+            body = e.read()
+            status = e.code
+            resp_ctype = e.headers.get("Content-Type", "application/json")
+        except OSError as e:
+            replica.breaker.record_failure()
+            self._requests_total.labels(replica.replica_id, "error").inc()
+            if span is not None:
+                span.set("error", str(e))
+            return f"{replica.replica_id}: {e}"
+        self._requests_total.labels(
+            replica.replica_id, str(status)
+        ).inc()
+        if span is not None:
+            span.set("status", status)
+        if status >= 500 and status != 504:
+            replica.breaker.record_failure()
+            return f"{replica.replica_id}: HTTP {status}"
+        # 2xx/4xx — and 504, the replica answering about an expired
+        # budget — are verdicts of health, not failure
+        replica.breaker.record_success()
+        return Response(status, body, content_type=resp_ctype)
+
+    # -- rolling swap ------------------------------------------------------
+    def rolling_swap(
+        self,
+        url: str,
+        generation: str,
+        replica_id: str | None = None,
+        pid: int | None = None,
+        retire: str | list[str] = "others",
+        warm_timeout_s: float = 120.0,
+        wait: bool = False,
+    ) -> dict:
+        """Roll the pool to a new model generation without dropping a
+        request: register ``url`` WARMING, admit it once healthy AND
+        warm (``pio_warmup_complete=1``), then drain the old replicas
+        (``retire="others"`` = every active replica of a different
+        generation; or an explicit id list). Runs in the background
+        unless ``wait=True``; progress lands in the returned record
+        (also served at ``GET /admin/swap/<id>``)."""
+        new_replica = self.add_replica(
+            url, replica_id=replica_id, generation=generation, pid=pid
+        )
+        swap_id = f"swap-{uuid.uuid4().hex[:8]}"
+        record = {
+            "id": swap_id,
+            "phase": "warming",
+            "generation": generation,
+            "url": url,
+            "replica": new_replica.replica_id,
+            "retired": [],
+            "error": None,
+        }
+        with self._lock:
+            self._swaps[swap_id] = record
+            while len(self._swaps) > 20:
+                oldest = next(iter(self._swaps))
+                if oldest == swap_id:
+                    break
+                self._swaps.pop(oldest)
+
+        def _run():
+            deadline = time.monotonic() + warm_timeout_s
+            while time.monotonic() < deadline and not self._closed.is_set():
+                if new_replica.state == HEALTHY:
+                    break
+                time.sleep(self._drain_poll_s)
+            if new_replica.state != HEALTHY:
+                record["phase"] = "failed"
+                record["error"] = (
+                    f"new replica never became healthy+warm within "
+                    f"{warm_timeout_s}s (state={new_replica.state}, "
+                    f"lastProbe={new_replica.last_probe})"
+                )
+                self._swaps_total.labels("failed").inc()
+                # the old generation keeps serving; pull the dud out
+                self.retire(new_replica.replica_id, wait=True)
+                return
+            record["phase"] = "draining-old"
+            if retire == "others":
+                with self._lock:
+                    victims = [
+                        rid
+                        for rid, r in self._replicas.items()
+                        if rid != new_replica.replica_id
+                        and r.generation != generation
+                    ]
+            else:
+                victims = list(retire)
+            # drain victims one at a time: capacity never drops by more
+            # than one replica mid-swap
+            for rid in victims:
+                if self.retire(rid, wait=True):
+                    record["retired"].append(rid)
+            record["phase"] = "done"
+            self._swaps_total.labels("ok").inc()
+            log_json(
+                logger, logging.INFO, "router_swap_done",
+                swap=swap_id, generation=generation,
+                retired=record["retired"],
+            )
+
+        if wait:
+            _run()
+        else:
+            threading.Thread(
+                target=_run, name=f"pio-router-{swap_id}", daemon=True
+            ).start()
+        return record
+
+    # -- routes ------------------------------------------------------------
+    def _status(self, request: Request) -> Response:
+        with self._lock:
+            replicas = [r.to_dict() for r in self._replicas.values()]
+        return Response(
+            200,
+            {
+                "status": "alive",
+                "service": "router",
+                "pid": os.getpid(),
+                "startTime": self._start_time,
+                "replicas": replicas,
+                "generations": sorted(
+                    {r["generation"] for r in replicas if r["generation"]}
+                ),
+            },
+        )
+
+    def _admin_list(self, request: Request) -> Response:
+        self._server_config.check_key(request)
+        with self._lock:
+            active = [r.to_dict() for r in self._replicas.values()]
+            retired = list(self._retired)
+        return Response(200, {"replicas": active, "retired": retired})
+
+    def _admin_register(self, request: Request) -> Response:
+        self._server_config.check_key(request)
+        body = request.json()
+        if not isinstance(body, dict) or not body.get("url"):
+            raise HTTPError(400, "body must be {'url': ..., ...}")
+        pid = body.get("pid")
+        if pid is not None and not isinstance(pid, int):
+            raise HTTPError(400, "pid must be an integer")
+        try:
+            replica = self.add_replica(
+                str(body["url"]),
+                replica_id=body.get("id"),
+                generation=str(body.get("generation", "")),
+                pid=pid,
+            )
+        except ValueError as e:
+            raise HTTPError(409, str(e)) from None
+        return Response(201, replica.to_dict())
+
+    def _admin_retire(self, request: Request) -> Response:
+        self._server_config.check_key(request)
+        rid = request.path_params["rid"]
+        if not self.retire(rid):
+            raise HTTPError(404, f"no replica {rid!r}")
+        return Response(200, {"id": rid, "state": DRAINING})
+
+    def _admin_swap(self, request: Request) -> Response:
+        self._server_config.check_key(request)
+        body = request.json()
+        if not isinstance(body, dict) or not body.get("url"):
+            raise HTTPError(
+                400, "body must be {'url': ..., 'generation': ...}"
+            )
+        pid = body.get("pid")
+        if pid is not None and not isinstance(pid, int):
+            raise HTTPError(400, "pid must be an integer")
+        retire = body.get("retire", "others")
+        if retire != "others" and not (
+            isinstance(retire, list)
+            and all(isinstance(x, str) for x in retire)
+        ):
+            raise HTTPError(400, "retire must be 'others' or a list of ids")
+        try:
+            record = self.rolling_swap(
+                str(body["url"]),
+                generation=str(body.get("generation", "")),
+                replica_id=body.get("id"),
+                pid=pid,
+                retire=retire,
+                warm_timeout_s=float(body.get("warmTimeoutS", 120.0)),
+            )
+        except ValueError as e:
+            raise HTTPError(409, str(e)) from None
+        return Response(202, record)
+
+    def _admin_swap_get(self, request: Request) -> Response:
+        self._server_config.check_key(request)
+        record = self._swaps.get(request.path_params["sid"])
+        if record is None:
+            raise HTTPError(404, "unknown swap id")
+        return Response(200, record)
+
+    # -- lifecycle ---------------------------------------------------------
+    def serve(self, host: str = "0.0.0.0", port: int = 8100) -> HTTPServer:
+        self._http = HTTPServer(
+            self.router,
+            host=host,
+            port=port,
+            server_config=self._server_config,
+            enforce_key=False,  # queries stay open; /admin/* check_key
+            service="router",
+            registry=self._registry,
+            tracer=self._tracer,
+        )
+        self._http.add_drain_hook(self.close)
+        return self._http
+
+    def close(self) -> None:
+        self._closed.set()
+        self._prober.join(timeout=5)
+
+
+def create_router(
+    replica_urls: Iterable[str] = (),
+    host: str = "0.0.0.0",
+    port: int = 8100,
+    **kwargs,
+) -> tuple[ServingRouter, HTTPServer]:
+    """Convenience: a router over ``url`` or ``url#generation``
+    strings, bound and ready to ``start()``/``serve_forever()``."""
+    router = ServingRouter(**kwargs)
+    for i, spec in enumerate(replica_urls):
+        url, _, generation = spec.partition("#")
+        router.add_replica(url, replica_id=f"r{i}", generation=generation)
+    return router, router.serve(host=host, port=port)

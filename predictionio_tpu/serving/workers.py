@@ -16,6 +16,11 @@ respawned — consecutive startup failures back off exponentially (1 s
 doubling to 30 s; a worker that served >=10 s resets the clock) —
 until the parent shuts down; SIGTERM/SIGINT tears the whole group down.
 
+The respawn machinery (:class:`WorkerSlot` + :func:`supervise_children`)
+is shared with the scale-out tier: ``scripts/router_smoke.py`` uses it
+to keep router replicas alive through SIGKILL chaos, and it is what a
+local replica supervisor should reuse (docs/scale_out.md).
+
 Caveats:
 * every worker opens storage independently — the backends must be
   multi-process-shared (sqlite/eventlog/postgres/mysql/httpstore; the
@@ -33,6 +38,7 @@ import subprocess
 import sys
 import threading
 import time
+from typing import Callable
 
 logger = logging.getLogger(__name__)
 
@@ -43,6 +49,10 @@ _RESPAWN_MAX_DELAY_S = 30.0
 #: a worker that served at least this long is considered to have been
 #: healthy — its next crash starts the backoff over
 _HEALTHY_UPTIME_S = 10.0
+#: how often the supervisor polls child liveness. Also the accuracy
+#: bound on the measured uptime: exits are NOTICED within one poll of
+#: happening, so a crash-loop cannot masquerade as healthy uptime.
+_POLL_INTERVAL_S = 0.5
 
 
 def rebuild_argv(argv: list[str], port: int) -> list[str]:
@@ -66,6 +76,106 @@ def rebuild_argv(argv: list[str], port: int) -> list[str]:
     return out + ["--port", str(port), "--workers", "1", "--reuse-port"]
 
 
+def backoff_delay_s(fails: int) -> float:
+    """Respawn delay after ``fails`` consecutive early exits (0 = the
+    worker had been healthy: respawn after the base delay)."""
+    return min(
+        _RESPAWN_DELAY_S * (2 ** max(fails - 1, 0)),
+        _RESPAWN_MAX_DELAY_S,
+    )
+
+
+class WorkerSlot:
+    """One supervised child process and its respawn-backoff state.
+
+    ``proc`` is None while the slot waits out a backoff delay
+    (respawn due at ``respawn_at`` on the supervision clock)."""
+
+    __slots__ = ("proc", "spawn", "spawned_at", "fails", "respawn_at")
+
+    def __init__(self, spawn: Callable[[], subprocess.Popen],
+                 clock: Callable[[], float] = time.monotonic,
+                 proc: subprocess.Popen | None = None):
+        self.spawn = spawn
+        #: pass ``proc`` to adopt an already-running child (the router
+        #: smoke supervises replicas it spawned earlier) instead of
+        #: spawning a fresh one
+        self.proc: subprocess.Popen | None = (
+            proc if proc is not None else spawn()
+        )
+        self.spawned_at = clock()
+        self.fails = 0
+        self.respawn_at = 0.0
+
+    @property
+    def pid(self) -> int | None:
+        return self.proc.pid if self.proc is not None else None
+
+
+def supervise_children(
+    slots: list[WorkerSlot],
+    stopping: threading.Event,
+    *,
+    clock: Callable[[], float] = time.monotonic,
+    poll_interval_s: float = _POLL_INTERVAL_S,
+) -> None:
+    """Respawn loop shared by the multi-worker front-end and the router
+    replica supervisor. Polls every slot each ``poll_interval_s``;
+    backoff waits are per-slot DEADLINES, never inline sleeps, so:
+
+    * one slot's 30 s backoff cannot blind the supervisor to a sibling
+      that crashed meanwhile — every exit is noticed within one poll;
+    * uptime is measured when the exit is NOTICED (≤ one poll after it
+      happened), so a child whose port bind succeeded but whose serve
+      loop died before ``_HEALTHY_UPTIME_S`` keeps escalating the
+      backoff instead of resetting it. The old inline-sleep shape
+      credited such a child with the supervisor's own sleep time and
+      reset the clock, turning a crash loop into a hot spin.
+
+    Returns when ``stopping`` is set.
+    """
+    while not stopping.is_set():
+        now = clock()
+        for slot in slots:
+            if slot.proc is None:
+                if now >= slot.respawn_at and not stopping.is_set():
+                    slot.proc = slot.spawn()
+                    slot.spawned_at = clock()
+                continue
+            rc = slot.proc.poll()
+            if rc is None or stopping.is_set():
+                continue
+            uptime = now - slot.spawned_at
+            slot.fails = 0 if uptime >= _HEALTHY_UPTIME_S else slot.fails + 1
+            delay = backoff_delay_s(slot.fails)
+            logger.warning(
+                "worker pid %d exited rc=%s after %.1fs; "
+                "respawning in %.1fs",
+                slot.proc.pid, rc, uptime, delay,
+            )
+            slot.proc = None
+            slot.respawn_at = now + delay
+        stopping.wait(poll_interval_s)
+
+
+def terminate_children(
+    slots: list[WorkerSlot], grace_s: float
+) -> None:
+    """SIGTERM every live child, give the group ``grace_s`` to drain,
+    then SIGKILL stragglers (the lossless-drain contract of
+    docs/robustness.md: a SIGTERM'd worker finishes its in-flight
+    requests and the current device batch before exiting)."""
+    live = [s for s in slots if s.proc is not None]
+    for slot in live:
+        slot.proc.terminate()
+    deadline = time.monotonic() + grace_s
+    for slot in live:
+        try:
+            slot.proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            slot.proc.kill()
+
+
 def serve_with_workers(
     http_server,
     n_workers: int,
@@ -76,8 +186,6 @@ def serve_with_workers(
     this process while supervising ``n_workers - 1`` re-exec'd children
     on the same port. Blocks until interrupted; returns an exit code."""
     stopping = threading.Event()
-    # per-slot state: [Popen, spawn time, consecutive startup failures]
-    children: list[list] = []
 
     def spawn() -> subprocess.Popen:
         return subprocess.Popen(
@@ -85,40 +193,15 @@ def serve_with_workers(
             + child_argv,
         )
 
-    def supervise() -> None:
-        while not stopping.is_set():
-            for slot in children:
-                proc, spawned_at, fails = slot
-                rc = proc.poll()
-                if rc is not None and not stopping.is_set():
-                    uptime = time.monotonic() - spawned_at
-                    fails = 0 if uptime >= _HEALTHY_UPTIME_S else fails + 1
-                    delay = min(
-                        _RESPAWN_DELAY_S * (2 ** max(fails - 1, 0)),
-                        _RESPAWN_MAX_DELAY_S,
-                    )
-                    logger.warning(
-                        "worker pid %d exited rc=%s after %.1fs; "
-                        "respawning in %.1fs",
-                        proc.pid, rc, uptime, delay,
-                    )
-                    stopping.wait(delay)
-                    if stopping.is_set():
-                        return  # shutdown won the race: don't spawn an
-                        # orphan the teardown loop will never see
-                    slot[0] = spawn()
-                    slot[1] = time.monotonic()
-                    slot[2] = fails
-            stopping.wait(0.5)
-
-    for _ in range(max(0, n_workers - 1)):
-        children.append([spawn(), time.monotonic(), 0])
-    if children:
+    slots = [WorkerSlot(spawn) for _ in range(max(0, n_workers - 1))]
+    if slots:
         out(
-            f"{len(children) + 1} workers sharing port {http_server.port} "
-            f"(pids {[s[0].pid for s in children]} + self)"
+            f"{len(slots) + 1} workers sharing port {http_server.port} "
+            f"(pids {[s.pid for s in slots]} + self)"
         )
-    watchdog = threading.Thread(target=supervise, daemon=True)
+    watchdog = threading.Thread(
+        target=supervise_children, args=(slots, stopping), daemon=True
+    )
     watchdog.start()
 
     # the parent serves traffic too: SIGTERM drains it like any other
@@ -134,21 +217,11 @@ def serve_with_workers(
     finally:
         stopping.set()
         # the watchdog must be parked before children are reaped — a
-        # respawn mid-teardown would orphan the new process
-        watchdog.join(timeout=_RESPAWN_MAX_DELAY_S + 1.0)
-        for slot in children:
-            slot[0].terminate()
+        # respawn mid-teardown would orphan the new process (the loop
+        # no longer sleeps out backoffs inline, so one poll suffices)
+        watchdog.join(timeout=_POLL_INTERVAL_S * 4 + 1.0)
         # children drain on SIGTERM too — give them the drain grace
         # (plus slack) before escalating to SIGKILL, or a slow device
         # batch gets cut mid-drain and the lossless contract breaks
-        deadline = (
-            time.monotonic() + resilience.drain_grace_s() + 5.0
-        )
-        for slot in children:
-            try:
-                slot[0].wait(
-                    timeout=max(0.1, deadline - time.monotonic())
-                )
-            except subprocess.TimeoutExpired:
-                slot[0].kill()
+        terminate_children(slots, resilience.drain_grace_s() + 5.0)
     return 0

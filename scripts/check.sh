@@ -56,11 +56,22 @@ if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
     rc=1
 fi
 
-echo "== serving pipeline bench (pipelined vs serial dispatch) =="
-# BENCH-format JSON lands on stdout so the perf trajectory is tracked
-if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
+echo "== serving pipeline bench (closed + open loop) =="
+# BENCH-format JSON lands on stdout AND is appended to
+# SERVING_BENCH.json (serving-bench/v1) so the perf trajectory is
+# recorded, not just printed
+if ! timeout -k 10 180 env JAX_PLATFORMS=cpu \
     python scripts/serving_bench.py --smoke; then
     echo "serving pipeline bench FAILED"
+    rc=1
+fi
+
+echo "== router smoke test (scale-out tier, docs/scale_out.md) =="
+# 2 real replicas behind the router: SIGKILL + respawn chaos, rolling
+# generation swap, one trace ID spanning router→replica→store
+if ! timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    python scripts/router_smoke.py; then
+    echo "router smoke test FAILED"
     rc=1
 fi
 

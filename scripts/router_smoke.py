@@ -1,0 +1,412 @@
+"""Scale-out smoke test: a router survives replica chaos and a rolling
+model-generation swap without dropping a request.
+
+Topology: two REAL engine-server replica processes (fake DASE pipeline,
+tests/router_replica_child.py — warmup gauges, micro-batcher, feedback
+store hop, SIGTERM drain all live) behind an in-process
+:class:`~predictionio_tpu.serving.router.ServingRouter`. The script
+proves, in order:
+
+1. admin registration is key-gated (401 without the key) and replicas
+   are admitted only after their ``pio_warmup_complete`` gauge reads 1;
+2. sustained 200s through the router while one replica is SIGKILLed
+   mid-traffic and respawned by the shared worker supervisor
+   (``serving/workers.py``) — failovers happen
+   (``pio_router_failovers_total`` > 0), errors do not, and the
+   respawned replica is readmitted once warm;
+3. a rolling generation swap (``POST /admin/swap``): the new-generation
+   replica warms before admission, the old generation drains via its
+   SIGTERM path, continuous traffic sees zero non-200s, and post-swap
+   predictions carry the new generation;
+4. one trace ID spans router → replica → store: the router's root span
+   and the replica's ``store/insert_event`` feedback span share the
+   request's trace ID across both ``/debug/traces.json`` recorders.
+
+Run by ``scripts/check.sh`` next to chaos_smoke.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# fast, deterministic knobs (read at construction — set before imports)
+os.environ["PIO_BREAKER_FAILURES"] = "2"
+os.environ["PIO_BREAKER_RESET_S"] = "0.5"
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)  # the package itself (no install required)
+
+from predictionio_tpu.serving import workers  # noqa: E402
+from predictionio_tpu.serving.config import ServerConfig  # noqa: E402
+from predictionio_tpu.serving.router import ServingRouter  # noqa: E402
+
+ADMIN_KEY = "router-smoke-key"
+CHILD = os.path.join(REPO, "tests", "router_replica_child.py")
+
+failures: list[str] = []
+
+
+def check(cond: bool, label: str) -> None:
+    print(("ok   " if cond else "FAIL ") + label, flush=True)
+    if not cond:
+        failures.append(label)
+
+
+def http_json(url, body=None, headers=None, timeout=20, method=None):
+    """(status, parsed body, response headers); no raise on 4xx/5xx."""
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(body).encode() if body is not None else None,
+        method=method or ("POST" if body is not None else "GET"),
+        headers=headers or {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read() or b"null"), resp.headers
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null"), e.headers
+
+
+def spawn_replica(generation: str, port: int = 0) -> tuple:
+    """(proc, port): a replica child, banner-parsed for its port."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYTHONUNBUFFERED"] = "1"
+    proc = subprocess.Popen(
+        [sys.executable, CHILD, "--port", str(port),
+         "--generation", generation, "--delay-ms", "10", "--feedback"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    bound: list[int] = []
+
+    def _scan():
+        for line in proc.stdout:
+            if "listening on" in line and not bound:
+                bound.append(int(line.split("pid=")[0].rsplit(":", 1)[1]))
+        # keep draining so request logs can't block the child
+
+    t = threading.Thread(target=_scan, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 120
+    while not bound and time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(f"replica {generation} died at startup")
+        time.sleep(0.1)
+    if not bound:
+        proc.kill()
+        raise RuntimeError(f"replica {generation} never printed its port")
+    return proc, bound[0]
+
+
+def wait_states(base: str, want: dict, deadline_s: float = 120) -> bool:
+    """Poll router status until every id in ``want`` has that state."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        _, status, _ = http_json(f"{base}/")
+        states = {r["id"]: r["state"] for r in status.get("replicas", [])}
+        if all(states.get(rid) == s for rid, s in want.items()):
+            return True
+        time.sleep(0.2)
+    return False
+
+
+def metric_value(base: str, name: str, **labels):
+    _, data, _ = http_json(f"{base}/metrics.json")
+    for sample in data.get(name, {}).get("samples", ()):
+        if all(sample["labels"].get(k) == v for k, v in labels.items()):
+            return sample.get("value", sample.get("count"))
+    return None
+
+
+class Traffic:
+    """Closed-loop query generators; records every outcome."""
+
+    def __init__(self, base: str, threads: int = 4):
+        self.base = base
+        self.stop = threading.Event()
+        self.outcomes: list[tuple[int, dict | None]] = []
+        self._lock = threading.Lock()
+        self._threads = [
+            threading.Thread(target=self._run, args=(i,), daemon=True)
+            for i in range(threads)
+        ]
+
+    def _run(self, seed: int) -> None:
+        i = seed
+        while not self.stop.is_set():
+            i += 1
+            try:
+                status, body, _ = http_json(
+                    f"{self.base}/queries.json", {"x": i % 100},
+                    headers={"X-PIO-Deadline": "15000"},
+                    timeout=20,
+                )
+            except OSError as e:
+                status, body = -1, {"error": str(e)}
+            with self._lock:
+                self.outcomes.append((status, body))
+
+    def start(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def finish(self) -> list:
+        self.stop.set()
+        for t in self._threads:
+            t.join(timeout=30)
+        with self._lock:
+            return list(self.outcomes)
+
+
+def main() -> int:
+    procs: dict[str, subprocess.Popen] = {}
+    stopping = threading.Event()
+    router = None
+    http = None
+    try:
+        print("starting 2 gen-1 replicas...", flush=True)
+        proc_a, port_a = spawn_replica("g1")
+        proc_b, port_b = spawn_replica("g1")
+        procs["a"], procs["b"] = proc_a, proc_b
+
+        config = ServerConfig(key_auth_enforced=True, access_key=ADMIN_KEY)
+        router = ServingRouter(
+            probe_interval_s=0.2,
+            probe_timeout_s=2.0,
+            unhealthy_after=1,
+            failover_retries=1,
+            proxy_timeout_s=20.0,
+            server_config=config,
+        )
+        http = router.serve(host="127.0.0.1", port=0)
+        http.start()
+        base = f"http://127.0.0.1:{http.port}"
+        key_hdr = {"X-PIO-Server-Key": ADMIN_KEY}
+
+        # -- 1: key-gated admin registration ------------------------------
+        status, _, _ = http_json(
+            f"{base}/admin/replicas",
+            {"url": f"http://127.0.0.1:{port_a}"},
+        )
+        check(status == 401, "admin registration without key refused 401")
+        status, _, _ = http_json(
+            f"{base}/admin/replicas",
+            {"id": "a", "url": f"http://127.0.0.1:{port_a}",
+             "generation": "g1"},
+            headers=key_hdr,
+        )
+        check(status == 201, "replica a registered via POST /admin/replicas")
+        # b is registered with its pid: the rolling swap will drain it
+        # through its own SIGTERM path
+        status, _, _ = http_json(
+            f"{base}/admin/replicas",
+            {"id": "b", "url": f"http://127.0.0.1:{port_b}",
+             "generation": "g1", "pid": proc_b.pid},
+            headers=key_hdr,
+        )
+        check(status == 201, "replica b registered (with pid)")
+        check(
+            wait_states(base, {"a": "healthy", "b": "healthy"}),
+            "both replicas admitted after warmup (healthz + "
+            "pio_warmup_complete)",
+        )
+        check(
+            metric_value(base, "pio_router_replica_healthy", replica="a")
+            == 1,
+            "pio_router_replica_healthy{replica=a} reads 1",
+        )
+
+        # -- 2: SIGKILL + respawn under sustained traffic ------------------
+        # the shared worker supervisor (serving/workers.py) adopts the
+        # running replica-a process and respawns it on the SAME port
+        slot = workers.WorkerSlot(
+            lambda: spawn_and_adopt("a-respawn", port_a, procs),
+            proc=proc_a,
+        )
+        supervisor = threading.Thread(
+            target=workers.supervise_children,
+            args=([slot], stopping),
+            kwargs={"poll_interval_s": 0.2},
+            daemon=True,
+        )
+        supervisor.start()
+
+        traffic = Traffic(base).start()
+        time.sleep(1.5)
+        print(f"SIGKILL replica a (pid {proc_a.pid})", flush=True)
+        os.kill(proc_a.pid, signal.SIGKILL)
+        time.sleep(4.0)  # traffic rides through the outage + respawn
+        outcomes = traffic.finish()
+        statuses = [s for s, _ in outcomes]
+        non200 = [o for o in outcomes if o[0] != 200]
+        check(len(outcomes) > 50, f"traffic flowed ({len(outcomes)} requests)")
+        check(
+            not non200,
+            f"zero non-200s through SIGKILL ({len(statuses)} requests, "
+            f"bad={non200[:3]})",
+        )
+        failovers = metric_value(base, "pio_router_failovers_total")
+        check(
+            (failovers or 0) > 0,
+            f"pio_router_failovers_total > 0 (={failovers})",
+        )
+        check(
+            wait_states(base, {"a": "healthy"}, deadline_s=120),
+            "killed replica respawned and readmitted once warm",
+        )
+
+        # -- 3: rolling generation swap under traffic ----------------------
+        # stop the supervisor FIRST: the swap retires the old
+        # generation, and a respawn mid-swap would fight it
+        stopping.set()
+        supervisor.join(timeout=5)
+
+        print("starting gen-2 replica for the rolling swap...", flush=True)
+        proc_c, port_c = spawn_replica("g2")
+        procs["c"] = proc_c
+        traffic = Traffic(base).start()
+        time.sleep(0.5)
+        status, swap, _ = http_json(
+            f"{base}/admin/swap",
+            {"id": "c", "url": f"http://127.0.0.1:{port_c}",
+             "generation": "g2", "pid": proc_c.pid,
+             "retire": "others"},
+            headers=key_hdr,
+        )
+        check(status == 202, "rolling swap accepted (202)")
+        swap_done = False
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            _, record, _ = http_json(
+                f"{base}/admin/swap/{swap['id']}", headers=key_hdr
+            )
+            if record.get("phase") in ("done", "failed"):
+                swap_done = record["phase"] == "done"
+                break
+            time.sleep(0.2)
+        time.sleep(0.5)  # a little post-swap traffic on the new gen
+        outcomes = traffic.finish()
+        check(swap_done, f"swap completed (phase={record.get('phase')}, "
+                         f"error={record.get('error')})")
+        non200 = [o for o in outcomes if o[0] != 200]
+        check(
+            len(outcomes) > 20 and not non200,
+            f"zero dropped/in-flight-failed requests through the swap "
+            f"({len(outcomes)} requests, bad={non200[:3]})",
+        )
+        tail_gens = {
+            (b or {}).get("generation") for _, b in outcomes[-10:]
+        }
+        check(
+            tail_gens == {"g2"},
+            f"post-swap predictions all carry generation g2 ({tail_gens})",
+        )
+        _, status_body, _ = http_json(f"{base}/")
+        active = {r["id"] for r in status_body["replicas"]}
+        check(active == {"c"}, f"old generation fully retired ({active})")
+        # replica b was drained via SIGTERM (registered pid): its
+        # process must exit cleanly on its own
+        try:
+            rc_b = proc_b.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            rc_b = None
+        check(rc_b == 0, f"drained replica b exited cleanly (rc={rc_b})")
+
+        # -- 4: one trace ID spanning router → replica → store -------------
+        trace_id = "router-smoke-trace"
+        status, out, _ = http_json(
+            f"{base}/queries.json", {"x": 42},
+            headers={"X-Request-ID": trace_id, "X-PIO-Deadline": "15000"},
+        )
+        check(
+            status == 200 and out.get("result") == 42,
+            "traced query answered by the new generation",
+        )
+        _, router_traces, _ = http_json(
+            f"{base}/debug/traces.json", headers=key_hdr
+        )
+        r_spans = [
+            s
+            for t in router_traces.get("traces", [])
+            for s in t.get("spans", [])
+            if s.get("traceId") == trace_id
+        ]
+        check(
+            any(s["name"].startswith("router ") for s in r_spans)
+            and any(s["name"].startswith("router/forward") for s in r_spans),
+            f"router recorder has root + forward spans for the trace "
+            f"({sorted(s['name'] for s in r_spans)})",
+        )
+        _, replica_traces, _ = http_json(
+            f"http://127.0.0.1:{port_c}/debug/traces.json"
+        )
+        c_spans = [
+            s
+            for t in replica_traces.get("traces", [])
+            for s in t.get("spans", [])
+            if s.get("traceId") == trace_id
+        ]
+        check(
+            any(s["name"].startswith("engine ") for s in c_spans),
+            "replica joined the same trace ID (engine root span)",
+        )
+        check(
+            any(s["name"].startswith("store/") for s in c_spans),
+            f"store hop recorded under the same trace ID "
+            f"({sorted(s['name'] for s in c_spans)})",
+        )
+    finally:
+        stopping.set()
+        if http is not None:
+            try:
+                http.shutdown()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+        if router is not None:
+            router.close()
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs.values():
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    if failures:
+        print(f"router smoke: {len(failures)} check(s) FAILED")
+        return 1
+    print("router smoke: all checks passed")
+    return 0
+
+
+def spawn_and_adopt(
+    name: str, port: int, procs: dict
+) -> subprocess.Popen:
+    """Respawn replica-a's command on its original (now-free) port and
+    track the new process for teardown."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYTHONUNBUFFERED"] = "1"
+    proc = subprocess.Popen(
+        [sys.executable, CHILD, "--port", str(port),
+         "--generation", "g1", "--delay-ms", "10", "--feedback"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    procs[name] = proc
+    return proc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

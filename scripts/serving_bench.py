@@ -10,11 +10,24 @@ with double buffering (``pipeline_depth=2``) the collector assembles
 and enqueues batch N+1 while batch N computes, so the cycle collapses
 to ~max(device, host) — the device never idles on host bookkeeping.
 
-Load is a bounded-window closed loop: one submitter keeps ``--window``
-requests in flight (done-callbacks refill the window), which saturates
-the batcher without the GIL thrash of a thread per simulated client —
-the measured delta is the pipeline's, not the harness's. Reports
-QPS/p50/p99 for both modes at load and at idle (window=1), asserting:
+Load comes in two shapes:
+
+* **closed loop** (the original): one submitter keeps ``--window``
+  requests in flight (done-callbacks refill the window), which
+  saturates the batcher without the GIL thrash of a thread per
+  simulated client — the measured delta is the pipeline's, not the
+  harness's;
+* **open loop** (``--open-rate``, on by default): requests arrive on a
+  FIXED schedule (request i at ``t0 + i/rate``) regardless of how fast
+  earlier ones complete — the shape real traffic has, and the one
+  closed loops systematically flatter (coordinated omission: a slow
+  server slows its own offered load). Reports achieved QPS and
+  p50/p95/p99 under the offered rate for both serial and pipelined
+  modes; the scale-out router's capacity claims are grounded in these
+  numbers.
+
+The closed loop reports QPS/p50/p99 for both modes at load and at idle
+(window=1), asserting:
 
 * pipelined throughput >= ``--min-speedup`` x serial (default 1.5,
   smoke 1.3) when simulated device time >= host time;
@@ -23,8 +36,12 @@ QPS/p50/p99 for both modes at load and at idle (window=1), asserting:
 
 The last stdout line is a BENCH-format JSON record
 (``{"metric": "serving_pipeline_speedup", ...}``) so the perf
-trajectory is trackable across PRs. ``--smoke`` shrinks the run for
-CI (scripts/check.sh wires it in).
+trajectory is trackable across PRs, and every run is also APPENDED to
+``SERVING_BENCH.json`` at the repo root (schema ``serving-bench/v1``:
+``{"schema": ..., "runs": [record + recordedAtUtc, ...]}``, last 100
+kept) so serving-tier scaling claims cite recorded numbers, not one-off
+stdout. ``--smoke`` shrinks the run for CI (scripts/check.sh wires it
+in); ``--out ''`` disables persistence.
 
 No jax import — this exercises the batcher pipeline itself, so it
 runs in seconds on any CPU-only runner.
@@ -135,6 +152,107 @@ def run_mode(
     }
 
 
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(len(sorted_vals) * q))
+    return sorted_vals[idx]
+
+
+def run_open_loop(
+    *, rate_qps: float, duration_s: float, pipeline_depth: int,
+    max_batch: int, max_wait_ms: float, device_ms: float,
+    enqueue_ms: float, decode_ms: float,
+) -> dict:
+    """Fixed-arrival-rate load: request i is submitted at
+    ``t0 + i/rate`` whether or not earlier requests finished, and its
+    latency is measured from its SCHEDULED time — late submission
+    (harness backpressure) counts against the server, not the clock.
+    That is the open-loop discipline closed loops can't give: achieved
+    QPS below the offered rate, or a p99 blowup, means the
+    configuration cannot sustain the load."""
+    dev = SimDevice(
+        device_ms / 1000.0, enqueue_ms / 1000.0, decode_ms / 1000.0
+    )
+    batcher = MicroBatcher(
+        TwoPhaseBatchFn(dev.dispatch, dev.collect),
+        max_batch=max_batch,
+        max_wait_ms=max_wait_ms,
+        max_queue=0,
+        pipeline_depth=pipeline_depth,
+        name=f"bench-open-depth{pipeline_depth}",
+    )
+    total = max(1, int(rate_qps * duration_s))
+    interval = 1.0 / rate_qps
+    latencies: list[float] = []
+    done = threading.Semaphore(0)
+    lock = threading.Lock()
+    t0 = time.perf_counter()
+    for i in range(total):
+        scheduled = t0 + i * interval
+        delay = scheduled - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+
+        def record(fut, scheduled=scheduled):
+            with lock:
+                latencies.append(time.perf_counter() - scheduled)
+            done.release()
+
+        batcher.submit(i).add_done_callback(record)
+    for _ in range(total):
+        done.acquire()
+    elapsed = time.perf_counter() - t0
+    batcher.close()
+    latencies.sort()
+    n = len(latencies)
+    return {
+        "offered_qps": round(rate_qps, 1),
+        "achieved_qps": round(n / elapsed, 1),
+        "p50_ms": round(_percentile(latencies, 0.50) * 1000, 3),
+        "p95_ms": round(_percentile(latencies, 0.95) * 1000, 3),
+        "p99_ms": round(_percentile(latencies, 0.99) * 1000, 3),
+        "requests": n,
+        "elapsed_s": round(elapsed, 3),
+    }
+
+
+def persist_record(record: dict, out_path: str) -> None:
+    """Append the run to the stable serving-bench trajectory file
+    (schema serving-bench/v1), mirroring how the training bench's
+    BENCH_*.json rounds persist — scaling claims cite these."""
+    import datetime as _dt
+
+    doc = {"schema": "serving-bench/v1", "runs": []}
+    try:
+        with open(out_path) as f:
+            existing = json.load(f)
+        if (
+            isinstance(existing, dict)
+            and existing.get("schema") == "serving-bench/v1"
+            and isinstance(existing.get("runs"), list)
+        ):
+            doc = existing
+    except (OSError, ValueError):
+        pass
+    doc["runs"].append(
+        {
+            "recordedAtUtc": _dt.datetime.now(
+                _dt.timezone.utc
+            ).isoformat(timespec="seconds"),
+            **record,
+        }
+    )
+    del doc["runs"][:-100]
+    try:
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+    except OSError as e:
+        print(f"serving_bench: cannot persist to {out_path}: {e}",
+              file=sys.stderr)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--smoke", action="store_true",
@@ -156,6 +274,17 @@ def main() -> int:
                     help="pipelined/serial QPS floor (default 1.5, "
                          "smoke 1.3)")
     ap.add_argument("--idle-requests", type=int, default=None)
+    ap.add_argument("--open-rate", type=float, default=None,
+                    help="open-loop offered arrival rate in QPS "
+                         "(default: 60%% of the pipelined closed-loop "
+                         "capacity; 0 disables the open-loop pass)")
+    ap.add_argument("--open-duration", type=float, default=None,
+                    help="open-loop run length in seconds "
+                         "(default 4, smoke 2)")
+    ap.add_argument("--out", default=os.path.join(
+                        REPO, "SERVING_BENCH.json"),
+                    help="append the run record to this trajectory "
+                         "file ('' disables persistence)")
     args = ap.parse_args()
 
     total = args.requests or (2000 if args.smoke else 8000)
@@ -197,6 +326,24 @@ def main() -> int:
     print(f"  idle serial   : {serial_idle}")
     print(f"  idle pipelined: {piped_idle}")
 
+    # open loop: offered load at a fraction of pipelined capacity, so
+    # the pass asserts SUSTAINED rate + tails, not peak throughput
+    open_loop = None
+    if args.open_rate is None or args.open_rate > 0:
+        rate = args.open_rate or max(100.0, piped["qps"] * 0.6)
+        duration = args.open_duration or (2.0 if args.smoke else 4.0)
+        open_serial = run_open_loop(
+            rate_qps=rate, duration_s=duration, pipeline_depth=0,
+            **common,
+        )
+        open_piped = run_open_loop(
+            rate_qps=rate, duration_s=duration,
+            pipeline_depth=args.pipeline_depth, **common,
+        )
+        print(f"  open serial   ({rate:.0f} qps offered): {open_serial}")
+        print(f"  open pipelined({rate:.0f} qps offered): {open_piped}")
+        open_loop = {"serial": open_serial, "pipelined": open_piped}
+
     speedup = piped["qps"] / serial["qps"]
     # "no worse" with room for one scheduler hiccup in the tail — the
     # p99 of an idle run is a single worst sample on a shared runner
@@ -212,6 +359,16 @@ def main() -> int:
             f"{serial_idle['p99_ms']}ms (+50%+5ms budget "
             f"{idle_budget:.1f}ms)"
         )
+    if open_loop is not None:
+        sustained = open_loop["pipelined"]["achieved_qps"]
+        offered = open_loop["pipelined"]["offered_qps"]
+        # 10% slack absorbs scheduler noise on shared CI runners; a
+        # real capacity shortfall shows up far below that
+        if sustained < offered * 0.9:
+            failures.append(
+                f"open loop: pipelined sustained {sustained} qps of "
+                f"{offered} offered (<90%)"
+            )
 
     record = {
         "metric": "serving_pipeline_speedup",
@@ -223,6 +380,7 @@ def main() -> int:
             "pipelined": piped,
             "idle_serial": {k: serial_idle[k] for k in ("p50_ms", "p99_ms")},
             "idle_pipelined": {k: piped_idle[k] for k in ("p50_ms", "p99_ms")},
+            "open_loop": open_loop,
             "params": {
                 "device_ms": args.device_ms,
                 "decode_ms": args.decode_ms,
@@ -237,6 +395,8 @@ def main() -> int:
     }
     if failures:
         record["error"] = failures
+    if args.out:
+        persist_record(record, args.out)
     print(json.dumps(record))
     if failures:
         print("serving_bench: FAILED: " + "; ".join(failures),

@@ -1,0 +1,155 @@
+"""A self-contained engine-server replica for router smoke/tests.
+
+Runs the deterministic fake DASE pipeline (fake_engine.py) behind a
+REAL :class:`~predictionio_tpu.serving.engine_server.EngineServer` —
+warmup gauges, micro-batcher, feedback store hop, SIGTERM drain — so
+the serving router can be exercised against genuine replica processes
+that can be SIGKILLed, respawned, and generation-swapped in seconds
+(memory storage; training is instant).
+
+Each prediction carries the replica's ``generation`` and ``pid`` so a
+caller can prove WHICH replica (and which model generation) answered.
+``--feedback`` stores a ``predict`` event per query, which opens a
+``store/insert_event`` child span inside the request's trace — the
+"replica → store" leg of the router's distributed-trace proof.
+
+Usage (spawned by scripts/router_smoke.py and tests):
+
+    python tests/router_replica_child.py --port 0 --generation g1 \
+        [--delay-ms 20] [--feedback] [--warmup/--no-warmup]
+
+Prints ``replica listening on 127.0.0.1:<port> pid=<pid>`` once bound.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+
+from fake_engine import (  # noqa: E402
+    FakeAlgorithm,
+    FakeDataSource,
+    FakeParams,
+    FakePreparator,
+)
+from predictionio_tpu.core import Engine, EngineParams, Serving  # noqa: E402
+from predictionio_tpu.core.workflow import run_train  # noqa: E402
+from predictionio_tpu.data.storage import App, Storage  # noqa: E402
+from predictionio_tpu.parallel.mesh import ComputeContext  # noqa: E402
+from predictionio_tpu.serving import resilience  # noqa: E402
+from predictionio_tpu.serving.engine_server import EngineServer  # noqa: E402
+
+
+def build_replica(
+    generation: str,
+    delay_ms: float = 0.0,
+    feedback: bool = False,
+    warmup: bool = True,
+    registry=None,
+) -> EngineServer:
+    """An EngineServer serving the fake pipeline, tagged with
+    ``generation``; importable in-process by tests too."""
+
+    class ReplicaAlgorithm(FakeAlgorithm):
+        def predict(self, model, query):
+            if delay_ms:
+                time.sleep(delay_ms / 1000.0)
+            q = query if isinstance(query, dict) else {}
+            return {"result": int(q.get("x", 0))}
+
+        def batch_predict(self, model, queries):
+            return [self.predict(model, q) for q in queries]
+
+    class ReplicaServing(Serving):
+        params_class = FakeParams
+
+        def serve(self, query, predictions):
+            return {
+                **predictions[0],
+                "generation": generation,
+                "pid": os.getpid(),
+            }
+
+    storage = Storage(
+        env={
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        }
+    )
+    engine = Engine(
+        FakeDataSource, FakePreparator, ReplicaAlgorithm, ReplicaServing
+    )
+    params = EngineParams(
+        data_source=("", FakeParams(id=1)),
+        preparator=("", FakeParams(id=2)),
+        algorithms=[("", FakeParams(id=3))],
+        serving=("", FakeParams()),
+    )
+    ctx = ComputeContext.create(batch=f"router-replica-{generation}")
+    run_train(
+        engine, params, engine_id="router-replica", ctx=ctx,
+        storage=storage,
+    )
+    feedback_app_id = None
+    if feedback:
+        feedback_app_id = storage.get_meta_data_apps().insert(
+            App(id=0, name="router-smoke")
+        )
+        storage.get_events().init(feedback_app_id)
+    kwargs = {}
+    if registry is not None:
+        kwargs["registry"] = registry
+    return EngineServer(
+        engine,
+        params,
+        engine_id="router-replica",
+        storage=storage,
+        ctx=ctx,
+        warmup=warmup,
+        feedback=feedback,
+        feedback_app_id=feedback_app_id,
+        max_wait_ms=1.0,
+        **kwargs,
+    )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--generation", default="g1")
+    ap.add_argument("--delay-ms", type=float, default=0.0)
+    ap.add_argument("--feedback", action="store_true")
+    ap.add_argument("--no-warmup", action="store_true")
+    args = ap.parse_args()
+
+    server = build_replica(
+        args.generation,
+        delay_ms=args.delay_ms,
+        feedback=args.feedback,
+        warmup=not args.no_warmup,
+    )
+    http = server.serve(host="127.0.0.1", port=args.port)
+    print(
+        f"replica listening on 127.0.0.1:{http.port} pid={os.getpid()}",
+        flush=True,
+    )
+    resilience.install_signal_drain(http)
+    try:
+        http.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,13 +36,16 @@ class _Script:
         return self.responses.pop(0)
 
 
-def test_worker_timeout_is_retried():
-    """The exact round-1/2 killer: first full run hangs, next succeeds."""
+def test_worker_timeout_with_live_backend_skips_reprobe():
+    """The exact round-1/2 killer: a full run hangs MID-WORKLOAD (its
+    phase tail proves the backend was up), then succeeds. The retry
+    reuses the already-proven platform — no second probe process (and
+    its second backend init) between rounds."""
     script = _Script(
         [
             _ok_probe(),
-            (None, "tpu worker timed out after 900s"),  # hang
-            _ok_probe(),
+            (None, "tpu worker timed out after 900s "
+                   "(last: [bench] round 1/3: 0.9s/epoch)"),
             _tpu_result(),
         ]
     )
@@ -53,12 +56,63 @@ def test_worker_timeout_is_retried():
     assert any("timed out" in e for e in errors)
     assert cpu_clean is None
     sides = [s for s, _ in script.calls]
-    assert sides == ["preflight", "tpu", "preflight", "tpu"]
+    assert sides == ["preflight", "tpu", "tpu"]
+    # the hang marked the run slow-init (annotated, not degraded)
+    assert result.get("slow_init") is True
+
+
+def test_worker_death_without_backend_reprobes_cheaply():
+    """A failed round with NO phase evidence of a live backend (the
+    tunnel died since it was proven) must fall back to the cheap probe
+    — not burn another (widened) full worker window on a dead host."""
+    script = _Script(
+        [
+            _ok_probe(),
+            (None, "tpu worker timed out after 900s"),  # no markers
+            (None, "preflight worker timed out after 180s"),
+            (None, "preflight worker timed out after 360s"),
+            (None, "preflight worker timed out after 720s"),
+        ]
+    )
+    result, errors, _ = bench.measure_tpu(
+        "default", run_worker=script, sleep=lambda s: None
+    )
+    assert result is None
+    sides = [s for s, _ in script.calls]
+    # every retry after the marker-less failure went back to the CHEAP
+    # probe; the expensive worker never launched again
+    assert sides == [
+        "preflight", "tpu", "preflight", "preflight", "preflight"
+    ]
+
+
+def test_worker_timeout_widens_next_window():
+    """A timed-out full worker doubles the next round's timeout (the
+    slow-platform fall-forward), bounded by the remaining budget."""
+    script = _Script(
+        [
+            _ok_probe(),
+            (None, "tpu worker timed out after 900s "
+                   "(last: [bench] compile+warmup done in 700.0s)"),
+            (None, "tpu worker timed out after 1800s "
+                   "(last: [bench] round 1/3: 500.0s/epoch)"),
+            _tpu_result(),
+        ]
+    )
+    result, errors, _ = bench.measure_tpu(
+        "default", run_worker=script, sleep=lambda s: None
+    )
+    assert result is not None
+    timeouts = [t for s, t in script.calls if s == "tpu"]
+    assert timeouts[0] == bench.WORKER_TIMEOUT_S
+    assert timeouts[1] > timeouts[0]
+    assert all(t <= bench.TOTAL_TPU_BUDGET_S for t in timeouts)
 
 
 def test_dead_tunnel_fails_fast_in_preflight():
-    """A wedged tunnel costs preflight timeouts (≤90s each), never the
-    900s full-workload timeout."""
+    """A wedged tunnel costs preflight timeouts, never the 900s
+    full-workload timeout — and each retry FALLS FORWARD with a wider
+    window instead of burning identical short probes."""
     script = _Script(
         [
             (None, "preflight worker timed out after 90s"),
@@ -72,7 +126,35 @@ def test_dead_tunnel_fails_fast_in_preflight():
     assert len(errors) == bench.MAX_TPU_ATTEMPTS
     # the expensive full worker never launched
     assert all(side == "preflight" for side, _ in script.calls)
-    assert all(t <= bench.PREFLIGHT_TIMEOUT_S for _, t in script.calls)
+    windows = [t for _, t in script.calls]
+    assert windows[0] == bench.PREFLIGHT_TIMEOUT_S
+    # widening, monotonic, still inside the total budget
+    assert all(b >= a for a, b in zip(windows, windows[1:]))
+    assert windows[1] == 2 * bench.PREFLIGHT_TIMEOUT_S
+    assert all(w <= bench.TOTAL_TPU_BUDGET_S for w in windows)
+
+
+def test_slow_preflight_eventually_passes_and_annotates():
+    """The r04/r05 regression: a slow-to-init platform must produce a
+    REAL TPU number annotated slow_init, not a cpu-fallback record."""
+    script = _Script(
+        [
+            (None, "preflight worker timed out after 180s"),
+            _ok_probe(),  # wider window: the platform made it up
+            _tpu_result(),
+        ]
+    )
+    result, errors, cpu_clean = bench.measure_tpu(
+        "default", run_worker=script, sleep=lambda s: None
+    )
+    assert result is not None and result["backend"] == "tpu"
+    assert result.get("slow_init") is True
+    assert cpu_clean is None
+    # the second probe got a doubled window
+    windows = [t for s, t in script.calls if s == "preflight"]
+    assert windows == [
+        bench.PREFLIGHT_TIMEOUT_S, 2 * bench.PREFLIGHT_TIMEOUT_S
+    ]
 
 
 def test_non_retryable_error_stops_immediately():

@@ -19,7 +19,17 @@ import urllib.request
 
 import pytest
 
-from predictionio_tpu.serving.workers import rebuild_argv
+import threading
+
+from predictionio_tpu.serving.workers import (
+    _HEALTHY_UPTIME_S,
+    _RESPAWN_DELAY_S,
+    _RESPAWN_MAX_DELAY_S,
+    WorkerSlot,
+    backoff_delay_s,
+    rebuild_argv,
+    supervise_children,
+)
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -43,9 +53,184 @@ class TestRebuildArgv:
             "--reuse-port",
         ]
 
+    def test_port_equals_form_only(self):
+        """`--port=N` alone (no --workers) is rewritten, not kept as a
+        stale duplicate ahead of the pinned port."""
+        out = rebuild_argv(["deploy", "--port=8000"], 8001)
+        assert out == [
+            "deploy", "--port", "8001", "--workers", "1", "--reuse-port",
+        ]
+        assert "--port=8000" not in out
+
+    def test_repeated_workers_flags_all_stripped(self):
+        out = rebuild_argv(
+            ["eventserver", "--workers", "4", "--workers=8",
+             "--workers", "2"],
+            7070,
+        )
+        assert out == [
+            "eventserver", "--port", "7070", "--workers", "1",
+            "--reuse-port",
+        ]
+
+    def test_value_that_looks_like_flag_is_consumed(self):
+        """`--workers 4 --port 0`: each option consumes ITS value even
+        when values and option names interleave."""
+        out = rebuild_argv(
+            ["deploy", "--workers", "4", "--port", "0", "--variant",
+             "e.json"],
+            9000,
+        )
+        assert out == [
+            "deploy", "--variant", "e.json",
+            "--port", "9000", "--workers", "1", "--reuse-port",
+        ]
+
     def test_existing_reuse_port_not_duplicated(self):
         out = rebuild_argv(["eventserver", "--reuse-port"], 9)
         assert out.count("--reuse-port") == 1
+
+
+class _FakeProc:
+    """Popen stand-in: scripted exit at a clock time."""
+
+    _next_pid = 1000
+
+    def __init__(self, clock, dies_at=None, rc=1):
+        _FakeProc._next_pid += 1
+        self.pid = _FakeProc._next_pid
+        self._clock = clock
+        self.dies_at = dies_at
+        self.rc = rc
+
+    def poll(self):
+        if self.dies_at is not None and self._clock() >= self.dies_at:
+            return self.rc
+        return None
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _run_supervisor_step(slots, clock, steps=1):
+    """Run supervise_children for `steps` poll iterations at the fake
+    clock's current time, then stop it."""
+    stopping = threading.Event()
+    count = {"n": 0}
+    real_wait = stopping.wait
+
+    def counting_wait(timeout=None):
+        count["n"] += 1
+        if count["n"] >= steps:
+            stopping.set()
+        return real_wait(0)
+
+    stopping.wait = counting_wait
+    supervise_children(
+        slots, stopping, clock=clock, poll_interval_s=0.0
+    )
+
+
+class TestRespawnBackoff:
+    def test_backoff_delay_escalates_and_caps(self):
+        delays = [backoff_delay_s(f) for f in range(0, 8)]
+        assert delays[0] == delays[1] == _RESPAWN_DELAY_S
+        assert delays[2] == 2 * _RESPAWN_DELAY_S
+        assert delays[3] == 4 * _RESPAWN_DELAY_S
+        assert delays[-1] == _RESPAWN_MAX_DELAY_S
+
+    def test_crash_loop_escalates_backoff(self):
+        """A child that binds then dies young keeps DOUBLING the delay;
+        a long-lived child resets it."""
+        clock = _Clock()
+        spawned = []
+
+        def spawn():
+            # each respawn dies 1s after it starts (young: < healthy)
+            proc = _FakeProc(clock, dies_at=clock.t + 1.0)
+            spawned.append(proc)
+            return proc
+
+        slot = WorkerSlot(spawn, clock=clock)
+        delays = []
+        for _ in range(5):
+            # advance to the child's death and let the supervisor see it
+            clock.t = slot.spawned_at + 1.0
+            _run_supervisor_step([slot], clock)
+            assert slot.proc is None
+            delays.append(slot.respawn_at - clock.t)
+            # advance past the respawn deadline so it respawns
+            clock.t = slot.respawn_at
+            _run_supervisor_step([slot], clock)
+            assert slot.proc is not None
+        assert delays == [1.0, 2.0, 4.0, 8.0, 16.0]
+        # now the child serves past the healthy-uptime bar: clock resets
+        slot.proc.dies_at = clock.t + _HEALTHY_UPTIME_S + 1.0
+        clock.t = slot.proc.dies_at
+        _run_supervisor_step([slot], clock)
+        assert slot.fails == 0
+        assert slot.respawn_at - clock.t == _RESPAWN_DELAY_S
+
+    def test_sibling_backoff_does_not_reset_fast_cracher(self):
+        """THE bug the old inline-sleep supervisor had: while slot A
+        waits out a 30s backoff, slot B's child binds, serves 2s, and
+        dies — B's uptime must read ~2s (escalating ITS backoff), not
+        2s + A's sleep (which reset it and turned B's crash loop into
+        a hot spin)."""
+        clock = _Clock()
+
+        def spawn_b():
+            return _FakeProc(clock, dies_at=clock.t + 2.0)
+
+        slot_a = WorkerSlot(lambda: _FakeProc(clock), clock=clock)
+        slot_b = WorkerSlot(spawn_b, clock=clock)
+        # A is already deep in backoff: respawn 30s out
+        slot_a.proc = None
+        slot_a.fails = 6
+        slot_a.respawn_at = clock.t + 30.0
+        # B dies young, repeatedly, while A waits
+        delays = []
+        for _ in range(3):
+            clock.t = slot_b.spawned_at + 2.0
+            _run_supervisor_step([slot_a, slot_b], clock)
+            assert slot_b.proc is None, "B's exit went unnoticed"
+            delays.append(slot_b.respawn_at - clock.t)
+            clock.t = slot_b.respawn_at
+            _run_supervisor_step([slot_a, slot_b], clock)
+        # escalating, never reset by A's pending backoff
+        assert delays == [1.0, 2.0, 4.0]
+        assert slot_b.fails == 3
+
+    def test_no_respawn_after_stopping(self):
+        clock = _Clock()
+        spawned = []
+
+        def spawn():
+            proc = _FakeProc(clock, dies_at=clock.t + 1.0)
+            spawned.append(proc)
+            return proc
+
+        slot = WorkerSlot(spawn, clock=clock)
+        clock.t = 2.0
+        stopping = threading.Event()
+        stopping.set()
+        supervise_children(
+            [slot], stopping, clock=clock, poll_interval_s=0.0
+        )
+        assert spawned == [slot.proc]  # nothing new spawned
+
+    def test_adopts_existing_process(self):
+        clock = _Clock()
+        existing = _FakeProc(clock)
+        slot = WorkerSlot(
+            lambda: _FakeProc(clock), clock=clock, proc=existing
+        )
+        assert slot.proc is existing
 
 
 def _get_status(port: int) -> dict:
