@@ -1,0 +1,34 @@
+"""AST-based concurrency & device-discipline analyzer (``pio-tpu
+lint``) — see docs/static_analysis.md for the rule catalog.
+
+Public surface: :func:`run_lint`, :class:`LintResult`,
+:class:`Finding`, the rule table ``RULES``, and the baseline helpers.
+Everything in this package is stdlib-only (no jax, no numpy): the gate
+runs in seconds on a bare checkout.
+"""
+
+from predictionio_tpu.analysis.baseline import (
+    BaselineEntry,
+    BaselineError,
+    load_baseline,
+    render_baseline,
+)
+from predictionio_tpu.analysis.engine import (
+    LintResult,
+    analyze_modules,
+    run_lint,
+)
+from predictionio_tpu.analysis.model import RULES, Finding, Rule
+
+__all__ = [
+    "RULES",
+    "BaselineEntry",
+    "BaselineError",
+    "Finding",
+    "LintResult",
+    "Rule",
+    "analyze_modules",
+    "load_baseline",
+    "render_baseline",
+    "run_lint",
+]

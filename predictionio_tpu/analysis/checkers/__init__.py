@@ -1,0 +1,24 @@
+"""Checker registry for ``pio-tpu lint``.
+
+Each checker is ``check(modules: list[SourceModule]) -> list[Finding]``
+over the whole file set at once, so project-wide rules (lock-order
+cycles, metric-label consistency) see everything.
+"""
+
+from __future__ import annotations
+
+from predictionio_tpu.analysis.checkers import (
+    clock,
+    device_sync,
+    locks,
+    telemetry,
+    threads,
+)
+
+ALL_CHECKERS = (
+    locks.check,
+    clock.check,
+    device_sync.check,
+    threads.check,
+    telemetry.check,
+)

@@ -1,0 +1,79 @@
+"""Driver for ``pio-tpu lint``: load sources, run every checker,
+apply suppressions, split against the baseline.
+
+Deliberately jax-free and stdlib-only: the lint gate must run in
+seconds on any checkout (CI sets it up before the heavyweight test
+deps), and importing an accelerator runtime to parse python would be
+absurd.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+from predictionio_tpu.analysis import baseline as baseline_mod
+from predictionio_tpu.analysis.checkers import ALL_CHECKERS
+from predictionio_tpu.analysis.model import Finding
+from predictionio_tpu.analysis.source import (
+    SourceModule,
+    iter_python_files,
+    load_modules,
+)
+
+
+@dataclasses.dataclass
+class LintResult:
+    new: list[Finding]
+    baselined: list[Finding]
+    stale_baseline: list[baseline_mod.BaselineEntry]
+    errors: list[str]
+    files_checked: int
+
+    @property
+    def ok(self) -> bool:
+        return not self.new and not self.errors
+
+    def all_findings(self) -> list[Finding]:
+        return sorted(self.new + self.baselined, key=Finding.sort_key)
+
+
+def analyze_modules(modules: list[SourceModule]) -> list[Finding]:
+    """Run every checker, drop suppressed findings."""
+    by_path = {m.rel_path: m for m in modules}
+    findings: list[Finding] = []
+    for checker in ALL_CHECKERS:
+        for f in checker(modules):
+            mod = by_path.get(f.path)
+            if mod is not None and mod.suppressed(f.rule, f.line):
+                continue
+            findings.append(f)
+    return sorted(findings, key=Finding.sort_key)
+
+
+def run_lint(
+    paths: list[str],
+    root: str | None = None,
+    baseline_path: str | None = None,
+) -> LintResult:
+    root = os.path.abspath(root or os.getcwd())
+    files = iter_python_files(paths)
+    modules, errors = load_modules(files, root)
+    findings = analyze_modules(modules)
+
+    entries: list[baseline_mod.BaselineEntry] = []
+    if baseline_path and os.path.exists(baseline_path):
+        try:
+            entries = baseline_mod.load_baseline(baseline_path)
+        except baseline_mod.BaselineError as e:
+            errors.append(str(e))
+    new, baselined, stale = baseline_mod.split_by_baseline(
+        findings, entries
+    )
+    return LintResult(
+        new=new,
+        baselined=baselined,
+        stale_baseline=stale,
+        errors=errors,
+        files_checked=len(modules),
+    )

@@ -1,0 +1,122 @@
+"""Finding + rule model for the ``pio-tpu lint`` static analyzer.
+
+A Finding is one rule violation at one source location. Its
+*fingerprint* deliberately excludes the line number: baselines match on
+(rule, path, enclosing qualname, normalized source text) so that
+unrelated edits above a baselined site don't resurrect it as "new".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    summary: str
+    hint: str
+
+
+#: the rule catalog — docs/static_analysis.md documents each with
+#: rationale and fix patterns; keep the two in sync
+RULES: dict[str, Rule] = {
+    r.id: r
+    for r in (
+        Rule(
+            "lock-order",
+            "lock-acquisition cycle (potential deadlock)",
+            "acquire locks in one global order, or collapse them into "
+            "a single lock",
+        ),
+        Rule(
+            "lock-blocking",
+            "blocking call while holding a lock",
+            "move the blocking call outside the critical section: "
+            "snapshot state under the lock, then block",
+        ),
+        Rule(
+            "wall-clock",
+            "wall clock (time.time) in duration/deadline arithmetic",
+            "use time.monotonic() (or serving.resilience.Deadline); "
+            "time.time() jumps under NTP steps and DST",
+        ),
+        Rule(
+            "device-sync-jit",
+            "implicit host sync / tracer leak inside a jit function",
+            "keep jit bodies device-only: return arrays and convert "
+            "on the host after the call",
+        ),
+        Rule(
+            "device-sync-hot",
+            "host sync on the enqueue-only dispatch path",
+            "batch_predict_launch/dispatch must only enqueue: return "
+            "un-fetched device arrays and pay the barrier in collect()",
+        ),
+        Rule(
+            "thread-lifecycle",
+            "thread neither daemonized nor joined",
+            "pass daemon=True (documenting the shutdown contract) or "
+            "join the thread from close()/stop()",
+        ),
+        Rule(
+            "span-leak",
+            "span opened outside a with-statement",
+            "open spans with `with tracer.trace(...)`/`tracing.span(...)` "
+            "so they close on every exit path",
+        ),
+        Rule(
+            "metric-labels",
+            "metric name registered with inconsistent label sets",
+            "register each metric name with exactly one kind and one "
+            "label tuple, project-wide",
+        ),
+    )
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    context: str  # enclosing qualname, "" at module scope
+    source: str  # stripped text of the flagged source line
+
+    @property
+    def hint(self) -> str:
+        return RULES[self.rule].hint
+
+    def fingerprint(self) -> tuple[str, str, str, str]:
+        return (self.rule, self.path, self.context, normalize(self.source))
+
+    def sort_key(self) -> tuple:
+        return (self.path, self.line, self.col, self.rule)
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "context": self.context,
+            "message": self.message,
+            "hint": self.hint,
+            "source": self.source,
+        }
+
+    def render(self) -> str:
+        where = f"{self.path}:{self.line}"
+        ctx = f" [{self.context}]" if self.context else ""
+        return (
+            f"{where}: {self.rule}{ctx}: {self.message}\n"
+            f"    {self.source}\n"
+            f"    fix: {self.hint}"
+        )
+
+
+def normalize(source_line: str) -> str:
+    """Whitespace-insensitive form used for baseline matching."""
+    return " ".join(source_line.split())

@@ -272,6 +272,91 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """AST-based concurrency & device-discipline analyzer
+    (docs/static_analysis.md): lock-order cycles, blocking calls under
+    locks, wall-clock misuse, implicit device syncs on the dispatch
+    path, thread lifecycle, telemetry hygiene. Pure stdlib — never
+    imports jax. Exit 0 = clean (baselined findings allowed), 1 = new
+    findings or unanalyzable files."""
+    from predictionio_tpu.analysis import render_baseline, run_lint
+
+    paths = args.paths or ["predictionio_tpu", "scripts"]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(
+            f"error: no such path(s): {', '.join(missing)} "
+            "(run from the repository root, or pass explicit paths)",
+            file=sys.stderr,
+        )
+        return 2
+    baseline_path = None if args.no_baseline else args.baseline
+    result = run_lint(paths, root=os.getcwd(), baseline_path=baseline_path)
+
+    if args.write_baseline:
+        for err in result.errors:
+            print(f"[ERROR] {err}", file=sys.stderr)
+        findings = result.all_findings()
+        with open(args.baseline, "w") as f:
+            f.write(render_baseline(findings))
+        print(
+            f"Wrote {len(findings)} finding(s) to {args.baseline}."
+        )
+        if result.errors:
+            # an unanalyzable file means the written baseline did NOT
+            # capture the full tree — don't let that look like success
+            print(
+                f"error: {len(result.errors)} file(s) could not be "
+                "analyzed; the baseline is incomplete",
+                file=sys.stderr,
+            )
+            return 1
+        return 0
+
+    if args.json:
+        print(json.dumps(
+            {
+                "filesChecked": result.files_checked,
+                "new": [f.to_dict() for f in result.new],
+                "baselined": [f.to_dict() for f in result.baselined],
+                "staleBaseline": [
+                    f"{e.rule}|{e.path}|{e.context}|{e.line}"
+                    for e in result.stale_baseline
+                ],
+                "errors": result.errors,
+                "ok": result.ok,
+            },
+            indent=2,
+        ))
+        return 0 if result.ok else 1
+
+    for err in result.errors:
+        print(f"[ERROR] {err}", file=sys.stderr)
+    for f in result.new:
+        print(f.render())
+    if result.stale_baseline:
+        print(
+            f"note: {len(result.stale_baseline)} baseline entr"
+            f"{'y' if len(result.stale_baseline) == 1 else 'ies'} no "
+            "longer match any finding — regenerate with "
+            "--write-baseline:",
+            file=sys.stderr,
+        )
+        for e in result.stale_baseline:
+            print(
+                f"  stale: {e.rule}|{e.path}|{e.context} "
+                f"(baseline line {e.raw_line_no})",
+                file=sys.stderr,
+            )
+    summary = (
+        f"{result.files_checked} file(s) checked: "
+        f"{len(result.new)} new finding(s), "
+        f"{len(result.baselined)} baselined"
+    )
+    print(summary)
+    return 0 if result.ok else 1
+
+
 def cmd_app(args) -> int:
     from predictionio_tpu.cli import commands
     from predictionio_tpu.data.storage import get_storage
@@ -1137,6 +1222,31 @@ def build_parser() -> argparse.ArgumentParser:
         help="server access key (servers that key-auth every route)",
     )
     p.set_defaults(func=cmd_trace)
+
+    p = sub.add_parser("lint")
+    p.add_argument(
+        "paths", nargs="*",
+        help="files/directories to analyze "
+             "(default: predictionio_tpu scripts)",
+    )
+    p.add_argument(
+        "--baseline", default="scripts/lint_baseline.txt",
+        help="baseline file of accepted pre-existing findings "
+             "(default: scripts/lint_baseline.txt)",
+    )
+    p.add_argument(
+        "--no-baseline", dest="no_baseline", action="store_true",
+        help="report every finding, ignoring the baseline",
+    )
+    p.add_argument(
+        "--write-baseline", dest="write_baseline", action="store_true",
+        help="accept all current findings into the baseline file",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="machine-readable findings on stdout",
+    )
+    p.set_defaults(func=cmd_lint)
 
     p = sub.add_parser("app")
     ap = p.add_subparsers(dest="app_command", required=True)

@@ -509,6 +509,10 @@ class MiniPGServer:
         server.auth = self._auth
         server.open_db = self.open_db
         self._server = server
+        # shutdown contract: stop() runs server.shutdown() then joins
+        # this thread; daemon=True is the backstop so an owner that
+        # exits without calling stop() (crash, test teardown skipped)
+        # cannot leave a zombie acceptor pinning the process
         self._thread = threading.Thread(
             target=server.serve_forever, name="minipg", daemon=True
         )

@@ -445,7 +445,11 @@ class MetricRegistry:
 #: telemetry-import wall clock — the uptime anchor for scrapes. (A
 #: /proc/self/stat read would be a few ms more precise but platform-
 #: bound; servers import telemetry within moments of process start.)
-_PROCESS_START_TIME = time.time()
+#: Exempt from the wall-clock lint rule: Prometheus defines
+#: process_start_time_seconds as a unix epoch — scrapers compute
+#: uptime as time() - this on THEIR clock, so a monotonic value here
+#: would be meaningless off-host.
+_PROCESS_START_TIME = time.time()  # pio-lint: disable=wall-clock -- Prometheus semantics: epoch, consumed off-host
 
 
 def _install_process_metrics(registry: MetricRegistry) -> None:

@@ -51,8 +51,12 @@ from predictionio_tpu.obs.context import ID_OK
 logger = logging.getLogger(__name__)
 
 #: the one clock: wall-clock anchor for the monotonic perf counter, so
-#: timestamps are epoch-meaningful AND nest strictly
-_EPOCH = time.time() - time.perf_counter()
+#: timestamps are epoch-meaningful AND nest strictly. Exempt from the
+#: wall-clock lint rule: time.time() is read exactly once, at import,
+#: to anchor the epoch; every duration is measured by perf_counter
+#: deltas on top of it, so an NTP step after import can never reorder
+#: or stretch spans (it only offsets all absolute timestamps equally).
+_EPOCH = time.time() - time.perf_counter()  # pio-lint: disable=wall-clock -- one-shot epoch anchor; durations use perf_counter
 
 #: header carrying the caller's span ID on outbound hops (the trace ID
 #: itself rides X-Request-ID)

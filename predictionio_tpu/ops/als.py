@@ -1470,7 +1470,11 @@ def _maybe_checkpoint(
 
 
 def _sync_scalar(arr) -> None:
-    # device→host fetch: the only reliable barrier on every platform
+    # device→host fetch: the only reliable barrier on every platform.
+    # This helper is the DELIBERATE sync point for the training loop —
+    # keep it out of jit bodies and the batch_predict_launch path,
+    # where the device-sync lint rules (docs/static_analysis.md) ban
+    # implicit barriers
     jax.device_get(arr[0, 0])
 
 

@@ -98,6 +98,10 @@ def devices_with_timeout() -> list:
         except Exception as exc:  # noqa: BLE001 - re-raised below
             error.append(exc)
 
+    # shutdown contract: joined with a timeout right below; daemon=True
+    # because a backend init wedged in the TPU transport cannot be
+    # interrupted from Python — on timeout we raise and let the process
+    # exit without waiting for it
     t = threading.Thread(target=_init, name="jax-device-init", daemon=True)
     t.start()
     t.join(timeout)

@@ -172,9 +172,28 @@ class _SnifferDispatcher:
             logger.warning("sniffer queue full; dropping notification")
 
     def close(self) -> None:
+        # shutdown contract: the sentinel lets already-queued sniffer
+        # notifications drain, and the bounded join gives them a
+        # window to finish — daemon=True remains the backstop so a
+        # wedged sniffer callback can only cost close() the timeout,
+        # never hang process exit
         self._closed = True
-        if self._thread is not None and self._thread.is_alive():
-            self._queue.put(None)
+        with self._thread_lock:
+            thread = self._thread
+        if thread is not None and thread.is_alive():
+            try:
+                # never a blocking put: with the queue full AND the
+                # drain thread wedged, close() would hang on the
+                # sentinel before ever reaching the bounded join
+                self._queue.put_nowait(None)
+            except queue.Full:
+                pass  # wedged + full — skip straight to the timed join
+            thread.join(timeout=2.0)
+            if thread.is_alive():
+                logger.warning(
+                    "sniffer thread still draining at close(); "
+                    "abandoning it (daemon)"
+                )
 
 
 class PluginContext:

@@ -229,7 +229,11 @@ class ServingRouter:
         self._ring_cache: dict[tuple, tuple[list, list]] = {}
         self._swaps: dict[str, dict] = {}
         self._closed = threading.Event()
-        self._start_time = time.time()
+        # startTime is a display epoch; uptime must come from the
+        # monotonic clock — an NTP step would otherwise make uptimeSec
+        # jump or go negative
+        self._start_time = time.time()  # pio-lint: disable=wall-clock -- display epoch only; uptime uses _start_monotonic
+        self._start_monotonic = time.monotonic()
 
         self._healthy_gauge = self._registry.gauge(
             "pio_router_replica_healthy",
@@ -812,6 +816,9 @@ class ServingRouter:
                 "service": "router",
                 "pid": os.getpid(),
                 "startTime": self._start_time,
+                "uptimeSec": round(
+                    time.monotonic() - self._start_monotonic, 3
+                ),
                 "replicas": replicas,
                 "generations": sorted(
                     {r["generation"] for r in replicas if r["generation"]}

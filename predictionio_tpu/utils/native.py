@@ -3,9 +3,15 @@
 One place owns the locate → staleness-check → compile → dlopen flow so
 the g++ invocation cannot drift between consumers (eventlog storage,
 ALS packing) and ``native/build.sh``. Compilation is concurrency-safe:
-a process-wide lock serializes threads, and g++ writes to a temp file
-that is ``os.replace``d into place, so a parallel process never dlopens
-a half-written .so (it either sees the old library or the new one).
+a per-library lock serializes builders of the *same* library, and g++
+writes to a temp file that is ``os.replace``d into place, so a parallel
+process never dlopens a half-written .so (it either sees the old
+library or the new one).
+
+The process-wide ``_lock`` guards only the two dicts and is never held
+across the g++ subprocess or dlopen (``pio-tpu lint`` lock-blocking
+rule): a multi-second compile of one library must not stall threads
+loading an already-built different one.
 """
 
 from __future__ import annotations
@@ -26,7 +32,8 @@ NATIVE_DIR = os.path.join(
 GXX_CMD = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC"]
 
 _loaded: dict[str, ctypes.CDLL] = {}
-_lock = threading.Lock()
+_build_locks: dict[str, threading.Lock] = {}
+_lock = threading.Lock()  # guards _loaded/_build_locks only
 
 
 def load_native_lib(name: str) -> ctypes.CDLL:
@@ -35,39 +42,55 @@ def load_native_lib(name: str) -> ctypes.CDLL:
     with the compiler output when the build fails, or when neither
     source nor a prebuilt library exists."""
     with _lock:
-        if name in _loaded:
-            return _loaded[name]
-        src = os.path.join(NATIVE_DIR, f"{name}.cc")
-        lib_path = os.path.join(NATIVE_DIR, f"libpio_{name}.so")
-        have_src = os.path.exists(src)
-        if not have_src and not os.path.exists(lib_path):
-            raise RuntimeError(
-                f"native sources not found at {src}; this feature needs "
-                f"the repo's native/ directory (or a prebuilt "
-                f"lib{name}.so)"
-            )
-        stale = have_src and (
-            not os.path.exists(lib_path)
-            or os.path.getmtime(src) > os.path.getmtime(lib_path)
-        )
-        if stale:
-            fd, tmp = tempfile.mkstemp(
-                prefix=f".lib{name}.", suffix=".so", dir=NATIVE_DIR
-            )
-            os.close(fd)
-            try:
-                subprocess.run(
-                    [*GXX_CMD, "-o", tmp, src],
-                    check=True, capture_output=True, text=True,
-                )
-                os.replace(tmp, lib_path)  # atomic swap
-            except subprocess.CalledProcessError as e:
-                raise RuntimeError(
-                    f"building lib{name}.so failed:\n{e.stderr}"
-                ) from e
-            finally:
-                if os.path.exists(tmp):
-                    os.unlink(tmp)
-        lib = ctypes.CDLL(lib_path)
-        _loaded[name] = lib
+        lib = _loaded.get(name)
+        if lib is not None:
+            return lib
+        build_lock = _build_locks.setdefault(name, threading.Lock())
+    with build_lock:
+        # double-check: the thread we serialized behind may have
+        # finished this exact library
+        with _lock:
+            lib = _loaded.get(name)
+            if lib is not None:
+                return lib
+        lib = _build_and_load(name)
+        with _lock:
+            _loaded[name] = lib
         return lib
+
+
+def _build_and_load(name: str) -> ctypes.CDLL:
+    """Compile-if-stale + dlopen; caller holds the per-name build lock
+    (and NOT the registry lock — this blocks for seconds under g++)."""
+    src = os.path.join(NATIVE_DIR, f"{name}.cc")
+    lib_path = os.path.join(NATIVE_DIR, f"libpio_{name}.so")
+    have_src = os.path.exists(src)
+    if not have_src and not os.path.exists(lib_path):
+        raise RuntimeError(
+            f"native sources not found at {src}; this feature needs "
+            f"the repo's native/ directory (or a prebuilt "
+            f"lib{name}.so)"
+        )
+    stale = have_src and (
+        not os.path.exists(lib_path)
+        or os.path.getmtime(src) > os.path.getmtime(lib_path)
+    )
+    if stale:
+        fd, tmp = tempfile.mkstemp(
+            prefix=f".lib{name}.", suffix=".so", dir=NATIVE_DIR
+        )
+        os.close(fd)
+        try:
+            subprocess.run(
+                [*GXX_CMD, "-o", tmp, src],
+                check=True, capture_output=True, text=True,
+            )
+            os.replace(tmp, lib_path)  # atomic swap
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"building lib{name}.so failed:\n{e.stderr}"
+            ) from e
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    return ctypes.CDLL(lib_path)

@@ -14,6 +14,19 @@ cd "$(dirname "$0")/.."
 
 rc=0
 
+echo "== pio-tpu lint (static analysis gate, docs/static_analysis.md) =="
+# AST-based concurrency/device-discipline analyzer: lock-order cycles,
+# blocking-under-lock, wall-clock misuse, device syncs on the dispatch
+# path, thread lifecycle, telemetry hygiene. Pure stdlib (no jax), so
+# it runs first and fails fast; findings outside
+# scripts/lint_baseline.txt are NEW and block the gate.
+if ! timeout -k 10 120 python -m predictionio_tpu.cli.main lint \
+    predictionio_tpu scripts; then
+    echo "pio-tpu lint FAILED (new findings — fix, suppress with a"
+    echo "reason, or accept via: pio-tpu lint --write-baseline)"
+    rc=1
+fi
+
 if [ "${1:-}" != "--smoke-only" ]; then
     echo "== tier-1 pytest (ROADMAP.md) =="
     skip_args=()

@@ -1,0 +1,1008 @@
+"""Tests for the ``pio-tpu lint`` static analyzer
+(predictionio_tpu/analysis/): per-rule positive + negative fixtures,
+suppression syntax, baseline round-trip, the seeded two-lock deadlock
+cycle, and meta-tests that the shipped baseline parses and the real
+tree is clean.
+
+Pure stdlib — no jax import anywhere on this path.
+"""
+
+from __future__ import annotations
+
+import os
+import textwrap
+
+import pytest
+
+from predictionio_tpu.analysis import (
+    BaselineError,
+    analyze_modules,
+    load_baseline,
+    render_baseline,
+    run_lint,
+)
+from predictionio_tpu.analysis.baseline import split_by_baseline
+from predictionio_tpu.analysis.source import SourceModule
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_source(src: str, path: str = "mod.py", extra: dict | None = None):
+    """Findings for one (or more) in-memory fixture modules."""
+    sources = {path: src, **(extra or {})}
+    modules = [
+        SourceModule(f"/fixture/{p}", p, textwrap.dedent(text))
+        for p, text in sources.items()
+    ]
+    return analyze_modules(modules)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# -- lock-order ------------------------------------------------------------
+
+
+class TestLockOrder:
+    def test_seeded_two_lock_cycle_detected(self):
+        """The acceptance-criteria fixture: A->B in one method, B->A in
+        another, must report a potential deadlock."""
+        findings = lint_source(
+            """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            return 1
+
+                def two(self):
+                    with self._b:
+                        with self._a:
+                            return 2
+            """
+        )
+        cycles = [f for f in findings if f.rule == "lock-order"]
+        assert len(cycles) == 1
+        assert "W._a" in cycles[0].message
+        assert "W._b" in cycles[0].message
+
+    def test_cycle_via_same_module_call(self):
+        """Interprocedural: two() holds _b and calls helper(), which
+        acquires _a — closes the cycle against one()."""
+        findings = lint_source(
+            """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            return 1
+
+                def two(self):
+                    with self._b:
+                        self.helper()
+
+                def helper(self):
+                    with self._a:
+                        return 2
+            """
+        )
+        assert "lock-order" in rules_of(findings)
+
+    def test_consistent_order_is_clean(self):
+        findings = lint_source(
+            """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            return 1
+
+                def two(self):
+                    with self._a:
+                        with self._b:
+                            return 2
+            """
+        )
+        assert "lock-order" not in rules_of(findings)
+
+    def test_nonreentrant_self_cycle(self):
+        """with self._lock: self.locked_helper() where the helper
+        re-acquires the same plain Lock = guaranteed deadlock."""
+        findings = lint_source(
+            """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def outer(self):
+                    with self._lock:
+                        self.inner()
+
+                def inner(self):
+                    with self._lock:
+                        return 1
+            """
+        )
+        assert "lock-order" in rules_of(findings)
+
+    def test_rlock_reentry_is_clean(self):
+        findings = lint_source(
+            """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def outer(self):
+                    with self._lock:
+                        self.inner()
+
+                def inner(self):
+                    with self._lock:
+                        return 1
+            """
+        )
+        assert "lock-order" not in rules_of(findings)
+
+    def test_multi_item_with_orders_left_to_right(self):
+        """`with a, b:` + `with b, a:` elsewhere is still a cycle."""
+        findings = lint_source(
+            """
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def one():
+                with A, B:
+                    return 1
+
+            def two():
+                with B, A:
+                    return 2
+            """
+        )
+        assert "lock-order" in rules_of(findings)
+
+
+# -- lock-blocking ---------------------------------------------------------
+
+
+class TestLockBlocking:
+    def test_sleep_under_lock(self):
+        findings = lint_source(
+            """
+            import threading
+            import time
+
+            _lock = threading.Lock()
+
+            def f():
+                with _lock:
+                    time.sleep(1)
+            """
+        )
+        assert "lock-blocking" in rules_of(findings)
+
+    def test_future_result_under_lock(self):
+        findings = lint_source(
+            """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def f(self, future):
+                    with self._lock:
+                        return future.result(timeout=5)
+            """
+        )
+        assert "lock-blocking" in rules_of(findings)
+
+    def test_device_barrier_under_lock(self):
+        findings = lint_source(
+            """
+            import threading
+            import jax
+
+            _lock = threading.Lock()
+
+            def f(x):
+                with _lock:
+                    return jax.device_get(x)
+            """
+        )
+        assert "lock-blocking" in rules_of(findings)
+
+    def test_interprocedural_blocking_callee(self):
+        findings = lint_source(
+            """
+            import threading
+            import time
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def f(self):
+                    with self._lock:
+                        self.slow()
+
+                def slow(self):
+                    time.sleep(2)
+            """
+        )
+        blocked = [f for f in findings if f.rule == "lock-blocking"]
+        assert any("slow" in f.message for f in blocked)
+
+    def test_sleep_outside_lock_is_clean(self):
+        findings = lint_source(
+            """
+            import threading
+            import time
+
+            _lock = threading.Lock()
+
+            def f():
+                with _lock:
+                    snapshot = 1
+                time.sleep(snapshot)
+            """
+        )
+        assert "lock-blocking" not in rules_of(findings)
+
+    def test_unbounded_queue_put_is_clean_bounded_get_flags(self):
+        findings = lint_source(
+            """
+            import queue
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue()
+                    self._bq = queue.Queue(maxsize=8)
+
+                def ok(self, item):
+                    with self._lock:
+                        self._q.put(item)
+
+                def bad(self):
+                    with self._lock:
+                        return self._bq.get()
+            """
+        )
+        blocked = [f for f in findings if f.rule == "lock-blocking"]
+        assert len(blocked) == 1
+        assert ".get()" in blocked[0].message
+
+    def test_str_join_and_dict_get_are_clean(self):
+        findings = lint_source(
+            """
+            import threading
+
+            _lock = threading.Lock()
+
+            def f(d):
+                with _lock:
+                    return ", ".join(d) + str(d.get("k"))
+            """
+        )
+        assert "lock-blocking" not in rules_of(findings)
+
+    def test_blocking_in_except_handler_reported_once(self):
+        """Handler bodies are reachable two ways in the walker — the
+        finding must still be reported exactly once (duplicates would
+        double-count in the baseline and CI summary)."""
+        findings = lint_source(
+            """
+            import threading
+            import time
+
+            _lock = threading.Lock()
+
+            def f():
+                with _lock:
+                    try:
+                        work()
+                    except ValueError:
+                        time.sleep(1)
+            """
+        )
+        blocked = [f for f in findings if f.rule == "lock-blocking"]
+        assert len(blocked) == 1
+
+    def test_condition_wait_releases_its_own_lock(self):
+        findings = lint_source(
+            """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._cond = threading.Condition()
+
+                def f(self):
+                    with self._cond:
+                        self._cond.wait(timeout=1)
+            """
+        )
+        assert "lock-blocking" not in rules_of(findings)
+
+
+# -- wall-clock ------------------------------------------------------------
+
+
+class TestWallClock:
+    def test_elapsed_arithmetic_flagged(self):
+        findings = lint_source(
+            """
+            import time
+
+            def f(t0):
+                return time.time() - t0
+            """
+        )
+        assert "wall-clock" in rules_of(findings)
+
+    def test_deadline_comparison_flagged(self):
+        findings = lint_source(
+            """
+            import time
+
+            def f(deadline):
+                while time.time() < deadline:
+                    pass
+            """
+        )
+        assert "wall-clock" in rules_of(findings)
+
+    def test_anchor_assignment_flagged(self):
+        findings = lint_source(
+            """
+            import time
+
+            class S:
+                def __init__(self):
+                    self._start_time = time.time()
+            """
+        )
+        assert "wall-clock" in rules_of(findings)
+
+    def test_backoff_function_flagged(self):
+        findings = lint_source(
+            """
+            import time
+
+            def next_backoff():
+                return time.time()
+            """
+        )
+        assert "wall-clock" in rules_of(findings)
+
+    def test_display_timestamp_is_clean(self):
+        """A log-record ts field is display-only wall clock — fine."""
+        findings = lint_source(
+            """
+            import time
+
+            def log_record(event):
+                return {"event": event, "ts": round(time.time(), 3)}
+            """
+        )
+        assert "wall-clock" not in rules_of(findings)
+
+    def test_monotonic_is_clean(self):
+        findings = lint_source(
+            """
+            import time
+
+            def f(t0):
+                return time.monotonic() - t0
+            """
+        )
+        assert "wall-clock" not in rules_of(findings)
+
+
+# -- device-sync -----------------------------------------------------------
+
+
+class TestDeviceSync:
+    def test_item_inside_jit(self):
+        findings = lint_source(
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return x.sum().item()
+            """
+        )
+        assert "device-sync-jit" in rules_of(findings)
+
+    def test_float_of_traced_value_inside_jit(self):
+        findings = lint_source(
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                y = x * 2
+                return float(y)
+            """
+        )
+        assert "device-sync-jit" in rules_of(findings)
+
+    def test_float_of_host_closure_is_clean(self):
+        """float(max(n, 1)) on a host closure value inside jit is fine
+        (the complementarypurchase lift scaling pattern)."""
+        findings = lint_source(
+            """
+            import jax
+
+            n_baskets = 10
+
+            @jax.jit
+            def f(x):
+                return x * float(max(n_baskets, 1))
+            """
+        )
+        assert "device-sync-jit" not in rules_of(findings)
+
+    def test_partial_jit_decorator_np_asarray(self):
+        findings = lint_source(
+            """
+            import functools
+            import jax
+            import numpy as np
+
+            @functools.partial(jax.jit, static_argnums=(1,))
+            def f(x, k):
+                return np.asarray(x)
+            """
+        )
+        assert "device-sync-jit" in rules_of(findings)
+
+    def test_call_form_jit_detected(self):
+        """ops/als.py style: ``return jax.jit(body)`` — the wrapped
+        function is jit scope even without a decorator."""
+        findings = lint_source(
+            """
+            import jax
+
+            def make_step():
+                def body(x):
+                    return x.sum().item()
+                return jax.jit(body)
+            """
+        )
+        assert "device-sync-jit" in rules_of(findings)
+
+    def test_launch_hook_device_get_flagged(self):
+        findings = lint_source(
+            """
+            import jax
+
+            class Algo:
+                def batch_predict_launch(self, queries):
+                    out = self._jitted(queries)
+                    return jax.device_get(out)
+            """
+        )
+        assert "device-sync-hot" in rules_of(findings)
+
+    def test_two_phase_dispatch_blocking_flagged(self):
+        findings = lint_source(
+            """
+            class TwoPhase:
+                def dispatch(self, items):
+                    handle = self._enqueue(items)
+                    handle.block_until_ready()
+                    return handle
+
+                def collect(self, handle):
+                    return handle
+            """
+        )
+        assert "device-sync-hot" in rules_of(findings)
+
+    def test_launch_host_prep_is_clean(self):
+        """np.asarray on host inputs is legitimate prep in launch —
+        only explicit syncs violate the enqueue-only contract."""
+        findings = lint_source(
+            """
+            import numpy as np
+
+            class Algo:
+                def batch_predict_launch(self, queries):
+                    ids = np.asarray([q["id"] for q in queries])
+                    return self._jitted(ids)
+            """
+        )
+        assert "device-sync-hot" not in rules_of(findings)
+
+    def test_plain_dispatch_without_collect_is_clean(self):
+        findings = lint_source(
+            """
+            class NotTwoPhase:
+                def dispatch(self, handler):
+                    return handler.result()
+            """
+        )
+        assert "device-sync-hot" not in rules_of(findings)
+
+
+# -- thread-lifecycle ------------------------------------------------------
+
+
+class TestThreadLifecycle:
+    def test_undaemonized_unjoined_flagged(self):
+        findings = lint_source(
+            """
+            import threading
+
+            class S:
+                def start(self):
+                    self._thread = threading.Thread(target=self._run)
+                    self._thread.start()
+            """
+        )
+        assert "thread-lifecycle" in rules_of(findings)
+
+    def test_daemon_true_is_clean(self):
+        findings = lint_source(
+            """
+            import threading
+
+            def go(fn):
+                threading.Thread(target=fn, daemon=True).start()
+            """
+        )
+        assert "thread-lifecycle" not in rules_of(findings)
+
+    def test_joined_in_close_is_clean(self):
+        findings = lint_source(
+            """
+            import threading
+
+            class S:
+                def start(self):
+                    self._thread = threading.Thread(target=self._run)
+                    self._thread.start()
+
+                def close(self):
+                    self._thread.join(timeout=5)
+            """
+        )
+        assert "thread-lifecycle" not in rules_of(findings)
+
+    def test_local_thread_joined_same_function_is_clean(self):
+        findings = lint_source(
+            """
+            import threading
+
+            def run(fn):
+                t = threading.Thread(target=fn)
+                t.start()
+                t.join()
+            """
+        )
+        assert "thread-lifecycle" not in rules_of(findings)
+
+    def test_unbound_undaemonized_flagged(self):
+        findings = lint_source(
+            """
+            import threading
+
+            def fire(fn):
+                threading.Thread(target=fn).start()
+            """
+        )
+        assert "thread-lifecycle" in rules_of(findings)
+
+
+# -- telemetry hygiene -----------------------------------------------------
+
+
+class TestTelemetry:
+    def test_span_without_with_flagged(self):
+        findings = lint_source(
+            """
+            from predictionio_tpu.obs import tracing
+
+            def f():
+                sp = tracing.span("work")
+                do_work()
+            """
+        )
+        assert "span-leak" in rules_of(findings)
+
+    def test_span_in_with_is_clean(self):
+        findings = lint_source(
+            """
+            from predictionio_tpu.obs import tracing
+
+            def f():
+                with tracing.span("work"):
+                    do_work()
+            """
+        )
+        assert "span-leak" not in rules_of(findings)
+
+    def test_span_cm_variable_pattern_is_clean(self):
+        """The http.py/router.py pattern: bind the cm (possibly via a
+        conditional expression), enter it later."""
+        findings = lint_source(
+            """
+            from predictionio_tpu.obs import tracing
+
+            def f(tracer, parent, enabled):
+                span_cm = (
+                    tracer.child(parent, "hop")
+                    if enabled
+                    else tracing.NOOP
+                )
+                with span_cm as sp:
+                    do_work(sp)
+            """
+        )
+        assert "span-leak" not in rules_of(findings)
+
+    def test_span_factory_return_is_clean(self):
+        findings = lint_source(
+            """
+            from predictionio_tpu.obs import tracing
+
+            def make(tracer, parent):
+                return tracer.child(parent, "hop")
+            """
+        )
+        assert "span-leak" not in rules_of(findings)
+
+    def test_metric_label_conflict_flagged(self):
+        extra = {
+            "b.py": """
+            from predictionio_tpu.obs.registry import default_registry
+
+            registry = default_registry()
+            c = registry.counter("pio_things_total", "things", ("kind",))
+            """
+        }
+        findings = lint_source(
+            """
+            from predictionio_tpu.obs.registry import default_registry
+
+            registry = default_registry()
+            c = registry.counter("pio_things_total", "things")
+            """,
+            path="a.py",
+            extra=extra,
+        )
+        conflicts = [f for f in findings if f.rule == "metric-labels"]
+        assert len(conflicts) == 2  # one per conflicting site
+        assert {f.path for f in conflicts} == {"a.py", "b.py"}
+
+    def test_metric_kind_conflict_flagged(self):
+        extra = {
+            "b.py": """
+            registry = get_registry()
+            g = registry.gauge("pio_depth", "depth")
+            """
+        }
+        findings = lint_source(
+            """
+            registry = get_registry()
+            c = registry.counter("pio_depth", "depth")
+            """,
+            path="a.py",
+            extra=extra,
+        )
+        assert "metric-labels" in rules_of(findings)
+
+    def test_consistent_metric_is_clean(self):
+        extra = {
+            "b.py": """
+            registry = get_registry()
+            c = registry.counter("pio_x_total", "x", ("a", "b"))
+            """
+        }
+        findings = lint_source(
+            """
+            registry = get_registry()
+            c = registry.counter("pio_x_total", "x", ("a", "b"))
+            """,
+            path="a.py",
+            extra=extra,
+        )
+        assert "metric-labels" not in rules_of(findings)
+
+
+# -- suppressions ----------------------------------------------------------
+
+
+class TestSuppressions:
+    SRC = """
+    import time
+
+    def f(t0):
+        return time.time() - t0{suffix}
+    """
+
+    def test_same_line_suppression(self):
+        findings = lint_source(
+            self.SRC.format(
+                suffix="  # pio-lint: disable=wall-clock -- test reason"
+            )
+        )
+        assert findings == []
+
+    def test_disable_next_line(self):
+        findings = lint_source(
+            """
+            import time
+
+            def f(t0):
+                # pio-lint: disable-next=wall-clock -- reason
+                return time.time() - t0
+            """
+        )
+        assert findings == []
+
+    def test_disable_file(self):
+        findings = lint_source(
+            """
+            # pio-lint: disable-file=wall-clock
+            import time
+
+            def f(t0):
+                return time.time() - t0
+            """
+        )
+        assert findings == []
+
+    def test_wrong_rule_does_not_suppress(self):
+        findings = lint_source(
+            self.SRC.format(suffix="  # pio-lint: disable=span-leak")
+        )
+        assert rules_of(findings) == ["wall-clock"]
+
+    def test_all_wildcard(self):
+        findings = lint_source(
+            self.SRC.format(suffix="  # pio-lint: disable=all")
+        )
+        assert findings == []
+
+    def test_marker_in_string_literal_is_not_a_suppression(self):
+        findings = lint_source(
+            """
+            import time
+
+            MSG = "# pio-lint: disable-file=wall-clock"
+
+            def f(t0):
+                return time.time() - t0
+            """
+        )
+        assert rules_of(findings) == ["wall-clock"]
+
+
+# -- baseline --------------------------------------------------------------
+
+
+class TestBaseline:
+    def _findings(self):
+        return lint_source(
+            """
+            import time
+
+            def f(t0):
+                return time.time() - t0
+
+            def g(t0):
+                return time.time() - t0
+            """
+        )
+
+    def test_round_trip(self, tmp_path):
+        findings = self._findings()
+        assert len(findings) == 2
+        path = tmp_path / "baseline.txt"
+        path.write_text(render_baseline(findings))
+        entries = load_baseline(str(path))
+        new, baselined, stale = split_by_baseline(findings, entries)
+        assert new == []
+        assert len(baselined) == 2
+        assert stale == []
+
+    def test_line_drift_still_matches(self, tmp_path):
+        """Baseline matching ignores line numbers: adding code above a
+        baselined site must not resurrect it."""
+        findings = self._findings()
+        path = tmp_path / "baseline.txt"
+        path.write_text(render_baseline(findings))
+        drifted = lint_source(
+            """
+            import time
+
+            x = 1
+            y = 2
+
+            def f(t0):
+                return time.time() - t0
+
+            def g(t0):
+                return time.time() - t0
+            """
+        )
+        new, baselined, _stale = split_by_baseline(
+            drifted, load_baseline(str(path))
+        )
+        assert new == []
+        assert len(baselined) == 2
+
+    def test_fixed_finding_goes_stale(self, tmp_path):
+        findings = self._findings()
+        path = tmp_path / "baseline.txt"
+        path.write_text(render_baseline(findings))
+        one_fixed = lint_source(
+            """
+            import time
+
+            def f(t0):
+                return time.monotonic() - t0
+
+            def g(t0):
+                return time.time() - t0
+            """
+        )
+        new, baselined, stale = split_by_baseline(
+            one_fixed, load_baseline(str(path))
+        )
+        assert new == []
+        assert len(baselined) == 1
+        assert len(stale) == 1
+
+    def test_multiset_matching(self, tmp_path):
+        """Two identical violations need two baseline entries — one
+        entry must not absorb both."""
+        findings = self._findings()
+        path = tmp_path / "baseline.txt"
+        # keep only ONE of the two entries
+        lines = [
+            ln
+            for ln in render_baseline(findings).splitlines()
+            if not ln.startswith("#")
+        ]
+        assert len(lines) == 2
+        path.write_text(lines[0] + "\n")
+        new, baselined, stale = split_by_baseline(
+            findings, load_baseline(str(path))
+        )
+        assert len(new) == 1
+        assert len(baselined) == 1
+        assert stale == []
+
+    def test_malformed_baseline_raises(self, tmp_path):
+        path = tmp_path / "baseline.txt"
+        path.write_text("not a baseline line\n")
+        with pytest.raises(BaselineError):
+            load_baseline(str(path))
+
+
+# -- end-to-end + meta -----------------------------------------------------
+
+
+class TestRunLintAndCli:
+    def test_run_lint_over_fixture_dir(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "import time\n\n\ndef f(t0):\n"
+            "    return time.time() - t0\n"
+        )
+        result = run_lint([str(tmp_path)], root=str(tmp_path))
+        assert result.files_checked == 1
+        assert [f.rule for f in result.new] == ["wall-clock"]
+        assert result.new[0].path == "bad.py"
+        assert not result.ok
+
+    def test_syntax_error_is_an_error_not_a_crash(self, tmp_path):
+        (tmp_path / "broken.py").write_text("def f(:\n")
+        result = run_lint([str(tmp_path)], root=str(tmp_path))
+        assert result.errors
+        assert not result.ok
+
+    def test_cli_verb_json(self, tmp_path, capsys, monkeypatch):
+        import json as _json
+
+        from predictionio_tpu.cli.main import main
+
+        (tmp_path / "bad.py").write_text(
+            "import time\ndeadline = time.time() + 5\n"
+        )
+        monkeypatch.chdir(tmp_path)
+        rc = main(["lint", "bad.py", "--no-baseline", "--json"])
+        payload = _json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert payload["ok"] is False
+        assert payload["new"][0]["rule"] == "wall-clock"
+
+    def test_cli_write_baseline_then_clean(self, tmp_path, capsys,
+                                           monkeypatch):
+        from predictionio_tpu.cli.main import main
+
+        (tmp_path / "bad.py").write_text(
+            "import time\ndeadline = time.time() + 5\n"
+        )
+        monkeypatch.chdir(tmp_path)
+        baseline = str(tmp_path / "baseline.txt")
+        assert main(["lint", "bad.py", "--baseline", baseline,
+                     "--write-baseline"]) == 0
+        assert main(["lint", "bad.py", "--baseline", baseline]) == 0
+        capsys.readouterr()
+
+    def test_cli_missing_path_is_usage_error(self, tmp_path, capsys,
+                                             monkeypatch):
+        from predictionio_tpu.cli.main import main
+
+        monkeypatch.chdir(tmp_path)
+        assert main(["lint", "nope_dir"]) == 2
+        capsys.readouterr()
+
+
+class TestRepoIsClean:
+    """Meta-tests over the real tree — the same contract CI gates on."""
+
+    def test_shipped_baseline_parses_and_is_live(self):
+        path = os.path.join(REPO_ROOT, "scripts", "lint_baseline.txt")
+        entries = load_baseline(path)  # must parse
+        result = run_lint(
+            [
+                os.path.join(REPO_ROOT, "predictionio_tpu"),
+                os.path.join(REPO_ROOT, "scripts"),
+            ],
+            root=REPO_ROOT,
+            baseline_path=path,
+        )
+        # every baseline entry still matches a real location
+        assert result.stale_baseline == [], [
+            f"{e.rule}|{e.path}|{e.context}" for e in result.stale_baseline
+        ]
+        assert len(result.baselined) == len(entries)
+
+    def test_tree_has_no_new_findings(self):
+        result = run_lint(
+            [
+                os.path.join(REPO_ROOT, "predictionio_tpu"),
+                os.path.join(REPO_ROOT, "scripts"),
+            ],
+            root=REPO_ROOT,
+            baseline_path=os.path.join(
+                REPO_ROOT, "scripts", "lint_baseline.txt"
+            ),
+        )
+        assert result.errors == []
+        assert result.new == [], "\n".join(
+            f.render() for f in result.new
+        )
