@@ -118,6 +118,48 @@ def _scale() -> str:
     return os.environ.get("PIO_BENCH_SCALE", "default")
 
 
+def serving_bench_summary() -> dict | None:
+    """The latest recorded serving-bench run (scripts/serving_bench.py
+    appends every run — including the overload-mode goodput numbers —
+    to SERVING_BENCH.json). Attached to the per-round record so the
+    driver's trajectory carries the SERVING numbers alongside the
+    training number (ROADMAP item 5), instead of them living only in a
+    repo file nobody diffs."""
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "SERVING_BENCH.json"
+    )
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        runs = doc.get("runs") or []
+        last = runs[-1]
+    except (OSError, ValueError, IndexError, AttributeError):
+        return None
+    extra = last.get("extra") or {}
+    summary = {
+        "recordedAtUtc": last.get("recordedAtUtc"),
+        "pipeline_speedup": last.get("value"),
+        "runs_recorded": len(runs),
+    }
+    open_loop = extra.get("open_loop")
+    if isinstance(open_loop, dict) and open_loop.get("pipelined"):
+        piped = open_loop["pipelined"]
+        summary["open_loop"] = {
+            k: piped.get(k)
+            for k in ("offered_qps", "achieved_qps", "p99_ms")
+        }
+    overload = extra.get("overload")
+    if isinstance(overload, dict):
+        summary["overload"] = {
+            k: overload.get(k)
+            for k in (
+                "capacity_qps", "offered_qps", "goodput_ratio",
+                "critical_p99_ms", "sheddable_shed_ratio",
+            )
+        }
+    return summary
+
+
 def make_data(scale: str):
     n_users, n_items, nnz, _rank = WORKLOADS[scale]
     rng = np.random.default_rng(42)
@@ -499,6 +541,8 @@ def main() -> None:
                 # the platform initialized slower than the base window
                 # but the measurement is REAL — annotated, not degraded
                 "slow_init": bool(result.get("slow_init")),
+                # the serving trajectory rides along (ROADMAP item 5)
+                "serving_bench": serving_bench_summary(),
             },
         }
         if errors:
@@ -532,6 +576,7 @@ def main() -> None:
                     "extra": {
                         "backend": "cpu",
                         "workload": cpu_result.get("workload"),
+                        "serving_bench": serving_bench_summary(),
                     },
                 }
             )

@@ -33,6 +33,16 @@
 #include <unordered_set>
 #include <vector>
 
+#if defined(_WIN32)
+#include <io.h>
+#define PIO_FSYNC _commit
+#define PIO_FILENO _fileno
+#else
+#include <unistd.h>
+#define PIO_FSYNC fsync
+#define PIO_FILENO fileno
+#endif
+
 namespace {
 
 struct Log {
@@ -143,10 +153,22 @@ void pio_log_close(void* handle) {
   delete log;
 }
 
-void pio_log_sync(void* handle) {
+// Durability barrier: flush stdio buffers AND fsync to stable storage.
+// Appends already fflush (kill -9 of the process loses nothing past
+// the flush — the kernel owns the pages), so this call is only needed
+// for power-loss durability; the Python wrapper gates it behind
+// PIO_EVENTLOG_FSYNC as a batch commit (once per write-lock section,
+// not per event). Returns 0 on success, -1 when any flush/fsync
+// failed (EIO, volume full) — the wrapper surfaces that instead of
+// acking a write that is not actually durable.
+int pio_log_sync(void* handle) {
   Log* log = static_cast<Log*>(handle);
-  std::fflush(log->log_file);
-  std::fflush(log->dict_file);
+  int rc = 0;
+  if (std::fflush(log->log_file) != 0) rc = -1;
+  if (std::fflush(log->dict_file) != 0) rc = -1;
+  if (PIO_FSYNC(PIO_FILENO(log->log_file)) != 0) rc = -1;
+  if (PIO_FSYNC(PIO_FILENO(log->dict_file)) != 0) rc = -1;
+  return rc;
 }
 
 // re-read dict entries appended by other processes (call under the

@@ -632,6 +632,7 @@ def cmd_deploy(args) -> int:
         max_wait_ms=args.max_wait_ms,
         pipeline_depth=args.pipeline_depth,
         adaptive_wait=not args.no_adaptive_wait,
+        admission=not args.no_admission,
     )
     multi = args.workers > 1
     if multi and (err := _reuseport_unsupported()):
@@ -722,6 +723,7 @@ def cmd_eventserver(args) -> int:
     http = create_event_server(
         host=args.ip, port=args.port, stats=args.stats,
         reuse_port=multi or args.reuse_port,
+        admission=not args.no_admission,
     )
     print(f"Event server is listening on {args.ip}:{http.port}")
     if multi:
@@ -1360,6 +1362,12 @@ def build_parser() -> argparse.ArgumentParser:
              "the next wait toward 0; idle traffic restores it)",
     )
     p.add_argument(
+        "--no-admission", dest="no_admission", action="store_true",
+        help="disable the adaptive overload controller (criticality-"
+             "aware admission + computed Retry-After; "
+             "docs/robustness.md) — equivalent to PIO_ADMISSION=0",
+    )
+    p.add_argument(
         "--workers", type=int, default=1,
         help="SO_REUSEPORT worker processes sharing the port "
              "(CPU-backend serving fronts; 1 = single process)",
@@ -1407,6 +1415,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ip", default="0.0.0.0")
     p.add_argument("--port", type=int, default=7070)
     p.add_argument("--stats", action="store_true")
+    p.add_argument(
+        "--no-admission", dest="no_admission", action="store_true",
+        help="disable the adaptive overload controller "
+             "(docs/robustness.md) — equivalent to PIO_ADMISSION=0",
+    )
     p.add_argument(
         "--workers", type=int, default=1,
         help="SO_REUSEPORT worker processes sharing the port",

@@ -15,12 +15,22 @@ breaker that fast-fails (:class:`~predictionio_tpu.serving.resilience
 .CircuitOpenError`) instead of piling timeouts onto a host that is
 down. Raised :class:`PIOClientError`\\ s carry the server-echoed
 ``X-Request-ID`` as ``request_id`` for log/trace correlation.
+
+Cooperative backpressure (docs/robustness.md "Overload &
+backpressure"): a 429/503 shed carrying ``Retry-After`` is the server
+ANSWERING — it never counts as a breaker failure — and the hint is
+honored: the retry sleeps what the server asked (inside the deadline
+budget) instead of a blind backoff. A shed guarantees the request was
+not processed, so even POSTs replay safely after one. The in-context
+criticality class (``X-PIO-Criticality``) propagates on every hop;
+:meth:`EngineClient.send_query` takes it as a keyword.
 """
 
 from __future__ import annotations
 
 import datetime as _dt
 import json
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -28,7 +38,7 @@ from typing import Any, Mapping, Sequence
 
 from predictionio_tpu.obs.context import get_request_id
 from predictionio_tpu.obs.tracing import PARENT_SPAN_HEADER, current_span
-from predictionio_tpu.serving import resilience
+from predictionio_tpu.serving import admission, resilience
 
 
 class PIOClientError(RuntimeError):
@@ -59,6 +69,11 @@ def _send_once(
     parent = current_span()
     if parent is not None:
         req.add_header(PARENT_SPAN_HEADER, parent.span_id)
+    criticality = admission.get_criticality()
+    if criticality != admission.DEFAULT:
+        # the class travels like the deadline: downstream admission
+        # sheds by the ORIGINATING caller's criticality
+        req.add_header(admission.CRITICALITY_HEADER, criticality)
     # whatever budget is left NOW rides to the server, so a retry
     # carries a smaller budget than the first attempt did
     req.add_header(resilience.DEADLINE_HEADER, deadline.to_header())
@@ -106,6 +121,33 @@ def _request(
                 message = json.loads(e.read()).get("message", "")
             except Exception:  # noqa: BLE001
                 message = ""
+            retry_after = admission.parse_retry_after(
+                e.headers.get("Retry-After") if e.headers else None
+            )
+            if e.code in (429, 503) and retry_after is not None:
+                # a shed carrying a hint is the server ANSWERING
+                # (overload, drain, or fair share) — health, not
+                # failure, for breaker purposes; tripping the breaker
+                # on sheds would blackhole a merely-busy host. Only a
+                # shed the server MARKS as refused-before-processing
+                # (X-PIO-Shed) makes a non-idempotent POST safe to
+                # replay — a bare 503 (e.g. a dependency's open
+                # breaker surfacing mid-handler) may have partially
+                # run. Honor the hinted delay when another attempt
+                # fits the budget.
+                breaker.record_success()
+                replay_safe = idempotent or bool(
+                    e.headers.get(admission.SHED_HEADER)
+                )
+                if (
+                    replay_safe
+                    and attempt + 1 < policy.max_attempts
+                    and deadline.remaining_s() > retry_after
+                ):
+                    time.sleep(retry_after)
+                    attempt += 1
+                    continue
+                raise PIOClientError(e.code, message, request_id) from e
             if e.code >= 500 and e.code != 504:
                 breaker.record_failure()
                 # retry only while the breaker stayed closed: when THIS
@@ -256,7 +298,22 @@ class EngineClient:
     def __init__(self, url: str = "http://127.0.0.1:8000"):
         self._base = url.rstrip("/")
 
-    def send_query(self, data: Mapping[str, Any], timeout: float = 30.0):
+    def send_query(
+        self,
+        data: Mapping[str, Any],
+        timeout: float = 30.0,
+        criticality: str | None = None,
+    ):
+        """``criticality`` labels the request for admission control
+        (``critical`` | ``default`` | ``sheddable``; docs/robustness.md
+        "Overload & backpressure") — under server overload the lowest
+        class sheds first."""
+        if criticality is not None:
+            with admission.criticality(criticality):
+                return _request(
+                    f"{self._base}/queries.json", "POST", dict(data),
+                    timeout,
+                )
         return _request(
             f"{self._base}/queries.json", "POST", dict(data), timeout
         )

@@ -20,8 +20,10 @@ import ctypes
 import datetime as _dt
 import fcntl
 import json
+import logging
 import os
 import struct
+import sys
 import threading
 from typing import Iterator, Sequence
 
@@ -35,6 +37,8 @@ from predictionio_tpu.data.storage.base import (
     PartialBatchError,
 )
 from predictionio_tpu.utils.bimap import BiMap
+
+logger = logging.getLogger(__name__)
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -53,6 +57,7 @@ def _load_library() -> ctypes.CDLL:
         lib.pio_log_open.restype = c.c_void_p
         lib.pio_log_open.argtypes = [c.c_char_p]
         lib.pio_log_close.argtypes = [c.c_void_p]
+        lib.pio_log_sync.restype = c.c_int  # 0 ok, -1 flush/fsync failed
         lib.pio_log_sync.argtypes = [c.c_void_p]
         lib.pio_intern.restype = c.c_uint32
         lib.pio_intern.argtypes = [c.c_void_p, c.c_char_p, c.c_uint32]
@@ -99,6 +104,19 @@ def _load_library() -> ctypes.CDLL:
 _NAN = float("nan")
 
 
+def _fsync_enabled() -> bool:
+    """``PIO_EVENTLOG_FSYNC=1`` turns appends into batch-commit fsyncs:
+    one durability barrier per write-lock section (a whole
+    ``insert_batch`` pays it once), making the durable prefix survive
+    power loss, not just process death. Default off — appends already
+    fflush, so kill -9 loses nothing; fsync is the disk-latency tax
+    for the continuous-training ingest path (ROADMAP) where replayed
+    events feed training and must not silently vanish."""
+    return os.environ.get("PIO_EVENTLOG_FSYNC", "").strip().lower() in (
+        "1", "true", "yes", "on",
+    )
+
+
 class _Log:
     """One (app, channel) log directory.
 
@@ -115,6 +133,9 @@ class _Log:
         self.handle = self.lib.pio_log_open(path.encode())
         if not self.handle:
             raise RuntimeError(f"cannot open event log at {path}")
+        # read once at open: flipping the env mid-process is not a
+        # supported way to change durability of an open log
+        self.fsync_on_commit = _fsync_enabled()
         self.lock = threading.Lock()
         self._flock_file = open(  # noqa: SIM115 - held for log lifetime
             os.path.join(path, "write.lock"), "a"
@@ -126,14 +147,39 @@ class _Log:
 
     @contextlib.contextmanager
     def write_lock(self):
-        """Thread lock + cross-process flock, dict resynced inside."""
+        """Thread lock + cross-process flock, dict resynced inside.
+        With ``PIO_EVENTLOG_FSYNC`` on, the commit point — one fsync
+        for everything appended in the section — happens before the
+        lock releases, so an insert/insert_batch that returned has its
+        events on stable storage."""
         with self.lock:
             fcntl.flock(self._flock_file, fcntl.LOCK_EX)
             try:
                 self.reload_dict()
                 yield
             finally:
-                fcntl.flock(self._flock_file, fcntl.LOCK_UN)
+                try:
+                    # sync even when the section raised mid-batch: a
+                    # PartialBatchError's acked prefix must be durable
+                    # too — clients retry only the remainder
+                    if (
+                        self.fsync_on_commit
+                        and self.lib.pio_log_sync(self.handle) != 0
+                    ):
+                        # acking a write that is not durable is worse
+                        # than an error; but never mask an exception
+                        # already propagating out of the section
+                        if sys.exc_info()[0] is None:
+                            raise OSError(
+                                "event log fsync failed; the last "
+                                "append may not be durable"
+                            )
+                        logger.error(
+                            "event log fsync failed during an already-"
+                            "failing write section"
+                        )
+                finally:
+                    fcntl.flock(self._flock_file, fcntl.LOCK_UN)
 
     def reload_dict(self) -> None:
         """Pick up dictionary entries appended by other processes."""

@@ -38,7 +38,7 @@ from typing import Any
 
 from predictionio_tpu.obs import tracing
 from predictionio_tpu.obs.context import get_request_id
-from predictionio_tpu.serving import resilience
+from predictionio_tpu.serving import admission, resilience
 from predictionio_tpu.data.storage.base import (
     AccessKey,
     AccessKeysBackend,
@@ -327,6 +327,11 @@ class HTTPStoreClient:
         rid = get_request_id()
         if rid:
             headers["X-Request-ID"] = rid
+        criticality = admission.get_criticality()
+        if criticality != admission.DEFAULT:
+            # propagated like the deadline: the store hop sheds by the
+            # ORIGINATING request's class under overload
+            headers[admission.CRITICALITY_HEADER] = criticality
         if json_body is not None:
             body = json.dumps(json_body).encode("utf-8")
             headers["Content-Type"] = "application/json"

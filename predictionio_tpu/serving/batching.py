@@ -30,6 +30,17 @@ and the host never idles on device compute.
   see :class:`TwoPhaseBatchFn`. This is the form that overlaps the
   *enqueue* of batch N+1 with the *barrier* of batch N.
 
+Overload discipline (docs/robustness.md "Overload & backpressure"):
+the wait queue is criticality- and deadline-aware. When backlog
+exceeds one batch, the most-urgent slots (nearest ``X-PIO-Deadline``)
+dispatch first so near-expiry work isn't served dead behind slack
+work; when the queue-depth bound is hit, a submission of a HIGHER
+criticality class evicts the lowest-class queued slot (shed accounting
+in ``pio_shed_total{batcher,class}``) instead of being refused, so
+``sheddable`` traffic absorbs overload before ``critical`` traffic
+feels it. :meth:`MicroBatcher.retry_after_s` turns live queue state
+into the cooperative-backpressure hint shed responses carry.
+
 Telemetry: when built with a :class:`~predictionio_tpu.obs.MetricRegistry`
 the batcher records batch occupancy, queue depth, device-dispatch time
 (now split into ``pio_device_enqueue_seconds`` and
@@ -44,6 +55,7 @@ exactly which requests rode in it.
 from __future__ import annotations
 
 import logging
+import math
 import queue
 import threading
 import time
@@ -54,7 +66,7 @@ from predictionio_tpu.obs import MetricRegistry, get_request_id
 from predictionio_tpu.obs import tracing
 from predictionio_tpu.obs.context import log_json
 from predictionio_tpu.obs.registry import LATENCY_BUCKETS, OCCUPANCY_BUCKETS
-from predictionio_tpu.serving import resilience
+from predictionio_tpu.serving import admission, resilience
 
 logger = logging.getLogger(__name__)
 
@@ -95,8 +107,9 @@ class TwoPhaseBatchFn:
 class _Slot(NamedTuple):
     """One queued submission: the payload, its Future, the submitting
     request's identity (ID + open span + submit time) for dispatch logs
-    and trace spans, and its deadline so expired work is dropped before
-    the device sees it."""
+    and trace spans, its deadline so expired work is dropped before
+    the device sees it, and its criticality class so overload evicts
+    the least-critical queued work first."""
 
     item: Any
     future: Future
@@ -104,6 +117,7 @@ class _Slot(NamedTuple):
     parent_span: Any  # tracing.Span | None
     submitted_mono: float
     deadline: Any  # resilience.Deadline | None
+    criticality: str = admission.DEFAULT
 
 
 class _Inflight(NamedTuple):
@@ -126,7 +140,7 @@ class _NullMetrics:
     def queue_depth(self, n: int) -> None:
         pass
 
-    def shed(self) -> None:
+    def shed(self, criticality: str) -> None:
         pass
 
     def dispatched(self, occupancy: int, seconds: float) -> None:
@@ -151,11 +165,12 @@ class _NullMetrics:
 class _BatcherMetrics:
     """Bound registry children for one named batcher."""
 
-    __slots__ = ("_depth", "_shed", "_occupancy", "_dispatch",
-                 "_enqueue", "_sync", "_batches", "_cancelled",
-                 "_expired", "_leaked")
+    __slots__ = ("_depth", "_shed", "_shed_class", "_name", "_occupancy",
+                 "_dispatch", "_enqueue", "_sync", "_batches",
+                 "_cancelled", "_expired", "_leaked")
 
     def __init__(self, registry: MetricRegistry, name: str):
+        self._name = name
         self._depth = registry.gauge(
             "pio_batch_queue_depth",
             "Items waiting in the micro-batch queue",
@@ -166,6 +181,13 @@ class _BatcherMetrics:
             "Submissions refused at the queue-depth bound",
             ("batcher",),
         ).labels(name)
+        self._shed_class = registry.counter(
+            "pio_shed_total",
+            "Work shed by the batcher under overload, by criticality "
+            "class (refused at the bound, or evicted by a "
+            "higher-criticality submission)",
+            ("batcher", "class"),
+        )
         self._occupancy = registry.histogram(
             "pio_batch_occupancy",
             "Queries per dispatched device batch",
@@ -220,8 +242,9 @@ class _BatcherMetrics:
     def queue_depth(self, n: int) -> None:
         self._depth.set(n)
 
-    def shed(self) -> None:
+    def shed(self, criticality: str) -> None:
         self._shed.inc()
+        self._shed_class.labels(self._name, criticality).inc()
 
     def dispatched(self, occupancy: int, seconds: float) -> None:
         self._batches.inc()
@@ -269,6 +292,16 @@ class MicroBatcher:
     ``pio_batch_cancelled_total``. Callers that abandon accepted
     futures (e.g. a partially-overloaded multi-algorithm batch slot)
     should cancel them rather than leak the dispatch.
+
+    Overload semantics: the wait queue is not strictly FIFO. When the
+    backlog exceeds ``max_batch`` at selection time, the slots with the
+    nearest deadlines dispatch first (work about to expire must not
+    rot behind slack work); arrival order breaks ties and orders
+    deadline-less slots. At the ``max_queue`` bound, a submission of a
+    strictly higher criticality class (``X-PIO-Criticality``, read
+    from the admission contextvar) evicts the lowest-class queued slot
+    — the evicted future fails with :class:`BatcherOverloaded` and the
+    shed is accounted per class in ``pio_shed_total{batcher,class}``.
     """
 
     def __init__(
@@ -309,9 +342,17 @@ class MicroBatcher:
             if registry is not None
             else _NullMetrics()
         )
-        self._queue: queue.Queue = queue.Queue()
+        #: wait queue + its condition: submit appends and notifies, the
+        #: collector selects under the same lock. One lock, never held
+        #: across dispatch or any blocking wait (Condition.wait excepted)
+        self._cv = threading.Condition()
+        self._buf: list[_Slot] = []
         self._closed = threading.Event()
-        self._submit_lock = threading.Lock()
+        #: EWMA of end-to-end batch seconds — feeds retry_after_s();
+        #: written by the settle path, read lock-free by handler threads
+        #: (a float store is atomic in CPython; a slightly stale hint is
+        #: fine)
+        self._batch_ewma_s = 0.0
         self._pipeline_depth = max(0, pipeline_depth)
         self._completer: threading.Thread | None = None
         if self._pipeline_depth > 0:
@@ -325,28 +366,35 @@ class MicroBatcher:
         self._thread.start()
 
     def submit(self, item: Any) -> Future:
-        # lock orders submit against close(): once the sentinel is queued
-        # no new item can slip in behind it (which would hang its Future)
-        with self._submit_lock:
+        # a request whose budget already ran out must not take a
+        # queue slot at all — the 504 costs nothing here but would
+        # cost a dispatch slot at flush time. Checked BEFORE the
+        # overload bound: doomed work must never trigger an eviction.
+        deadline = resilience.get_deadline()
+        criticality = admission.get_criticality()
+        victim: _Slot | None = None
+        # the cv orders submit against close(): once closed is set under
+        # it, no new slot can slip into the buffer behind the drain
+        with self._cv:
             if self._closed.is_set():
                 raise RuntimeError("batcher is closed")
-            if (
-                self._max_queue > 0
-                and self._queue.qsize() >= self._max_queue
-            ):
-                self._metrics.shed()
-                raise BatcherOverloaded(
-                    f"batch queue at capacity ({self._max_queue})"
-                )
-            # a request whose budget already ran out must not take a
-            # queue slot at all — the 504 costs nothing here but would
-            # cost a dispatch slot at flush time
-            deadline = resilience.get_deadline()
             if deadline is not None and deadline.expired:
                 self._metrics.expired(1)
                 raise resilience.DeadlineExceeded(
                     "deadline expired before batch submit"
                 )
+            if (
+                self._max_queue > 0
+                and len(self._buf) >= self._max_queue
+            ):
+                victim = self._pick_victim(criticality)
+                if victim is None:
+                    self._metrics.shed(criticality)
+                    raise BatcherOverloaded(
+                        f"batch queue at capacity ({self._max_queue})"
+                    )
+                self._buf.remove(victim)
+                self._metrics.shed(victim.criticality)
             future: Future = Future()
             # the submitting request's ID and span ride the slot so
             # dispatch logs can name the requests in a slow/failed
@@ -354,7 +402,7 @@ class MicroBatcher:
             # it coalesced. With tracing off the extra cost is exactly
             # the current_span() contextvar read (parent is None).
             parent_span = tracing.current_span()
-            self._queue.put(
+            self._buf.append(
                 _Slot(
                     item,
                     future,
@@ -362,10 +410,49 @@ class MicroBatcher:
                     parent_span,
                     time.monotonic() if parent_span is not None else 0.0,
                     deadline,
+                    criticality,
                 )
             )
-            self._metrics.queue_depth(self._queue.qsize())
-            return future
+            self._metrics.queue_depth(len(self._buf))
+            self._cv.notify()
+        if victim is not None:
+            # settle the evicted waiter OUTSIDE the lock: its
+            # done-callbacks run inline and must not execute under the
+            # batcher's condition
+            if victim.future.set_running_or_notify_cancel():
+                victim.future.set_exception(
+                    BatcherOverloaded(
+                        "shed: evicted by a higher-criticality "
+                        "submission under overload"
+                    )
+                )
+        return future
+
+    def _pick_victim(self, criticality: str) -> "_Slot | None":
+        """cv held. The queued slot a full buffer sheds to admit a
+        ``criticality``-class submission: strictly lower class only
+        (equal class waits its turn — no churn), lowest class first,
+        then the nearest deadline (the slot most likely to die unserved
+        anyway loses the least goodput), then the latest arrival."""
+        incoming = admission.CLASS_RANK.get(
+            criticality, admission.CLASS_RANK[admission.DEFAULT]
+        )
+        victim = None
+        victim_key = None
+        for i, slot in enumerate(self._buf):
+            rank = admission.CLASS_RANK.get(slot.criticality, 1)
+            if rank >= incoming or slot.future.cancelled():
+                continue
+            key = (
+                rank,
+                slot.deadline.expires_mono
+                if slot.deadline is not None
+                else math.inf,
+                -i,
+            )
+            if victim_key is None or key < victim_key:
+                victim, victim_key = slot, key
+        return victim
 
     def __call__(self, item: Any, timeout: float | None = 30.0) -> Any:
         # the waiter must never outlive the budget it was admitted
@@ -380,24 +467,36 @@ class MicroBatcher:
             )
         return self.submit(item).result(timeout=timeout)
 
+    def retry_after_s(self) -> float:
+        """Cooperative-backpressure hint from live queue state: about
+        how long until the current backlog has drained through the
+        device (queued batches × recent batch time), clamped to
+        [0.05, 5] — what a shed response's ``Retry-After`` should say
+        (docs/robustness.md)."""
+        with self._cv:
+            depth = len(self._buf)
+        batches_ahead = 1.0 + depth / max(1, self._max_batch)
+        per_batch = max(self._batch_ewma_s, 0.001)
+        return min(5.0, max(0.05, batches_ahead * per_batch))
+
     def close(self) -> None:
-        """Graceful, in pipeline order: the collector sentinel drains
-        queued items through dispatch, in-flight dispatches complete,
+        """Graceful, in pipeline order: the collector drains queued
+        items through dispatch, in-flight dispatches complete,
         their futures resolve, then both threads exit. A worker stuck
         in a hung dispatch past the join timeout is reported
         (structured warning + ``pio_batcher_leaked_threads_total``)
         instead of silently leaked."""
-        with self._submit_lock:
+        with self._cv:
             if self._closed.is_set():
                 return
             self._closed.set()
-            self._queue.put(None)  # wake the collector
+            self._cv.notify_all()  # wake the collector to drain
         join_deadline = time.monotonic() + self._close_join_timeout_s
         self._thread.join(timeout=self._close_join_timeout_s)
         leaked = self._thread.is_alive()
         if self._completer is not None:
             # the completer sentinel is sent by the collector alone
-            # (end of _drain_and_exit). If the collector is hung we do
+            # (end of its drain loop). If the collector is hung we do
             # NOT inject one here: it could overtake a batch the stuck
             # collector is still about to hand off, and an exited
             # completer would strand that batch's futures forever. Both
@@ -417,46 +516,59 @@ class MicroBatcher:
             )
 
     # -- collector stage ---------------------------------------------------
-    def _drain_and_exit(self, batch) -> None:
-        """Sentinel seen: serve everything already queued, then stop."""
-        while True:
-            try:
-                nxt = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            if nxt is not None:
-                batch.append(nxt)
-        if batch:
-            self._dispatch_batch(batch)
-        if self._completer is not None:
-            self._pending.put(None)  # completer drains in order, then exits
+    def _select_batch(self) -> list:
+        """cv held. Take up to ``max_batch`` slots out of the buffer —
+        deadline-aware when over-full: the nearest-deadline slots go
+        first so near-expiry work isn't served dead behind slack work;
+        arrival order breaks ties (and orders deadline-less slots), and
+        the dispatched batch itself keeps arrival order."""
+        buf = self._buf
+        if len(buf) <= self._max_batch:
+            batch = buf
+            self._buf = []
+        else:
+            order = sorted(
+                range(len(buf)),
+                key=lambda i: (
+                    buf[i].deadline.expires_mono
+                    if buf[i].deadline is not None
+                    else math.inf,
+                    i,
+                ),
+            )
+            chosen = set(order[: self._max_batch])
+            batch = [buf[i] for i in sorted(chosen)]
+            self._buf = [
+                slot for i, slot in enumerate(buf) if i not in chosen
+            ]
+        if not self._closed.is_set():
+            # a closed batcher is a draining OLD generation — after
+            # /reload its replacement shares the same gauge child, and
+            # a final set() here would overwrite the live queue depth
+            self._metrics.queue_depth(len(self._buf))
+        return batch
 
     def _loop(self) -> None:
         while True:
-            first = self._queue.get()
-            if first is None:
-                self._drain_and_exit([])
-                return
-            batch = [first]
-            wait = self._current_wait
-            deadline = time.monotonic() + wait
-            while len(batch) < self._max_batch:
-                remaining = deadline - time.monotonic()
-                try:
-                    # a spent window still drains backlog without
-                    # blocking — a hot (adaptively shrunk) wait must
-                    # not cap occupancy at 1
-                    nxt = (
-                        self._queue.get(timeout=remaining)
-                        if remaining > 0
-                        else self._queue.get_nowait()
-                    )
-                except queue.Empty:
-                    break
-                if nxt is None:
-                    self._drain_and_exit(batch)
-                    return
-                batch.append(nxt)
+            with self._cv:
+                while not self._buf and not self._closed.is_set():
+                    self._cv.wait()
+                if not self._buf:
+                    break  # closed and fully drained
+                if not self._closed.is_set():
+                    # coalesce: wait out the window from the FIRST
+                    # queued item unless the batch fills (or close
+                    # lands — a drain dispatches immediately)
+                    window_end = time.monotonic() + self._current_wait
+                    while (
+                        len(self._buf) < self._max_batch
+                        and not self._closed.is_set()
+                    ):
+                        remaining = window_end - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._cv.wait(remaining)
+                batch = self._select_batch()
             full = len(batch) >= self._max_batch
             self._dispatch_batch(batch)
             if self._adaptive:
@@ -465,19 +577,16 @@ class MicroBatcher:
                 # stops taxing p50. The first non-full batch restores
                 # the whole window for idle-traffic coalescing.
                 if full:
-                    wait *= 0.5
+                    wait = self._current_wait * 0.5
                     if wait < self._max_wait / 64:
                         wait = 0.0
                     self._current_wait = wait
                 else:
                     self._current_wait = self._max_wait
+        if self._completer is not None:
+            self._pending.put(None)  # completer drains in order, then exits
 
     def _dispatch_batch(self, batch) -> None:
-        # a closed batcher is a draining OLD generation — after /reload
-        # its replacement shares the same gauge child (same name), and
-        # a final set() here would overwrite the live queue depth
-        if not self._closed.is_set():
-            self._metrics.queue_depth(self._queue.qsize())
         # backpressure BEFORE the cancellation/deadline cutoff: while
         # the collector waits for a pipeline slot (device slow, depth
         # exhausted) waiters can still cancel and budgets can still
@@ -627,10 +736,20 @@ class MicroBatcher:
         )
 
     # -- shared settlement -------------------------------------------------
+    def _observe_batch_time(self, elapsed: float) -> None:
+        # feeds retry_after_s(); single writer (whichever thread
+        # settles), lock-free float store
+        self._batch_ewma_s = (
+            elapsed
+            if self._batch_ewma_s == 0.0
+            else 0.8 * self._batch_ewma_s + 0.2 * elapsed
+        )
+
     def _settle_success(
         self, live, results, elapsed: float, start_wall: float,
         start_mono: float, traced: bool, enqueue_s: float, sync_s: float,
     ) -> None:
+        self._observe_batch_time(elapsed)
         self._metrics.dispatched(len(live), elapsed)
         if traced:
             self._record_dispatch_spans(
@@ -652,6 +771,7 @@ class MicroBatcher:
         start_mono: float, traced: bool, enqueue_s: float, sync_s: float,
         phase: str,
     ) -> None:
+        self._observe_batch_time(elapsed)
         self._metrics.dispatched(len(live), elapsed)
         if traced:
             self._record_dispatch_spans(

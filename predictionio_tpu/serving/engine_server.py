@@ -55,6 +55,7 @@ from predictionio_tpu.data.storage import Storage, get_storage
 from predictionio_tpu.obs import MetricRegistry, get_registry
 from predictionio_tpu.obs import tracing
 from predictionio_tpu.parallel.mesh import ComputeContext
+from predictionio_tpu.serving import admission as admission_mod
 from predictionio_tpu.serving import resilience
 from predictionio_tpu.serving.batching import (
     BatcherOverloaded,
@@ -104,6 +105,7 @@ class EngineServer:
         log_prefix: str = "",
         registry: MetricRegistry | None = None,
         tracer: tracing.Tracer | None = None,
+        admission: bool | admission_mod.AdmissionController = True,
     ):
         self._engine = engine
         self._params = params
@@ -179,6 +181,22 @@ class EngineServer:
             server_config=self._server_config,
         )
         install_plugin_routes(self.router, self._plugins, OUTPUT_SNIFFER)
+        # adaptive overload control (docs/robustness.md "Overload &
+        # backpressure"): the limit follows observed latency instead of
+        # the static batcher queue bound. Attached BEFORE serve() so
+        # HTTPServer picks it up; admission=False (or PIO_ADMISSION=0)
+        # restores the pre-admission behavior.
+        if admission is True:
+            self.router.admission = admission_mod.AdmissionController.from_env(
+                "engine", registry=self._registry,
+                # the limit must never starve the device: one full
+                # pipeline of batches stays admissible
+                min_limit=float(
+                    self._max_batch * (max(0, self._pipeline_depth) + 1)
+                ),
+            )
+        elif isinstance(admission, admission_mod.AdmissionController):
+            self.router.admission = admission
         self._http: HTTPServer | None = None
         if self._log_queue is not None:
             threading.Thread(
@@ -465,6 +483,22 @@ class EngineServer:
   </body>
 </html>"""
 
+    def _shed_headers(self) -> dict[str, str]:
+        """The cooperative-backpressure hint for a batcher shed: a
+        ``Retry-After`` computed from live queue state (deepest backlog
+        across the algorithm batchers), not a hardcoded constant. The
+        shed marker is safe here: a shed query produced no prediction
+        and recorded no feedback — nothing externally visible ran."""
+        with self._lock:
+            batchers = self._batchers
+        hint = max(
+            (b.retry_after_s() for b in batchers), default=0.05
+        )
+        return {
+            "Retry-After": admission_mod.format_retry_after(hint),
+            admission_mod.SHED_HEADER: "batcher",
+        }
+
     def _queries(self, request: Request) -> Response:
         return self._with_remote_log(self._queries_inner, request)
 
@@ -550,7 +584,10 @@ class EngineServer:
                 # queueing into a predict-timeout hang. Earlier
                 # algorithms' accepted submits must not run for nothing.
                 self._abandon(futures)
-                raise HTTPError(503, "server overloaded; retry later")
+                raise HTTPError(
+                    503, "server overloaded; retry later",
+                    headers=self._shed_headers(),
+                )
             except resilience.DeadlineExceeded:
                 self._abandon(futures)
                 raise HTTPError(504, "deadline expired before dispatch")
@@ -570,6 +607,17 @@ class EngineServer:
             # the batcher dropped the slot pre-dispatch: the client's
             # budget ran out while the query was queued
             raise HTTPError(504, "deadline expired before device dispatch")
+        except BatcherOverloaded:
+            # a queued slot was evicted by a higher-criticality
+            # submission while we waited — a shed, not a fault. The
+            # sibling algorithms' still-live slots are abandoned (the
+            # evicted future is already done; only pending peers are
+            # cancelled, so the wasted-dispatch counter stays honest)
+            self._abandon([f for f in futures if not f.done()])
+            raise HTTPError(
+                503, "shed under overload; retry later",
+                headers=self._shed_headers(),
+            )
 
         elapsed = time.perf_counter() - t0
         with self._lock:
@@ -708,6 +756,12 @@ class EngineServer:
                 results.append(
                     {"status": 504,
                      "message": "deadline expired before device dispatch"}
+                )
+            except BatcherOverloaded:
+                self._abandon([f for f in futures if not f.done()])
+                results.append(
+                    {"status": 503,
+                     "message": "shed under overload; retry later"}
                 )
             except Exception as exc:  # noqa: BLE001 - per-slot status
                 if self._log_queue is not None and not logged:

@@ -34,6 +34,7 @@ from predictionio_tpu.data.storage import Storage, get_storage
 from predictionio_tpu.data.storage.base import PartialBatchError
 from predictionio_tpu.obs import MetricRegistry, get_registry
 from predictionio_tpu.obs import tracing
+from predictionio_tpu.serving import admission as admission_mod
 from predictionio_tpu.serving.http import (
     HTTPError,
     HTTPServer,
@@ -73,10 +74,16 @@ class EventServer:
         registry: MetricRegistry | None = None,
         tracer: tracing.Tracer | None = None,
         server_config=None,
+        admission: bool | admission_mod.AdmissionController = True,
     ):
         """``server_config`` (the server-key ServerConfig) key-auths
         the ``/debug`` trace routes — the event API itself stays on
-        per-app access keys."""
+        per-app access keys.
+
+        ``admission`` turns on the adaptive overload controller
+        (docs/robustness.md "Overload & backpressure"); fair-share
+        tenancy is keyed by the ``accessKey`` query param, so one hot
+        app cannot starve the other apps' ingest under pressure."""
         self._storage = storage or get_storage()
         self.registry = registry if registry is not None else get_registry()
         self.tracer = tracer if tracer is not None else tracing.get_tracer()
@@ -107,6 +114,12 @@ class EventServer:
         r.route("GET", "/webhooks/<name>.json", self._webhook_json_probe)
         r.route("GET", "/webhooks/<name>.form", self._webhook_form_probe)
         install_plugin_routes(r, self._plugins, INPUT_SNIFFER)
+        if admission is True:
+            r.admission = admission_mod.AdmissionController.from_env(
+                "eventserver", registry=self.registry
+            )
+        elif isinstance(admission, admission_mod.AdmissionController):
+            r.admission = admission
 
     # -- auth (reference EventServer.scala:90-140) ------------------------
     def _auth(self, request: Request) -> tuple[int, int | None, tuple]:
@@ -415,6 +428,7 @@ def create_event_server(
     reuse_port: bool = False,
     registry: MetricRegistry | None = None,
     tracer: tracing.Tracer | None = None,
+    admission: bool = True,
 ) -> HTTPServer:
     """Reference EventServer.createEventServer (default port 7070).
 
@@ -428,6 +442,7 @@ def create_event_server(
     server = EventServer(
         storage=storage, stats=stats, plugins=plugins,
         registry=registry, tracer=tracer, server_config=server_config,
+        admission=admission,
     )
     http = HTTPServer(
         server.router,

@@ -25,7 +25,7 @@ from urllib.parse import parse_qs, urlparse
 from predictionio_tpu.obs import MetricRegistry, set_request_id
 from predictionio_tpu.obs import tracing
 from predictionio_tpu.obs.context import log_json, redact_keys
-from predictionio_tpu.serving import resilience
+from predictionio_tpu.serving import admission, resilience
 
 logger = logging.getLogger(__name__)
 
@@ -60,6 +60,9 @@ class Request:
         #: the route PATTERN that matched (set by Router.dispatch) —
         #: bounded cardinality, unlike the raw path
         self.route: str | None = None
+        #: criticality class from X-PIO-Criticality (set by the server
+        #: wrapper; defaults to "default" for unlabeled requests)
+        self.criticality: str = admission.DEFAULT
         #: "host:port" of the connecting client (set by the server
         #: wrapper) — the serving router hashes this for consistent
         #: affinity when a request carries no explicit affinity key
@@ -99,10 +102,18 @@ class Response:
 
 
 class HTTPError(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(
+        self,
+        status: int,
+        message: str,
+        headers: dict[str, str] | None = None,
+    ):
         super().__init__(message)
         self.status = status
         self.message = message
+        #: extra response headers (e.g. a computed ``Retry-After`` on a
+        #: shed — the cooperative-backpressure contract)
+        self.headers = headers or {}
 
 
 class Router:
@@ -114,6 +125,10 @@ class Router:
         #: fault injector applied before dispatch (attached by
         #: install_metrics_routes when PIO_CHAOS is set)
         self.chaos_middleware: resilience.ChaosMiddleware | None = None
+        #: adaptive overload controller applied at admission (attached
+        #: by the owning server BEFORE HTTPServer construction;
+        #: docs/robustness.md "Overload & backpressure")
+        self.admission: admission.AdmissionController | None = None
 
     def route(self, method: str, pattern: str, handler: Handler) -> None:
         # escape literal segments so '.' in '.json' doesn't match anything
@@ -263,6 +278,7 @@ class HTTPServer:
         config_ref = server_config if enforce_key else None
         tracer_ref = tracer if tracer is not None else tracing.get_tracer()
         chaos_ref = router.chaos_middleware
+        admission_ref = router.admission
         state = resilience.DrainState()
         if registry is not None:
             requests_total = registry.counter(
@@ -278,7 +294,7 @@ class HTTPServer:
             rejected_total = registry.counter(
                 "pio_http_rejected_total",
                 "Requests refused at admission, by reason "
-                "(draining | deadline)",
+                "(draining | deadline | overload)",
                 ("service", "reason"),
             )
             # scrape-time functions: in a process that rebuilds servers
@@ -414,6 +430,13 @@ class HTTPServer:
                 )
                 resilience.set_deadline(deadline)
                 request.deadline = deadline
+                # criticality rides the same contextvar discipline:
+                # set unconditionally so a keep-alive thread cannot
+                # leak one request's class into the next
+                request.criticality = admission.parse_criticality(
+                    self.headers.get(admission.CRITICALITY_HEADER)
+                )
+                admission.set_criticality(request.criticality)
                 # the operator's window into a sick server: never
                 # drain-refused, never chaos-faulted
                 telemetry_path = parsed.path == "/healthz" or (
@@ -422,6 +445,60 @@ class HTTPServer:
                 t0 = time.perf_counter()
                 early = self._admission(request, parsed.path, deadline,
                                         telemetry_path)
+                # adaptive overload gate, AFTER drain/deadline refusals
+                # (those must not consume limiter slots) and never for
+                # the telemetry surface. Every admit is paired with
+                # exactly one release below — including the chaos-reset
+                # early return.
+                admitted = False
+                tenant = ""
+                if (
+                    early is None
+                    and admission_ref is not None
+                    and not telemetry_path
+                ):
+                    tenant = (
+                        query.get("accessKey")
+                        or self.headers.get(admission.TENANT_HEADER)
+                        or ""
+                    )
+                    try:
+                        admission_ref.try_acquire(
+                            request.criticality, tenant
+                        )
+                        admitted = True
+                    except admission.AdmissionRejected as rej:
+                        request.route = (
+                            router_ref.match_route(request)
+                            or "(unmatched)"
+                        )
+                        if rejected_total is not None:
+                            rejected_total.labels(
+                                service, "overload"
+                            ).inc()
+                        early = Response(
+                            rej.status,
+                            {
+                                "message": (
+                                    "server overloaded"
+                                    if rej.reason == "limit"
+                                    else "tenant over fair share"
+                                )
+                                + "; retry after the hinted delay",
+                                "reason": rej.reason,
+                            },
+                            headers={
+                                "Retry-After": admission
+                                .format_retry_after(rej.retry_after_s),
+                                # refused BEFORE the handler: nothing
+                                # ran, so even a POST replays safely
+                                admission.SHED_HEADER: rej.reason,
+                            },
+                        )
+                # True when the response carries NO verdict about this
+                # server's capacity (dependency fast-fail, injected
+                # fault): released without feeding the limiter
+                no_verdict = False
                 if early is not None:
                     response = early
                 else:
@@ -468,7 +545,9 @@ class HTTPServer:
                                 raise  # handled below: slam the socket
                             except HTTPError as e:
                                 response = Response(
-                                    e.status, {"message": e.message}
+                                    e.status,
+                                    {"message": e.message},
+                                    headers=dict(e.headers),
                                 )
                             except resilience.DeadlineExceeded as e:
                                 response = Response(
@@ -476,16 +555,34 @@ class HTTPServer:
                                     {"message": f"deadline exceeded: {e}"},
                                 )
                             except resilience.ChaosError as e:
+                                # an injected fault says nothing about
+                                # this server's capacity — it must not
+                                # feed the limiter (a chaos rehearsal
+                                # would drag the limit to the floor on
+                                # an unloaded server)
+                                no_verdict = True
                                 response = Response(
                                     e.status, {"message": e.message}
                                 )
                             except resilience.CircuitOpenError as e:
                                 # a dependency's breaker is open: the
-                                # request CAN be retried elsewhere/later
+                                # request CAN be retried elsewhere/
+                                # later. A fast-fail says nothing
+                                # about THIS server's capacity, so it
+                                # is flagged out of the limiter's
+                                # latency signal below.
+                                no_verdict = True
                                 response = Response(
                                     503,
                                     {"message": str(e)},
-                                    headers={"Retry-After": "1"},
+                                    headers={
+                                        "Retry-After": (
+                                            admission_ref
+                                            .retry_after_header()
+                                            if admission_ref is not None
+                                            else "1"
+                                        )
+                                    },
                                 )
                             except json.JSONDecodeError as e:
                                 response = Response(
@@ -502,6 +599,14 @@ class HTTPServer:
                                 )
                                 root_span.set("status", response.status)
                     except resilience.ChaosReset:
+                        if admitted:
+                            # a slammed connection produced no verdict
+                            # about capacity — release without a sample
+                            admission_ref.release(
+                                time.perf_counter() - t0,
+                                admission.OUTCOME_IGNORE,
+                                tenant,
+                            )
                         log_json(
                             access_logger, logging.INFO, "chaos_reset",
                             service=service, path=parsed.path,
@@ -509,6 +614,19 @@ class HTTPServer:
                         self.close_connection = True
                         return
                 elapsed = time.perf_counter() - t0
+                if admitted:
+                    # outcome classification feeds the adaptive limit:
+                    # sheds and deadline misses are the AIMD backoff
+                    # signal; a circuit-open fast-fail is NO sample (its
+                    # near-zero latency would inflate the limit); every
+                    # real served request is a latency sample
+                    if no_verdict:
+                        outcome = admission.OUTCOME_IGNORE
+                    elif response.status in (429, 503, 504):
+                        outcome = admission.OUTCOME_DROP
+                    else:
+                        outcome = admission.OUTCOME_OK
+                    admission_ref.release(elapsed, outcome, tenant)
                 if response.status >= 400 and isinstance(
                     response.body, dict
                 ):

@@ -36,6 +36,15 @@ This module gives the chain four coordinated behaviors, used by
   resets at the HTTP boundary, so all of the above can be rehearsed
   (``scripts/chaos_smoke.py``) instead of first exercised by an outage.
 
+Overload is NOT failure: the adaptive admission layer
+(:mod:`~predictionio_tpu.serving.admission`) composes with these
+primitives — a 429/503 shed carrying a computed ``Retry-After`` is the
+server ANSWERING, so it never counts as a breaker failure, a
+dependency's :class:`CircuitOpenError` fast-fail never feeds the
+limiter's latency signal, and shed-retry hints are honored only inside
+the propagated deadline budget (docs/robustness.md "Overload &
+backpressure").
+
 Env knobs (all optional; see docs/robustness.md):
 
 * ``PIO_RETRY_MAX_ATTEMPTS`` (3), ``PIO_RETRY_BASE_MS`` (50),

@@ -63,7 +63,7 @@ from typing import Callable, Iterable
 from predictionio_tpu.obs import MetricRegistry, get_registry
 from predictionio_tpu.obs import tracing
 from predictionio_tpu.obs.context import log_json
-from predictionio_tpu.serving import resilience
+from predictionio_tpu.serving import admission, resilience
 from predictionio_tpu.serving.http import (
     HTTPError,
     HTTPServer,
@@ -123,6 +123,11 @@ class Replica:
         #: must not readmit this replica even while its process still
         #: answers ok (the router, not the replica, decided to drain)
         self.admin_draining = False
+        #: monotonic instant until which this replica is SOFT-unhealthy:
+        #: it answered 503 + Retry-After (its admission controller shed
+        #: or it is draining), so it stays in the pool but is
+        #: deprioritized — saturation is backpressure, not sickness
+        self.saturated_until = 0.0
         self._lock = threading.Lock()
         self._inflight = 0
         self.probe_failures = 0
@@ -157,6 +162,21 @@ class Replica:
         with self._lock:
             self._inflight -= 1
 
+    def mark_saturated(self, hint_s: float) -> None:
+        """The replica shed with a Retry-After of ``hint_s``: treat it
+        as saturated (soft-unhealthy) for that long, clamped to
+        [0.05, 5] so a weird hint can't bench a replica for minutes."""
+        self.saturated_until = time.monotonic() + min(
+            5.0, max(0.05, hint_s)
+        )
+
+    @property
+    def saturated(self) -> bool:
+        return time.monotonic() < self.saturated_until
+
+    def saturation_remaining_s(self) -> float:
+        return max(0.0, self.saturated_until - time.monotonic())
+
     def to_dict(self) -> dict:
         return {
             "id": self.replica_id,
@@ -165,6 +185,7 @@ class Replica:
             "state": self.state,
             "inflight": self.inflight,
             "breaker": self.breaker.state,
+            "saturated": self.saturated,
             "lastProbe": self.last_probe,
             "pid": self.pid,
         }
@@ -260,6 +281,12 @@ class ServingRouter:
             "pio_router_swaps_total",
             "Rolling generation swaps, by outcome",
             ("outcome",),
+        )
+        self._shed_total = self._registry.counter(
+            "pio_router_shed_total",
+            "Requests shed at the router because every healthy "
+            "replica advertised saturation (router-level backpressure "
+            "— no replica budget burned)",
         )
 
         for replica in replicas:
@@ -499,11 +526,14 @@ class ServingRouter:
 
     # -- selection ---------------------------------------------------------
     def _candidates(self, affinity_key: bytes, exclude: set[str]):
-        """Healthy replicas in selection order: recovering breakers
-        first (their ``allow()`` is the half-open probe — skipping them
-        would strand an open breaker forever behind healthier peers),
-        then least-inflight with the consistent-hash ring breaking
-        ties."""
+        """Healthy replicas in selection order: unsaturated before
+        saturated (a replica that just shed is soft-unhealthy — it
+        stays available as a last resort but must not absorb traffic
+        its own admission controller is refusing), and within each
+        band recovering breakers first (their ``allow()`` is the
+        half-open probe — skipping them would strand an open breaker
+        forever behind healthier peers), then least-inflight with the
+        consistent-hash ring breaking ties."""
         with self._lock:
             pool = [
                 r
@@ -512,21 +542,33 @@ class ServingRouter:
             ]
         if not pool:
             return []
-        recovering = [r for r in pool if r.breaker.state != resilience.CLOSED]
-        closed = [r for r in pool if r.breaker.state == resilience.CLOSED]
-        ordered: list[Replica] = sorted(
-            recovering, key=lambda r: r.inflight
-        )
-        remaining = sorted(closed, key=lambda r: r.inflight)
-        while remaining:
-            least = remaining[0].inflight
-            tied = [r for r in remaining if r.inflight == least]
-            if len(tied) == 1:
-                pick = tied[0]
-            else:
-                pick = self._ring_pick(tied, affinity_key)
-            ordered.append(pick)
-            remaining.remove(pick)
+        # snapshot the time-dependent saturation flag ONCE per replica:
+        # evaluating it in two comprehensions would let a replica whose
+        # window expires between them fall into neither band and
+        # vanish from the candidate list
+        saturated = {r.replica_id: r.saturated for r in pool}
+        ordered: list[Replica] = []
+        for band in (
+            [r for r in pool if not saturated[r.replica_id]],
+            [r for r in pool if saturated[r.replica_id]],
+        ):
+            recovering = [
+                r for r in band if r.breaker.state != resilience.CLOSED
+            ]
+            closed = [
+                r for r in band if r.breaker.state == resilience.CLOSED
+            ]
+            ordered.extend(sorted(recovering, key=lambda r: r.inflight))
+            remaining = sorted(closed, key=lambda r: r.inflight)
+            while remaining:
+                least = remaining[0].inflight
+                tied = [r for r in remaining if r.inflight == least]
+                if len(tied) == 1:
+                    pick = tied[0]
+                else:
+                    pick = self._ring_pick(tied, affinity_key)
+                ordered.append(pick)
+                remaining.remove(pick)
         return ordered
 
     def _ring_pick(
@@ -575,13 +617,57 @@ class ServingRouter:
             return request.body
         return (getattr(request, "client_addr", "") or "").encode()
 
+    def _saturation_hint(self) -> str:
+        """Retry-After for a router-level shed: the SOONEST any
+        saturated replica expects capacity back (it told us via its
+        own Retry-After), floored at 50 ms."""
+        with self._lock:
+            remaining = [
+                r.saturation_remaining_s()
+                for r in self._replicas.values()
+                if r.state == HEALTHY and r.saturated
+            ]
+        return admission.format_retry_after(
+            min(remaining) if remaining else 0.5
+        )
+
     def _proxy(self, request: Request) -> Response:
         deadline = resilience.get_deadline()
         affinity_key = self._affinity_key(request)
         tried: set[str] = set()
         attempts = 1 + self._failover_retries
         last_failure: str | None = None
+        hard_failure = False
         parent = tracing.current_span()
+        # router-level shed: when EVERY healthy replica is advertising
+        # saturation, forwarding just burns a saturated replica's
+        # budget to collect another 503 — answer the backpressure here
+        # with the soonest capacity hint. Critical-class traffic still
+        # goes through: the replicas' own admission keeps the full
+        # limit open for it.
+        if request.criticality != admission.CRITICAL:
+            # a cheap pool scan, not the full selection ordering (which
+            # the first _acquire below would only rebuild)
+            with self._lock:
+                healthy = [
+                    r
+                    for r in self._replicas.values()
+                    if r.state == HEALTHY
+                ]
+            if healthy and all(r.saturated for r in healthy):
+                self._shed_total.inc()
+                return Response(
+                    503,
+                    {
+                        "message": "all replicas are saturated; "
+                        "retry after the hinted delay"
+                    },
+                    headers={
+                        "Retry-After": self._saturation_hint(),
+                        # nothing was forwarded: replay-safe
+                        admission.SHED_HEADER: "saturated",
+                    },
+                )
         for attempt in range(attempts):
             if deadline is not None and deadline.expired:
                 raise resilience.DeadlineExceeded(
@@ -627,18 +713,42 @@ class ServingRouter:
                 replica.end()
             if isinstance(outcome, Response):
                 return outcome
-            # transport error or retryable 5xx
-            last_failure = outcome
+            # failover-eligible: transport error, retryable 5xx, or a
+            # saturation shed (kind distinguishes them — a request that
+            # only ever hit saturated replicas becomes a backpressure
+            # 503, not a 502)
+            kind, last_failure = outcome
+            hard_failure = hard_failure or kind == "error"
             if attempt + 1 >= attempts or (
                 deadline is not None and deadline.expired
             ):
                 break
         if last_failure is not None:
-            # every allowed attempt failed — a gateway error the client
+            if not hard_failure:
+                # every attempt was answered with a saturation shed:
+                # relay the backpressure with the soonest capacity
+                # hint. Queries are reads — the replicas' sheds did no
+                # work — so the relay is marked replay-safe too.
+                self._shed_total.inc()
+                return Response(
+                    503,
+                    {
+                        "message": "all tried replicas are saturated; "
+                        "retry after the hinted delay"
+                    },
+                    headers={
+                        "Retry-After": self._saturation_hint(),
+                        admission.SHED_HEADER: "saturated",
+                    },
+                )
+            # a real failure somewhere — a gateway error the client
             # may retry (the replicas themselves stayed consistent)
             raise HTTPError(502, f"all routed replicas failed: {last_failure}")
         states = set(self.replica_states().values())
         if states and states <= {DRAINING, RETIRED}:
+            # drain keeps the small FIXED hint: the pool is rolling,
+            # not overloaded, and fresh capacity readmits in about a
+            # probe interval, independent of queue state
             return Response(
                 503,
                 {"message": "all replicas are draining; retry shortly"},
@@ -650,7 +760,14 @@ class ServingRouter:
                 "message": "no healthy replica available"
                 + (" (all tried)" if tried else "")
             },
-            headers={"Retry-After": "1"},
+            headers={
+                # computed from the router's own recovery cadence: a
+                # probe cycle is how fast a replica can possibly be
+                # readmitted
+                "Retry-After": admission.format_retry_after(
+                    2.0 * self._probe_interval_s
+                )
+            },
         )
 
     def _forward(
@@ -659,11 +776,14 @@ class ServingRouter:
         request: Request,
         deadline: resilience.Deadline | None,
         span,
-    ) -> Response | str:
+    ) -> "Response | tuple[str, str]":
         """One proxied attempt. Returns the upstream Response (success
-        — including 4xx/504, which are the replica ANSWERING), or an
-        error string when the attempt is failover-eligible (transport
-        error / retryable 5xx)."""
+        — including 4xx/504, which are the replica ANSWERING), or a
+        ``(kind, message)`` tuple when the attempt is failover-eligible:
+        ``("error", ...)`` for transport errors / retryable 5xx,
+        ``("saturated", ...)`` for a 503 carrying Retry-After — the
+        replica's admission controller shedding, which is an ANSWER
+        for breaker purposes but a reason to try a sibling."""
         url = replica.url + request.path
         req = urllib.request.Request(
             url, data=request.body, method=request.method
@@ -672,6 +792,13 @@ class ServingRouter:
         req.add_header("Content-Type", ctype or "application/json")
         if request.request_id:
             req.add_header("X-Request-ID", request.request_id)
+        if request.criticality != admission.DEFAULT:
+            # criticality propagates like the deadline, so the
+            # replica's admission controller sheds by the CLIENT's
+            # class, not the router hop's
+            req.add_header(
+                admission.CRITICALITY_HEADER, request.criticality
+            )
         # nest the replica's root span under the forward span (or the
         # router's root when tracing the forward itself is disabled)
         parent = span if span is not None else tracing.current_span()
@@ -691,29 +818,50 @@ class ServingRouter:
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 body = resp.read()
                 status = resp.status
+                upstream_headers = resp.headers
                 resp_ctype = resp.headers.get(
                     "Content-Type", "application/json"
                 )
         except urllib.error.HTTPError as e:
             body = e.read()
             status = e.code
+            upstream_headers = e.headers
             resp_ctype = e.headers.get("Content-Type", "application/json")
         except OSError as e:
             replica.breaker.record_failure()
             self._requests_total.labels(replica.replica_id, "error").inc()
             if span is not None:
                 span.set("error", str(e))
-            return f"{replica.replica_id}: {e}"
+            return ("error", f"{replica.replica_id}: {e}")
         self._requests_total.labels(
             replica.replica_id, str(status)
         ).inc()
         if span is not None:
             span.set("status", status)
+        if status == 503:
+            hint = admission.parse_retry_after(
+                upstream_headers.get("Retry-After")
+                if upstream_headers is not None
+                else None
+            )
+            if hint is not None:
+                # cooperative backpressure: the replica ANSWERED —
+                # overload (or drain) is not a breaker failure, but it
+                # IS a reason to deprioritize it and try a sibling
+                replica.mark_saturated(hint)
+                replica.breaker.record_success()
+                if span is not None:
+                    span.set("saturated", True)
+                return (
+                    "saturated",
+                    f"{replica.replica_id}: HTTP 503 (saturated)",
+                )
         if status >= 500 and status != 504:
             replica.breaker.record_failure()
-            return f"{replica.replica_id}: HTTP {status}"
+            return ("error", f"{replica.replica_id}: HTTP {status}")
         # 2xx/4xx — and 504, the replica answering about an expired
-        # budget — are verdicts of health, not failure
+        # budget — are verdicts of health, not failure (a 429
+        # fair-share refusal is tenant-specific and forwarded as-is)
         replica.breaker.record_success()
         return Response(status, body, content_type=resp_ctype)
 

@@ -79,6 +79,16 @@ if ! timeout -k 10 180 env JAX_PLATFORMS=cpu \
     rc=1
 fi
 
+echo "== overload smoke test (admission control plane, docs/robustness.md) =="
+# baseline collapse vs admission-controlled goodput at 2x saturation
+# (recorded into SERVING_BENCH.json) + the HTTP wiring: computed
+# Retry-After on sheds, criticality ordering, limiter gauges
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu \
+    python scripts/overload_smoke.py; then
+    echo "overload smoke test FAILED"
+    rc=1
+fi
+
 echo "== router smoke test (scale-out tier, docs/scale_out.md) =="
 # 2 real replicas behind the router: SIGKILL + respawn chaos, rolling
 # generation swap, one trace ID spanning router→replica→store

@@ -1,0 +1,482 @@
+"""Overload smoke test — the admission control plane, end to end.
+
+Two parts, both required (ISSUE 8 acceptance; docs/robustness.md
+"Overload & backpressure"):
+
+**Part A — the recorded proof.** Runs the serving-bench overload mode
+(baseline pre-admission stack vs :class:`AdmissionController`) at 2×
+the rig's measured capacity and asserts the contract: goodput ≥ 80% of
+measured capacity, critical-class p99 bounded (≤ 2× the deadline,
+versus the uncontrolled collapse at >10×), and the sheddable class
+shed first. The numbers are appended to ``SERVING_BENCH.json``
+(``serving_overload_goodput``) so the claim is a recorded trajectory
+point, not a one-off stdout line.
+
+**Part B — the HTTP wiring.** A REAL :class:`EngineServer` (fake DASE,
+fixed per-batch device cost) under 2× saturation open-loop HTTP load
+with a 20/60/20 critical/default/sheddable mix proves the wire-level
+contract: sheds answer 503/429 with a *parseable, computed*
+``Retry-After`` (no hardcoded ``1``), the lowest class sheds first,
+critical keeps the bulk of its goodput, and the limiter's gauges
+(``pio_admission_limit``/``pio_admission_inflight``) plus shed
+counters are live in ``/metrics.json``.
+
+Runs on any CPU-only runner (JAX_PLATFORMS=cpu); wired into
+scripts/check.sh and CI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import logging  # noqa: E402
+
+# thousands of shed 503s at INFO would drown the check output
+logging.basicConfig(level=logging.WARNING)
+logging.getLogger("predictionio_tpu.access").setLevel(logging.ERROR)
+
+from predictionio_tpu.serving import admission  # noqa: E402
+
+import serving_bench  # noqa: E402
+
+FAILURES: list[str] = []
+
+
+def check(ok: bool, what: str) -> None:
+    tag = "ok " if ok else "FAIL"
+    print(f"  [{tag}] {what}")
+    if not ok:
+        FAILURES.append(what)
+
+
+# --------------------------------------------------------------------------
+# Part A — recorded overload proof (in-process batcher rig)
+# --------------------------------------------------------------------------
+
+
+def part_a_recorded_proof(out_path: str) -> None:
+    print("== part A: overload proof (baseline collapse vs admission) ==")
+    common = dict(
+        max_batch=16, max_wait_ms=2.0,
+        device_ms=4.0, enqueue_ms=0.2, decode_ms=4.0,
+    )
+    # a quick closed-loop anchor for the offered rate
+    anchor = serving_bench.run_mode(
+        pipeline_depth=2, window=64, requests=1500, **common
+    )
+    deadline_ms = 150.0
+    base = serving_bench.run_overload(
+        capacity_qps=anchor["qps"], duration_s=1.5, pipeline_depth=2,
+        deadline_ms=deadline_ms, admit=False, **common,
+    )
+    adm = serving_bench.run_overload(
+        capacity_qps=anchor["qps"], duration_s=1.5, pipeline_depth=2,
+        deadline_ms=deadline_ms, admit=True, **common,
+    )
+    capacity = base["served_qps"]
+    goodput_ratio = adm["goodput_qps"] / max(1.0, capacity)
+    baseline_ratio = base["goodput_qps"] / max(1.0, capacity)
+    crit = adm[admission.CRITICAL]
+    shed = adm[admission.SHEDDABLE]
+    print(f"  capacity={capacity:.0f}qps offered={adm['offered_qps']}qps")
+    print(
+        f"  baseline goodput={baseline_ratio:.2f}  admitted "
+        f"goodput={goodput_ratio:.2f}  critical p99={crit['p99_ms']}ms"
+    )
+    if adm["offered_qps"] < 1.5 * capacity:
+        # the anchor run collapsed (noisy rig): the 2x premise is void
+        # and asserting would measure harness noise — matching the
+        # serving_bench gate's anchor-degenerate escape
+        print("  anchor degenerate; part A gate skipped", file=sys.stderr)
+        return
+    check(
+        goodput_ratio >= 0.8,
+        f"goodput {goodput_ratio:.2f} >= 0.8 of measured capacity "
+        "at 2x offered load",
+    )
+    check(
+        crit["p99_ms"] <= 2.0 * deadline_ms,
+        f"critical p99 {crit['p99_ms']}ms bounded (<= 2x "
+        f"{deadline_ms}ms deadline; baseline collapsed to "
+        f"{base[admission.CRITICAL]['p99_ms']}ms)",
+    )
+    check(
+        shed["shed_ratio"] > crit["shed_ratio"],
+        f"sheddable shed first ({shed['shed_ratio']} > "
+        f"critical {crit['shed_ratio']})",
+    )
+    check(
+        goodput_ratio > baseline_ratio,
+        f"admission goodput {goodput_ratio:.2f} beats the "
+        f"uncontrolled baseline {baseline_ratio:.2f}",
+    )
+    record = {
+        "metric": "serving_overload_goodput",
+        "value": round(goodput_ratio, 3),
+        "unit": "ratio",
+        "vs_baseline": round(
+            goodput_ratio / max(0.001, baseline_ratio), 2
+        ),
+        "extra": {
+            "capacity_qps": capacity,
+            "offered_qps": adm["offered_qps"],
+            "deadline_ms": deadline_ms,
+            "critical_p99_ms": crit["p99_ms"],
+            "critical_shed_ratio": crit["shed_ratio"],
+            "sheddable_shed_ratio": shed["shed_ratio"],
+            "baseline": base,
+            "admitted": adm,
+        },
+    }
+    if out_path:
+        serving_bench.persist_record(record, out_path)
+    print(json.dumps(record))
+
+
+# --------------------------------------------------------------------------
+# Part B — HTTP wiring over a real EngineServer
+# --------------------------------------------------------------------------
+
+#: tuned for small CI runners (2 cores): a SLOW simulated device keeps
+#: the absolute request rates low enough that the Python HTTP layers
+#: (client + server share the box) are not the thing being measured —
+#: overload behavior is rate-independent
+DEVICE_MS = 100.0
+MAX_BATCH = 5
+DEADLINE_MS = 800.0
+
+
+def build_server():
+    from fake_engine import (
+        FakeAlgorithm,
+        FakeDataSource,
+        FakeParams,
+        FakePreparator,
+    )
+    from predictionio_tpu.core import Engine, EngineParams, Serving
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.data.storage import Storage
+    from predictionio_tpu.parallel.mesh import ComputeContext
+    from predictionio_tpu.serving.engine_server import EngineServer
+
+    class DeviceAlgorithm(FakeAlgorithm):
+        """Fixed per-BATCH cost: the simulated accelerator dispatch."""
+
+        def predict(self, model, query):
+            time.sleep(DEVICE_MS / 1000.0)
+            return {"ok": True}
+
+        def batch_predict(self, model, queries):
+            time.sleep(DEVICE_MS / 1000.0)
+            return [{"ok": True} for _ in queries]
+
+    class PlainServing(Serving):
+        params_class = FakeParams
+
+        def serve(self, query, predictions):
+            return predictions[0]
+
+    storage = Storage(
+        env={
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        }
+    )
+    engine = Engine(
+        FakeDataSource, FakePreparator, DeviceAlgorithm, PlainServing
+    )
+    params = EngineParams(
+        data_source=("", FakeParams(id=1)),
+        preparator=("", FakeParams(id=2)),
+        algorithms=[("", FakeParams(id=3))],
+        serving=("", FakeParams()),
+    )
+    ctx = ComputeContext.create(batch="overload-smoke")
+    run_train(
+        engine, params, engine_id="overload-smoke", ctx=ctx,
+        storage=storage,
+    )
+    return EngineServer(
+        engine,
+        params,
+        engine_id="overload-smoke",
+        storage=storage,
+        ctx=ctx,
+        max_batch=MAX_BATCH,
+        max_wait_ms=2.0,
+        pipeline_depth=2,
+    )
+
+
+def _post(base: str, body: bytes, headers: dict) -> tuple:
+    """(status, retry_after_header | None)."""
+    req = urllib.request.Request(
+        base + "/queries.json", data=body, method="POST",
+        headers={"Content-Type": "application/json", **headers},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            resp.read()
+            return resp.status, None
+    except urllib.error.HTTPError as e:
+        e.read()
+        return e.code, e.headers.get("Retry-After")
+
+
+def warm_baseline(base: str, n: int = 25) -> None:
+    """Sequential low-load traffic so the limiter observes the
+    server's true no-load RTT before saturation hits — the windowed-
+    min baseline of a server whose FIRST request arrives mid-stampede
+    would anchor on already-queued latency."""
+    body = json.dumps({"x": 0}).encode()
+    for _ in range(n):
+        _post(base, body, {"X-PIO-Deadline": "2000"})
+
+
+def measure_capacity(base: str, duration_s: float = 1.2) -> float:
+    """Closed-loop saturation: completed 200s per second."""
+    stop = time.perf_counter() + duration_s
+    oks = [0]
+    lock = threading.Lock()
+
+    def worker():
+        body = json.dumps({"x": 1}).encode()
+        while time.perf_counter() < stop:
+            status, retry_after = _post(
+                base, body, {"X-PIO-Deadline": "2000"}
+            )
+            if status == 200:
+                with lock:
+                    oks[0] += 1
+            elif status in (429, 503):
+                # a well-behaved client honors the hint instead of
+                # hot-spinning the shed path
+                hint = admission.parse_retry_after(retry_after)
+                time.sleep(min(hint or 0.02, 0.2))
+
+    threads = [
+        threading.Thread(target=worker, daemon=True)
+        for _ in range(16)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return oks[0] / (time.perf_counter() - t0)
+
+
+def part_b_http(out_path: str) -> None:
+    print("== part B: HTTP overload wiring (real EngineServer) ==")
+    server = build_server()
+    http = server.serve(host="127.0.0.1", port=0)
+    http.start()
+    base = f"http://127.0.0.1:{http.port}"
+    try:
+        warm_baseline(base)
+        capacity = measure_capacity(base)
+        ideal = MAX_BATCH * 1000.0 / DEVICE_MS
+        print(
+            f"  measured HTTP capacity {capacity:.0f} qps "
+            f"(device ceiling {ideal:.0f})"
+        )
+        check(capacity > 0, "server serves under closed-loop load")
+
+        # open loop at 2x measured capacity, 20/60/20 class mix
+        rate = 2.0 * capacity
+        duration = 3.0
+        total = int(rate * duration)
+        interval = 1.0 / rate
+        mix = (
+            admission.CRITICAL,
+            admission.DEFAULT, admission.DEFAULT, admission.DEFAULT,
+            admission.SHEDDABLE,
+        )
+        # (cls, status, send-to-response latency, retry_after).
+        # Latency is measured from SEND, not from the scheduled time:
+        # this part gates the wire contract (sheds, hints, class
+        # order, server tails) and must not fail on client
+        # worker-pool slip — the strict open-loop goodput discipline
+        # is part A's in-process rig, where submission is cheap.
+        results: list[tuple] = []
+        lock = threading.Lock()
+        next_i = [0]
+        t0 = time.perf_counter() + 0.1
+
+        def worker():
+            body = json.dumps({"x": 2}).encode()
+            while True:
+                with lock:
+                    i = next_i[0]
+                    if i >= total:
+                        return
+                    next_i[0] += 1
+                scheduled = t0 + i * interval
+                delay = scheduled - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                cls = mix[i % len(mix)]
+                sent = time.perf_counter()
+                status, retry_after = _post(
+                    base, body,
+                    {
+                        "X-PIO-Deadline": str(int(DEADLINE_MS)),
+                        admission.CRITICALITY_HEADER: cls,
+                    },
+                )
+                latency = time.perf_counter() - sent
+                with lock:
+                    results.append((cls, status, latency, retry_after))
+
+        threads = [
+            threading.Thread(target=worker, daemon=True)
+            for _ in range(24)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+
+        # skip the warm-up quarter, like the bench
+        counted = results[int(len(results) * 0.25):]
+        by_cls = {
+            cls: [r for r in counted if r[0] == cls]
+            for cls in (
+                admission.CRITICAL, admission.DEFAULT,
+                admission.SHEDDABLE,
+            )
+        }
+        sheds = [r for r in counted if r[1] in (429, 503)]
+        good = [
+            r for r in counted
+            if r[1] == 200 and r[2] <= DEADLINE_MS / 1000.0
+        ]
+        goodput = len(good) / (elapsed * 0.75)
+        print(
+            f"  offered {rate:.0f}qps: {len(good)} good, "
+            f"{len(sheds)} shed of {len(counted)} counted"
+        )
+        check(len(sheds) > 0, "overload produced sheds (503/429)")
+        hints = [admission.parse_retry_after(r[3]) for r in sheds]
+        check(
+            all(h is not None and h > 0 for h in hints),
+            "every shed carries a parseable computed Retry-After "
+            f"(sample: {sheds[0][3] if sheds else 'n/a'})",
+        )
+        check(
+            any(h is not None and h != 1.0 for h in hints),
+            "Retry-After is computed from queue state, not the "
+            "hardcoded 1",
+        )
+
+        def shed_ratio(cls):
+            rows = by_cls[cls]
+            return (
+                sum(1 for r in rows if r[1] in (429, 503))
+                / max(1, len(rows))
+            )
+
+        crit_shed = shed_ratio(admission.CRITICAL)
+        shed_shed = shed_ratio(admission.SHEDDABLE)
+        check(
+            shed_shed > crit_shed,
+            f"sheddable shed first ({shed_shed:.2f} > critical "
+            f"{crit_shed:.2f})",
+        )
+        crit_good = [
+            r for r in by_cls[admission.CRITICAL]
+            if r[1] == 200 and r[2] <= DEADLINE_MS / 1000.0
+        ]
+        check(
+            len(crit_good) >= 0.5 * len(by_cls[admission.CRITICAL]),
+            "critical class keeps the majority of its goodput "
+            f"({len(crit_good)}/{len(by_cls[admission.CRITICAL])})",
+        )
+        check(
+            goodput >= 0.5 * capacity,
+            f"HTTP goodput {goodput:.0f}qps holds >= 50% of capacity "
+            f"{capacity:.0f}qps at 2x offered (strict 80% gate is "
+            "part A's in-process rig)",
+        )
+
+        # the limiter's telemetry surface is live
+        with urllib.request.urlopen(
+            base + "/metrics.json", timeout=10
+        ) as resp:
+            metrics = json.loads(resp.read())
+
+        def sample(name, **labels):
+            for s in metrics.get(name, {}).get("samples", ()):
+                if all(
+                    s.get("labels", {}).get(k) == v
+                    for k, v in labels.items()
+                ):
+                    return s.get("value", s.get("count"))
+            return None
+
+        limit = sample("pio_admission_limit", service="engine")
+        check(
+            limit is not None and limit > 0,
+            f"pio_admission_limit gauge live (limit={limit})",
+        )
+        check(
+            sample("pio_admission_inflight", service="engine")
+            is not None,
+            "pio_admission_inflight gauge live",
+        )
+        shed_count = sum(
+            s.get("value", 0)
+            for s in metrics.get(
+                "pio_admission_shed_total", {}
+            ).get("samples", ())
+        )
+        check(
+            shed_count > 0,
+            f"pio_admission_shed_total counted {shed_count:.0f} sheds "
+            "by class",
+        )
+        check(
+            sample(
+                "pio_http_rejected_total",
+                service="engine", reason="overload",
+            ) is not None,
+            "pio_http_rejected_total{reason=overload} counted",
+        )
+    finally:
+        http.shutdown()
+        server.close()
+
+
+def main() -> int:
+    out_path = os.path.join(REPO, "SERVING_BENCH.json")
+    part_a_recorded_proof(out_path)
+    part_b_http(out_path)
+    if FAILURES:
+        print(
+            f"overload_smoke: FAILED ({len(FAILURES)}): "
+            + "; ".join(FAILURES),
+            file=sys.stderr,
+        )
+        return 1
+    print("overload_smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,51 @@
+"""Kill-9 crash-test writer for the native event log.
+
+Appends events one at a time with ``PIO_EVENTLOG_FSYNC=1`` (set by the
+spawning test) and prints ``ACK <i> <event_id>`` — flushed — only
+AFTER ``insert`` returned, i.e. after the batch-commit fsync. The
+parent test SIGKILLs this process mid-stream and asserts that every
+acked event replays cleanly from the reopened log: the durable-prefix
+contract behind the ROADMAP continuous-training ingest path.
+
+Usage: python tests/eventlog_crash_child.py <log-dir>
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from predictionio_tpu.data import DataMap, Event  # noqa: E402
+from predictionio_tpu.data.storage.eventlog import (  # noqa: E402
+    EventLogEvents,
+)
+
+
+def main() -> int:
+    backend = EventLogEvents({"PATH": sys.argv[1]})
+    backend.init(1)
+    t0 = dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc)
+    i = 0
+    while True:
+        event = Event(
+            event="rate",
+            entity_type="user",
+            entity_id=f"u{i}",
+            target_entity_type="item",
+            target_entity_id=f"i{i % 7}",
+            properties=DataMap({"n": i}),
+            event_time=t0 + dt.timedelta(seconds=i),
+        )
+        event_id = backend.insert(event, 1)
+        # the ack the parent trusts: printed strictly after the
+        # committed (fsynced) append returned
+        print(f"ACK {i} {event_id}", flush=True)
+        i += 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,494 @@
+"""The admission control plane (docs/robustness.md "Overload &
+backpressure"): criticality parsing/propagation, the gradient limiter's
+adaptation, class-ordered shedding, per-tenant fair share, the computed
+Retry-After contract, and the HTTP wiring."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.obs import MetricRegistry
+from predictionio_tpu.serving import admission, resilience
+from predictionio_tpu.serving.admission import (
+    CRITICAL,
+    DEFAULT,
+    SHEDDABLE,
+    AdmissionConfig,
+    AdmissionController,
+    AdmissionRejected,
+    GradientLimiter,
+)
+from predictionio_tpu.serving.http import (
+    HTTPServer,
+    Response,
+    Router,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_context():
+    admission.set_criticality(DEFAULT)
+    resilience.set_deadline(None)
+    yield
+    admission.set_criticality(DEFAULT)
+    resilience.set_deadline(None)
+
+
+class _Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class TestCriticality:
+    @pytest.mark.parametrize(
+        "raw,expected",
+        [
+            (None, DEFAULT),
+            ("", DEFAULT),
+            ("critical", CRITICAL),
+            ("CRITICAL", CRITICAL),
+            ("  sheddable ", SHEDDABLE),
+            ("default", DEFAULT),
+            ("vip", DEFAULT),  # unknown never promotes nor refuses
+        ],
+    )
+    def test_parse(self, raw, expected):
+        assert admission.parse_criticality(raw) == expected
+
+    def test_contextvar_round_trip(self):
+        assert admission.get_criticality() == DEFAULT
+        admission.set_criticality(CRITICAL)
+        assert admission.get_criticality() == CRITICAL
+        admission.set_criticality("junk")  # coerced, never raises
+        assert admission.get_criticality() == DEFAULT
+
+    def test_context_manager_restores(self):
+        with admission.criticality(SHEDDABLE):
+            assert admission.get_criticality() == SHEDDABLE
+        assert admission.get_criticality() == DEFAULT
+
+    def test_rank_order(self):
+        assert (
+            admission.CLASS_RANK[SHEDDABLE]
+            < admission.CLASS_RANK[DEFAULT]
+            < admission.CLASS_RANK[CRITICAL]
+        )
+
+
+class TestRetryAfterWire:
+    def test_format_floors_and_rounds(self):
+        assert admission.format_retry_after(0.0) == "0.05"
+        assert admission.format_retry_after(1.234) == "1.23"
+
+    @pytest.mark.parametrize(
+        "raw,expected",
+        [
+            ("1", 1.0),
+            ("0.25", 0.25),
+            (None, None),
+            ("", None),
+            ("soon", None),
+            ("nan", None),
+            ("inf", None),
+            ("-2", None),
+        ],
+    )
+    def test_parse(self, raw, expected):
+        assert admission.parse_retry_after(raw) == expected
+
+    def test_round_trips_own_format(self):
+        assert admission.parse_retry_after(
+            admission.format_retry_after(0.3)
+        ) == 0.3
+
+
+class TestGradientLimiter:
+    def _limiter(self, clock, **overrides):
+        cfg = AdmissionConfig(
+            initial_limit=overrides.pop("initial_limit", 32.0),
+            min_limit=overrides.pop("min_limit", 4.0),
+            max_limit=overrides.pop("max_limit", 1024.0),
+            **overrides,
+        )
+        return GradientLimiter(cfg, clock=clock)
+
+    def test_healthy_latency_grows_limit(self):
+        clock = _Clock()
+        lim = self._limiter(clock)
+        start = lim.limit
+        for _ in range(50):
+            clock.advance(0.01)
+            lim.on_sample(0.010)  # flat latency = no queueing signal
+        assert lim.limit > start
+        assert lim.samples == 50
+
+    def test_inflated_latency_shrinks_limit(self):
+        clock = _Clock()
+        lim = self._limiter(clock)
+        for _ in range(10):
+            clock.advance(0.01)
+            lim.on_sample(0.010)  # establish a 10ms baseline
+        grown = lim.limit
+        for _ in range(50):
+            clock.advance(0.01)
+            lim.on_sample(0.100)  # 10x the baseline: deep queueing
+        assert lim.limit < grown
+
+    def test_on_drop_is_multiplicative_and_rate_limited(self):
+        clock = _Clock()
+        lim = self._limiter(clock, decrease_ratio=0.5)
+        before = lim.limit
+        lim.on_drop()
+        assert lim.limit == pytest.approx(before * 0.5)
+        # a storm of drops within the same latency interval is ONE
+        # signal, not a slam to the floor
+        lim.on_drop()
+        lim.on_drop()
+        assert lim.limit == pytest.approx(before * 0.5)
+        assert lim.drops == 1
+        clock.advance(10.0)
+        lim.on_drop()
+        assert lim.limit == pytest.approx(before * 0.25)
+
+    def test_drop_never_goes_below_min(self):
+        clock = _Clock()
+        lim = self._limiter(clock, min_limit=8.0, initial_limit=9.0)
+        for _ in range(20):
+            clock.advance(10.0)
+            lim.on_drop()
+        assert lim.limit == 8.0
+
+    def test_baseline_window_forgets_old_minimum(self):
+        clock = _Clock()
+        lim = self._limiter(clock, baseline_window_s=5.0)
+        lim.on_sample(0.001)  # one anomalously fast sample
+        assert lim.baseline_s() == pytest.approx(0.001)
+        # two full window rotations later the old min is gone and the
+        # baseline reflects current reality
+        for _ in range(4):
+            clock.advance(6.0)
+            lim.on_sample(0.050)
+        assert lim.baseline_s() == pytest.approx(0.050)
+
+    def test_garbage_samples_ignored(self):
+        clock = _Clock()
+        lim = self._limiter(clock)
+        lim.on_sample(-1.0)
+        lim.on_sample(float("nan"))
+        lim.on_sample(float("inf"))
+        assert lim.samples == 0
+
+    def test_initial_clamped_to_floor(self):
+        clock = _Clock()
+        lim = self._limiter(clock, initial_limit=2.0, min_limit=16.0)
+        assert lim.limit == 16.0
+
+
+def _fixed_controller(limit: float, **cfg_overrides) -> AdmissionController:
+    """A controller whose limit cannot move — isolates the shedding
+    policy from the limiter dynamics."""
+    cfg = AdmissionConfig(
+        initial_limit=limit, min_limit=limit, max_limit=limit,
+        **cfg_overrides,
+    )
+    return AdmissionController(
+        "test", registry=MetricRegistry(), config=cfg
+    )
+
+
+def _samples(registry: MetricRegistry, name: str) -> list[dict]:
+    return registry.to_dict().get(name, {}).get("samples", [])
+
+
+class TestAdmissionController:
+    def test_lowest_class_sheds_first(self):
+        ctrl = _fixed_controller(10.0)
+        # sheddable fills to 60% of the limit, then sheds
+        for _ in range(6):
+            ctrl.try_acquire(SHEDDABLE)
+        with pytest.raises(AdmissionRejected) as e:
+            ctrl.try_acquire(SHEDDABLE)
+        assert e.value.status == 503 and e.value.reason == "limit"
+        assert e.value.retry_after_s > 0
+        # default still has room up to 85%
+        ctrl.try_acquire(DEFAULT)
+        ctrl.try_acquire(DEFAULT)
+        with pytest.raises(AdmissionRejected):
+            ctrl.try_acquire(DEFAULT)
+        # critical keeps the full limit
+        ctrl.try_acquire(CRITICAL)
+        ctrl.try_acquire(CRITICAL)
+        assert ctrl.inflight == 10
+        with pytest.raises(AdmissionRejected):
+            ctrl.try_acquire(CRITICAL)
+
+    def test_shed_counter_carries_class_and_reason(self):
+        ctrl = _fixed_controller(10.0)
+        registry = MetricRegistry()
+        ctrl2 = AdmissionController(
+            "svc", registry=registry,
+            config=AdmissionConfig(
+                initial_limit=1.0, min_limit=1.0, max_limit=1.0
+            ),
+        )
+        del ctrl  # only ctrl2's registry is inspected
+        ctrl2.try_acquire(CRITICAL)
+        with pytest.raises(AdmissionRejected):
+            ctrl2.try_acquire(SHEDDABLE)
+        rows = _samples(registry, "pio_admission_shed_total")
+        assert any(
+            r["labels"]
+            == {"service": "svc", "class": SHEDDABLE, "reason": "limit"}
+            and r["value"] == 1
+            for r in rows
+        )
+
+    def test_fair_share_refuses_the_hot_tenant_only(self):
+        ctrl = _fixed_controller(20.0, fair_pressure=0.5)
+        for _ in range(12):
+            ctrl.try_acquire(DEFAULT, tenant="hot")
+        # under pressure (>10 inflight), a second tenant still gets in
+        ctrl.try_acquire(DEFAULT, tenant="cold")
+        # the hot tenant is past its equal share (20/2 = 10): 429
+        with pytest.raises(AdmissionRejected) as e:
+            ctrl.try_acquire(DEFAULT, tenant="hot")
+        assert e.value.status == 429 and e.value.reason == "fairshare"
+        # critical work from the hot tenant is exempt
+        ctrl.try_acquire(CRITICAL, tenant="hot")
+        # the cold tenant keeps flowing
+        ctrl.try_acquire(DEFAULT, tenant="cold")
+
+    def test_release_outcomes_feed_the_limiter(self):
+        ctrl = _fixed_controller(10.0)
+        lim = ctrl.limiter
+        ctrl.try_acquire(DEFAULT, tenant="t")
+        ctrl.release(0.02, admission.OUTCOME_OK, tenant="t")
+        assert lim.samples == 1 and ctrl.inflight == 0
+        ctrl.try_acquire(DEFAULT)
+        ctrl.release(0.02, admission.OUTCOME_DROP)
+        assert lim.drops == 1 and lim.samples == 1
+        ctrl.try_acquire(DEFAULT)
+        ctrl.release(0.02, admission.OUTCOME_IGNORE)
+        # no verdict: neither a sample nor a drop
+        assert lim.drops == 1 and lim.samples == 1
+        assert ctrl.inflight == 0
+
+    def test_retry_after_grows_with_pressure(self):
+        ctrl = _fixed_controller(10.0)
+        ctrl.limiter.on_sample(0.2)  # ewma 200ms
+        idle_hint = ctrl.retry_after_s()
+        for _ in range(10):
+            ctrl.try_acquire(CRITICAL)
+        assert ctrl.retry_after_s() >= idle_hint
+        assert 0.05 <= ctrl.retry_after_s() <= 5.0
+
+    def test_from_env_disable_and_floor(self, monkeypatch):
+        monkeypatch.setenv("PIO_ADMISSION", "0")
+        assert AdmissionController.from_env("x") is None
+        monkeypatch.delenv("PIO_ADMISSION")
+        ctrl = AdmissionController.from_env(
+            "x", registry=MetricRegistry(), min_limit=192.0
+        )
+        assert ctrl is not None
+        # the caller's pipeline floor raises both min and the live limit
+        assert ctrl.limiter.limit >= 192.0
+
+
+class TestAdmissionOverHTTP:
+    def _serve(self, handler, controller, registry=None):
+        router = Router()
+        router.route("GET", "/work", handler)
+        router.admission = controller
+        http = HTTPServer(
+            router, host="127.0.0.1", port=0,
+            service="test", registry=registry,
+        )
+        http.start()
+        return http
+
+    def _get(self, url, headers=None):
+        req = urllib.request.Request(url, headers=headers or {})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status, json.loads(resp.read()), resp.headers
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"null"), e.headers
+
+    def test_limit_shed_is_503_with_computed_retry_after(self):
+        release = threading.Event()
+
+        def handler(request):
+            release.wait(5)
+            return Response(200, {"ok": True})
+
+        ctrl = _fixed_controller(2.0)
+        http = self._serve(handler, ctrl)
+        base = f"http://127.0.0.1:{http.port}"
+        results = []
+        lock = threading.Lock()
+
+        def hit():
+            # critical: may fill the FULL limit of 2 (default would cap
+            # at 85%), so exactly two admit and two shed
+            out = self._get(
+                base + "/work",
+                {admission.CRITICALITY_HEADER: "critical"},
+            )
+            with lock:
+                results.append(out)
+
+        threads = [
+            threading.Thread(target=hit, daemon=True) for _ in range(4)
+        ]
+        try:
+            for t in threads:
+                t.start()
+                time.sleep(0.05)  # order admissions before the sheds
+            # two admitted (limit 2), two shed while they run
+            deadline = time.monotonic() + 5
+            while ctrl.inflight < 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            release.set()
+            for t in threads:
+                t.join(10)
+            statuses = sorted(r[0] for r in results)
+            assert statuses == [200, 200, 503, 503]
+            shed_headers = [
+                h for s, _b, h in results if s == 503
+            ]
+            for h in shed_headers:
+                hint = admission.parse_retry_after(h.get("Retry-After"))
+                assert hint is not None and hint >= 0.05
+        finally:
+            release.set()
+            http.shutdown()
+
+    def test_inflight_released_after_each_request(self):
+        ctrl = _fixed_controller(2.0)
+        http = self._serve(
+            lambda request: Response(200, {"ok": True}), ctrl
+        )
+        try:
+            base = f"http://127.0.0.1:{http.port}"
+            for _ in range(5):  # more requests than the limit: all 200
+                status, _, _ = self._get(base + "/work")
+                assert status == 200
+            assert ctrl.inflight == 0
+            assert ctrl.limiter.samples == 5
+        finally:
+            http.shutdown()
+
+    def test_telemetry_surface_exempt_from_admission(self):
+        ctrl = _fixed_controller(1.0)
+        registry = MetricRegistry()
+        from predictionio_tpu.serving.http import install_metrics_routes
+
+        router = Router()
+        install_metrics_routes(router, registry)
+        release = threading.Event()
+
+        def handler(request):
+            release.wait(5)
+            return Response(200, {"ok": True})
+
+        router.route("GET", "/work", handler)
+        router.admission = ctrl
+        http = HTTPServer(
+            router, host="127.0.0.1", port=0,
+            service="test", registry=registry,
+        )
+        http.start()
+        base = f"http://127.0.0.1:{http.port}"
+        t = threading.Thread(
+            target=lambda: self._get(base + "/work"), daemon=True
+        )
+        try:
+            t.start()
+            deadline = time.monotonic() + 5
+            while ctrl.inflight < 1 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            # the limit is fully consumed, yet the operator's window
+            # stays open
+            for path in ("/healthz", "/metrics.json"):
+                status, _, _ = self._get(base + path)
+                assert status == 200, path
+        finally:
+            release.set()
+            t.join(10)
+            http.shutdown()
+
+    def test_criticality_header_installs_contextvar(self):
+        seen = []
+
+        def handler(request):
+            seen.append(
+                (request.criticality, admission.get_criticality())
+            )
+            return Response(200, {})
+
+        ctrl = _fixed_controller(10.0)
+        http = self._serve(handler, ctrl)
+        try:
+            base = f"http://127.0.0.1:{http.port}"
+            self._get(
+                base + "/work",
+                {admission.CRITICALITY_HEADER: "sheddable"},
+            )
+            self._get(base + "/work")  # no header: default, not stale
+            assert seen == [
+                (SHEDDABLE, SHEDDABLE), (DEFAULT, DEFAULT)
+            ]
+        finally:
+            http.shutdown()
+
+    def test_overload_shed_counted_in_http_rejected(self):
+        registry = MetricRegistry()
+        ctrl = AdmissionController(
+            "test", registry=registry,
+            config=AdmissionConfig(
+                initial_limit=1.0, min_limit=1.0, max_limit=1.0
+            ),
+        )
+        release = threading.Event()
+
+        def handler(request):
+            release.wait(5)
+            return Response(200, {})
+
+        http = self._serve(handler, ctrl, registry=registry)
+        base = f"http://127.0.0.1:{http.port}"
+        t = threading.Thread(
+            target=lambda: self._get(base + "/work"), daemon=True
+        )
+        try:
+            t.start()
+            deadline = time.monotonic() + 5
+            while ctrl.inflight < 1 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            status, _, _ = self._get(base + "/work")
+            assert status == 503
+            rows = _samples(registry, "pio_http_rejected_total")
+            assert any(
+                r["labels"].get("reason") == "overload"
+                and r["value"] == 1
+                for r in rows
+            )
+            # and the gauges the ISSUE names are live
+            limits = _samples(registry, "pio_admission_limit")
+            assert any(r["value"] == 1.0 for r in limits)
+        finally:
+            release.set()
+            t.join(10)
+            http.shutdown()

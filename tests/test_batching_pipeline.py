@@ -20,6 +20,7 @@ import pytest
 from predictionio_tpu.obs import MetricRegistry
 from predictionio_tpu.serving import resilience
 from predictionio_tpu.serving.batching import (
+    BatcherOverloaded,
     MicroBatcher,
     TwoPhaseBatchFn,
 )
@@ -435,5 +436,159 @@ class TestCallDeadlineCap:
         )
         try:
             assert b(21, timeout=5) == 42
+        finally:
+            b.close()
+
+
+class TestOverloadClassAwareQueue:
+    """Criticality-aware eviction at the queue bound and deadline-aware
+    batch selection (docs/robustness.md "Overload & backpressure")."""
+
+    def _shed_count(self, registry, name, cls):
+        for s in registry.to_dict().get(
+            "pio_shed_total", {}
+        ).get("samples", []):
+            if s["labels"] == {"batcher": name, "class": cls}:
+                return s["value"]
+        return 0.0
+
+    def test_higher_class_evicts_lowest_and_counts_shed_class(self):
+        from predictionio_tpu.serving import admission
+
+        registry = MetricRegistry()
+        fn = _TwoPhase()
+        fn.release.clear()  # hold the pipeline: batches park in collect
+        b = MicroBatcher(
+            TwoPhaseBatchFn(fn.dispatch, fn.collect),
+            max_batch=1, max_wait_ms=1, max_queue=2,
+            pipeline_depth=1, registry=registry, name="evict",
+        )
+        try:
+            f_w1 = b.submit("w1")  # dispatched, stuck in collect
+            time.sleep(0.1)
+            f_w2 = b.submit("w2")  # taken by the collector, waiting
+            time.sleep(0.1)       # on the pipeline slot
+            with admission.criticality(admission.SHEDDABLE):
+                f_s1 = b.submit("s1")
+                f_s2 = b.submit("s2")
+            # the queue is at its bound (2): a critical submission
+            # evicts a sheddable slot instead of being refused
+            with admission.criticality(admission.CRITICAL):
+                f_c1 = b.submit("c1")
+            evicted = [f for f in (f_s1, f_s2) if f.done()]
+            assert len(evicted) == 1
+            with pytest.raises(BatcherOverloaded):
+                evicted[0].result(0)
+            assert self._shed_count(registry, "evict", "sheddable") == 1
+            # equal class cannot evict: the bound refuses it, counted
+            # against ITS class
+            with admission.criticality(admission.CRITICAL):
+                b.submit("c2")  # evicts the remaining sheddable
+                with pytest.raises(BatcherOverloaded):
+                    b.submit("c3")
+            assert self._shed_count(registry, "evict", "sheddable") == 2
+            assert self._shed_count(registry, "evict", "critical") == 1
+            fn.release.set()
+            # everything still queued is served
+            assert f_w1.result(10) == "W1"
+            assert f_w2.result(10) == "W2"
+            assert f_c1.result(10) == "C1"
+        finally:
+            fn.release.set()
+            b.close()
+
+    def test_default_cannot_evict_default(self):
+        fn = _TwoPhase()
+        fn.release.clear()
+        b = MicroBatcher(
+            TwoPhaseBatchFn(fn.dispatch, fn.collect),
+            max_batch=1, max_wait_ms=1, max_queue=1, pipeline_depth=1,
+        )
+        try:
+            b.submit("w1")
+            time.sleep(0.1)
+            b.submit("w2")
+            time.sleep(0.1)
+            f_q = b.submit("q1")  # fills the queue
+            with pytest.raises(BatcherOverloaded):
+                b.submit("q2")
+            assert not f_q.done()  # the queued peer was NOT evicted
+        finally:
+            fn.release.set()
+            b.close()
+
+    def test_near_deadline_slots_selected_first(self):
+        """When the backlog exceeds one batch, the nearest-deadline
+        slots dispatch first — urgent work must not rot behind slack
+        work submitted earlier."""
+        fn = _TwoPhase()
+        fn.release.clear()
+        b = MicroBatcher(
+            TwoPhaseBatchFn(fn.dispatch, fn.collect),
+            max_batch=2, max_wait_ms=1, max_queue=0, pipeline_depth=1,
+        )
+        try:
+            b.submit("w1")
+            time.sleep(0.1)
+            b.submit("w2")
+            time.sleep(0.1)
+            # backlog of 3 > max_batch: two slack-deadline slots ahead
+            # of one urgent slot in ARRIVAL order
+            resilience.set_deadline(resilience.Deadline.after(60.0))
+            f_far_a = b.submit("far_a")
+            f_far_b = b.submit("far_b")
+            resilience.set_deadline(resilience.Deadline.after(5.0))
+            f_near = b.submit("near")
+            resilience.set_deadline(None)
+            fn.release.set()
+            for f in (f_far_a, f_far_b, f_near):
+                f.result(10)
+            # third dispatched batch = the backlog selection: the
+            # urgent slot jumped the slack one that arrived before it
+            assert "near" in fn.dispatched[2]
+            assert fn.dispatched[3] == ["far_b"]
+        finally:
+            fn.release.set()
+            resilience.set_deadline(None)
+            b.close()
+
+    def test_fifo_preserved_without_deadlines(self):
+        """Deadline-less traffic keeps strict arrival order even when
+        the backlog exceeds one batch."""
+        fn = _TwoPhase()
+        fn.release.clear()
+        b = MicroBatcher(
+            TwoPhaseBatchFn(fn.dispatch, fn.collect),
+            max_batch=2, max_wait_ms=1, max_queue=0, pipeline_depth=1,
+        )
+        try:
+            b.submit("w1")
+            time.sleep(0.1)
+            b.submit("w2")
+            time.sleep(0.1)
+            futures = [b.submit(f"q{i}") for i in range(5)]
+            fn.release.set()
+            for f in futures:
+                f.result(10)
+            backlog_batches = fn.dispatched[2:]
+            assert [i for batch in backlog_batches for i in batch] == [
+                f"q{i}" for i in range(5)
+            ]
+        finally:
+            fn.release.set()
+            b.close()
+
+    def test_retry_after_hint_tracks_backlog(self):
+        fn = _TwoPhase()
+        b = MicroBatcher(
+            TwoPhaseBatchFn(fn.dispatch, fn.collect),
+            max_batch=4, max_wait_ms=1,
+        )
+        try:
+            assert 0.05 <= b.retry_after_s() <= 5.0
+            for i in range(8):
+                b.submit(i)
+            idle_after = b.retry_after_s()
+            assert 0.05 <= idle_after <= 5.0
         finally:
             b.close()

@@ -1108,3 +1108,224 @@ class TestHTTPStoreResilience:
         # maps CircuitOpenError to a retryable 503
         assert isinstance(e.value, StorageError)
         assert isinstance(e.value, CircuitOpenError)
+
+
+class TestAdmissionBreakerDeadlineInteraction:
+    """Limiter × breaker × deadline (docs/robustness.md "Overload &
+    backpressure"): fast-fails must not feed the latency signal, sheds
+    must not poison breakers, and the Retry-After contract is honored
+    inside the deadline budget — with the drain hint staying fixed."""
+
+    def _admitted_server(self, handler):
+        from predictionio_tpu.serving import admission
+
+        router = Router()
+        router.route("GET", "/work", handler)
+        ctrl = admission.AdmissionController(
+            "test",
+            registry=MetricRegistry(),
+            config=admission.AdmissionConfig(
+                initial_limit=8.0, min_limit=8.0, max_limit=8.0
+            ),
+        )
+        router.admission = ctrl
+        http = HTTPServer(router, host="127.0.0.1", port=0)
+        http.start()
+        return http, ctrl
+
+    def test_circuit_open_fast_fail_is_not_a_latency_sample(self):
+        """A dependency's open breaker answers in microseconds; feeding
+        that to the limiter would drag the latency signal down and
+        inflate the limit far past real capacity."""
+        def handler(request):
+            raise CircuitOpenError("store:9500")
+
+        http, ctrl = self._admitted_server(handler)
+        try:
+            base = f"http://127.0.0.1:{http.port}"
+            for _ in range(5):
+                status, _, headers = _get(base + "/work")
+                assert status == 503
+                # computed hint, even on the fast-fail path
+                assert float(headers.get("Retry-After")) > 0
+            assert ctrl.limiter.samples == 0
+            assert ctrl.limiter.drops == 0  # no verdict either way
+            assert ctrl.inflight == 0  # every admit released
+        finally:
+            http.shutdown()
+
+    def test_deadline_miss_feeds_aimd_not_the_latency_ewma(self):
+        def handler(request):
+            raise DeadlineExceeded("budget gone")
+
+        http, ctrl = self._admitted_server(handler)
+        try:
+            base = f"http://127.0.0.1:{http.port}"
+            status, _, _ = _get(base + "/work")
+            assert status == 504
+            assert ctrl.limiter.drops == 1
+            assert ctrl.limiter.samples == 0
+        finally:
+            http.shutdown()
+
+    def test_shed_responses_do_not_trip_the_client_breaker(
+        self, monkeypatch
+    ):
+        """Five consecutive 503s normally trip a breaker — but a shed
+        carrying Retry-After is the server ANSWERING about overload;
+        tripping on it would blackhole a merely-busy host (and fail
+        sibling requests sharing the target breaker for nothing)."""
+        from predictionio_tpu.client import PIOClientError, _request
+
+        monkeypatch.setenv("PIO_RETRY_MAX_ATTEMPTS", "1")
+        calls = {"n": 0}
+        router = Router()
+
+        def shed(request):
+            calls["n"] += 1
+            return Response(
+                503,
+                {"message": "server overloaded"},
+                headers={"Retry-After": "0.05"},
+            )
+
+        router.route("GET", "/shed", shed)
+        http = HTTPServer(router, host="127.0.0.1", port=0)
+        http.start()
+        base = f"http://127.0.0.1:{http.port}"
+        target = base.removeprefix("http://")
+        try:
+            for _ in range(7):  # breaker default threshold is 5
+                with pytest.raises(PIOClientError) as e:
+                    _request(f"{base}/shed")
+                assert e.value.status == 503
+            breaker = resilience.get_breaker(target)
+            assert breaker.state == resilience.CLOSED
+            assert calls["n"] == 7
+            # a sibling request through the same breaker still flows
+            router.route("GET", "/ok", lambda r: Response(200, {"k": 1}))
+            assert _request(f"{base}/ok") == {"k": 1}
+        finally:
+            http.shutdown()
+
+    def test_client_honors_retry_after_hint(self, monkeypatch):
+        """A shed MARKED unprocessed (X-PIO-Shed) makes even a POST
+        safe to replay — after sleeping what the server asked."""
+        from predictionio_tpu.client import _request
+        from predictionio_tpu.serving import admission
+
+        monkeypatch.setenv("PIO_RETRY_MAX_ATTEMPTS", "3")
+        state = {"n": 0, "times": []}
+        router = Router()
+
+        def flaky(request):
+            state["n"] += 1
+            state["times"].append(time.monotonic())
+            if state["n"] <= 2:
+                return Response(
+                    503,
+                    {"message": "overloaded"},
+                    headers={
+                        "Retry-After": "0.08",
+                        admission.SHED_HEADER: "limit",
+                    },
+                )
+            return Response(200, {"served": True})
+
+        router.route("POST", "/q", flaky)
+        http = HTTPServer(router, host="127.0.0.1", port=0)
+        http.start()
+        try:
+            out = _request(
+                f"http://127.0.0.1:{http.port}/q", "POST", {"x": 1}
+            )
+            assert out == {"served": True}
+            assert state["n"] == 3
+            # each retry waited at least the hinted delay
+            gaps = [
+                b - a
+                for a, b in zip(state["times"], state["times"][1:])
+            ]
+            assert all(g >= 0.08 for g in gaps), gaps
+        finally:
+            http.shutdown()
+
+    def test_unmarked_503_post_is_not_replayed(self, monkeypatch):
+        """A 503 + Retry-After WITHOUT the shed marker (e.g. a
+        dependency's open breaker surfacing mid-handler) may have
+        partially run: no breaker failure, but a POST must surface the
+        error instead of replaying a possibly-applied write."""
+        from predictionio_tpu.client import PIOClientError, _request
+
+        monkeypatch.setenv("PIO_RETRY_MAX_ATTEMPTS", "3")
+        calls = {"n": 0}
+        router = Router()
+
+        def half_done(request):
+            calls["n"] += 1
+            return Response(
+                503,
+                {"message": "circuit open for store"},
+                headers={"Retry-After": "0.05"},
+            )
+
+        router.route("POST", "/q", half_done)
+        http = HTTPServer(router, host="127.0.0.1", port=0)
+        http.start()
+        base = f"http://127.0.0.1:{http.port}"
+        try:
+            with pytest.raises(PIOClientError) as e:
+                _request(f"{base}/q", "POST", {"x": 1})
+            assert e.value.status == 503
+            assert calls["n"] == 1  # never replayed
+            breaker = resilience.get_breaker(
+                base.removeprefix("http://")
+            )
+            assert breaker.state == resilience.CLOSED
+        finally:
+            http.shutdown()
+
+    def test_retry_after_beyond_deadline_budget_fails_fast(
+        self, monkeypatch
+    ):
+        """A hint the budget can't afford is not slept on — the shed
+        surfaces immediately instead of burning the caller's time."""
+        from predictionio_tpu.client import PIOClientError, _request
+
+        monkeypatch.setenv("PIO_RETRY_MAX_ATTEMPTS", "3")
+        router = Router()
+        router.route(
+            "GET", "/shed",
+            lambda r: Response(
+                503, {"message": "busy"}, headers={"Retry-After": "30"}
+            ),
+        )
+        http = HTTPServer(router, host="127.0.0.1", port=0)
+        http.start()
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(PIOClientError):
+                _request(
+                    f"http://127.0.0.1:{http.port}/shed", timeout=0.5
+                )
+            assert time.monotonic() - t0 < 0.5
+        finally:
+            http.shutdown()
+
+    def test_drain_keeps_the_fixed_retry_after(self):
+        """The satellite contract: computed hints everywhere EXCEPT
+        drain — a draining server's 503 says 'come back in about a
+        probe interval', independent of queue state."""
+        router = Router()
+        router.route("GET", "/work", lambda r: Response(200, {}))
+        http = HTTPServer(router, host="127.0.0.1", port=0)
+        http.start()
+        try:
+            http.begin_drain()
+            status, _, headers = _get(
+                f"http://127.0.0.1:{http.port}/work"
+            )
+            assert status == 503
+            assert headers.get("Retry-After") == "1"
+        finally:
+            http.shutdown()

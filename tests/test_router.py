@@ -43,6 +43,8 @@ class FakeReplica:
         self.warm = warm
         self.fail_next = 0  # respond 500 to this many requests
         self.reset_next = 0  # slam the connection on this many
+        self.shed_next = 0  # 503 + Retry-After (admission shed) on
+        self.shed_hint = "0.30"  # ... this many, with this hint
         self.delay_s = 0.0
         self.calls = 0
         self.seen_deadlines: list[str | None] = []
@@ -69,6 +71,13 @@ class FakeReplica:
             if self.fail_next > 0:
                 self.fail_next -= 1
                 raise HTTPError(500, "injected replica failure")
+            if self.shed_next > 0:
+                self.shed_next -= 1
+                return Response(
+                    503,
+                    {"message": "server overloaded"},
+                    headers={"Retry-After": self.shed_hint},
+                )
         if self.delay_s:
             time.sleep(self.delay_s)
         q = json.loads(request.body)
@@ -655,3 +664,129 @@ class TestTracing:
         # the replica runs in-process here too, so its root span landed
         # in the same process tracer under the same trace id
         assert any(n.startswith("replica-") for n in names), names
+
+
+class TestSaturationBackpressure:
+    """A replica shedding 503 + Retry-After is soft-unhealthy, not
+    sick: breaker success, failover to a sibling, deprioritized in
+    selection, and a router-level shed once EVERYONE is saturated
+    (docs/robustness.md "Overload & backpressure")."""
+
+    def test_shed_fails_over_without_breaker_failure(self, pair):
+        router, http, a, b = pair
+        a.shed_next = 5
+        base = f"http://127.0.0.1:{http.port}"
+        status, body, _ = post(
+            base, "/queries.json", {"x": 3},
+            headers={"X-PIO-Deadline": "10000"},
+        )
+        assert status == 200 and body["replica"] == "b"
+        with router._lock:
+            rep_a = router._replicas["a"]
+        # the shed marked it saturated for the hinted window, and its
+        # breaker saw an ANSWER, not a failure
+        assert rep_a.saturated
+        assert rep_a.breaker.state == resilience.CLOSED
+        # while saturated, traffic prefers the sibling outright
+        for _ in range(3):
+            status, body, _ = post(base, "/queries.json", {"x": 4})
+            assert status == 200 and body["replica"] == "b"
+
+    def test_all_saturated_sheds_at_router_with_soonest_hint(self, pair):
+        router, http, a, b = pair
+        a.shed_next = 2
+        b.shed_next = 2
+        base = f"http://127.0.0.1:{http.port}"
+        status, body, headers = post(
+            base, "/queries.json", {"x": 5},
+            headers={"X-PIO-Deadline": "10000"},
+        )
+        # both replicas answered a shed: the router relays the
+        # backpressure (503 + computed hint), never a 502
+        assert status == 503
+        hint = headers.get("Retry-After")
+        assert hint is not None and 0 < float(hint) <= 5.0
+        assert "saturated" in body["message"]
+        assert counter_value(
+            router._registry, "pio_router_shed_total"
+        ) == 1
+        # next request, with both replicas still inside their hint
+        # window: shed at the router BEFORE burning a replica's budget
+        calls_before = a.calls + b.calls
+        status, _, headers = post(base, "/queries.json", {"x": 6})
+        assert status == 503 and headers.get("Retry-After")
+        assert a.calls + b.calls == calls_before
+        # once the hint window passes, traffic flows again
+        assert wait_for(
+            lambda: post(base, "/queries.json", {"x": 7})[0] == 200,
+            timeout_s=5,
+        )
+
+    def test_critical_class_bypasses_router_shed(self, pair):
+        from predictionio_tpu.serving import admission
+
+        router, http, a, b = pair
+        a.shed_next = 1
+        b.shed_next = 1
+        base = f"http://127.0.0.1:{http.port}"
+        # saturate both marks
+        post(base, "/queries.json", {"x": 1},
+             headers={"X-PIO-Deadline": "10000"})
+        with router._lock:
+            assert all(r.saturated for r in router._replicas.values())
+        # a critical request is still FORWARDED (the replicas' own
+        # admission keeps the full limit open for it) — and they are
+        # no longer shedding, so it serves
+        calls_before = a.calls + b.calls
+        status, _, _ = post(
+            base, "/queries.json", {"x": 2},
+            headers={admission.CRITICALITY_HEADER: "critical"},
+        )
+        assert status == 200
+        assert a.calls + b.calls > calls_before
+
+    def test_criticality_header_forwarded_to_replica(self, pair):
+        from predictionio_tpu.serving import admission
+
+        router, http, a, b = pair
+        seen = []
+        orig_a, orig_b = a._queries, b._queries
+
+        def spy(rep_orig):
+            def _h(request):
+                seen.append(
+                    request.headers.get(admission.CRITICALITY_HEADER)
+                )
+                return rep_orig(request)
+            return _h
+
+        a._queries = spy(orig_a)
+        b._queries = spy(orig_b)
+        # rebuild routes to pick up the spies
+        for rep in (a, b):
+            rep.http.router._routes = []
+            rep.http.router.route("POST", "/queries.json", rep._queries)
+            rep.http.router.route("GET", "/metrics.json", rep._metrics)
+        base = f"http://127.0.0.1:{http.port}"
+        status, _, _ = post(
+            base, "/queries.json", {"x": 9},
+            headers={admission.CRITICALITY_HEADER: "sheddable"},
+        )
+        assert status == 200
+        assert seen == ["sheddable"]
+
+    def test_empty_pool_hint_is_computed_not_hardcoded(self):
+        router = make_router()  # no replicas at all
+        http = router.serve(host="127.0.0.1", port=0)
+        http.start()
+        try:
+            base = f"http://127.0.0.1:{http.port}"
+            status, _, headers = post(base, "/queries.json", {"x": 1})
+            assert status == 503
+            hint = headers.get("Retry-After")
+            # 2x the probe interval (0.05 in tests) — the recovery
+            # cadence, not the legacy constant "1"
+            assert hint == "0.10"
+        finally:
+            router.close()
+            http.shutdown()
