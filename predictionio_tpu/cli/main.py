@@ -154,14 +154,67 @@ def _fetch_json(target: str, access_key: str = ""):
         return None
 
 
+_CANARY_STATE_NAMES = {
+    0: "idle", 1: "shadowing", 2: "watching", 3: "stable",
+    4: "rejected", 5: "rolled_back",
+}
+
+
+def _model_summary_line(data: dict) -> str | None:
+    """One-line model-lifecycle summary from the new generation/age/
+    last-train gauges, shown ahead of the raw metric dump when the
+    scraped server exposes them (engine servers and trainers)."""
+
+    def gauge(name):
+        family = data.get(name)
+        if not isinstance(family, dict):
+            return None
+        samples = family.get("samples") or []
+        if not samples or "value" not in samples[0]:
+            return None
+        return samples[0]["value"]
+
+    generation = gauge("pio_model_generation")
+    if generation is None:
+        return None
+    parts = [f"model: generation={int(generation)}"]
+    age = gauge("pio_model_age_seconds")
+    if age is not None:
+        parts.append(f"age={age:.0f}s")
+    last_train = gauge("pio_train_last_timestamp_seconds")
+    if last_train:
+        import datetime as _dt
+
+        parts.append(
+            "lastTrain="
+            + _dt.datetime.fromtimestamp(
+                last_train, _dt.timezone.utc
+            ).isoformat(timespec="seconds")
+        )
+    canary = gauge("pio_canary_state")
+    if canary is not None:
+        parts.append(
+            f"canary={_CANARY_STATE_NAMES.get(int(canary), canary)}"
+        )
+    quarantined = gauge("pio_model_quarantined_total")
+    if quarantined:
+        parts.append(f"quarantined={int(quarantined)}")
+    return " ".join(parts)
+
+
 def _print_metrics(url: str, access_key: str = "") -> int:
     """Scrape a live server's ``/metrics.json`` and print a per-metric
-    one-liner (histograms with derived p50/p95/p99)."""
+    one-liner (histograms with derived p50/p95/p99), led by a model-
+    lifecycle summary (generation / age / last-train / canary) when the
+    server exposes those gauges."""
     target = url.rstrip("/") + "/metrics.json"
     data = _fetch_json(target, access_key=access_key)
     if data is None:
         return 1
     try:
+        summary = _model_summary_line(data)
+        if summary:
+            print(summary)
         for name in sorted(data):
             family = data[name]
             for sample in family["samples"]:
@@ -560,6 +613,9 @@ def cmd_train(args) -> int:
         engine_factory=args.engine or "",
         workflow=workflow,
         ctx=_mesh_ctx(args, variant_dict),
+        checkpoint_dir=args.checkpoint_dir or None,
+        checkpoint_every=args.checkpoint_every,
+        resume=args.resume,
     )
     print(f"Training completed. Engine instance: {instance_id}")
     return 0
@@ -633,6 +689,7 @@ def cmd_deploy(args) -> int:
         pipeline_depth=args.pipeline_depth,
         adaptive_wait=not args.no_adaptive_wait,
         admission=not args.no_admission,
+        canary=args.canary,
     )
     multi = args.workers > 1
     if multi and (err := _reuseport_unsupported()):
@@ -659,6 +716,121 @@ def cmd_deploy(args) -> int:
             _workers.rebuild_argv(args.raw_argv, http.port),
         )
     return _serve_foreground(http)
+
+
+def cmd_trainer(args) -> int:
+    """Supervised continuous trainer (docs/training.md): watches event
+    watermarks, fold-ins new users/items, runs checkpointed full
+    retrains, publishes transactional model generations. The default
+    mode supervises the actual training child with the shared
+    backoff respawn loop — kill -9 / preemption mid-epoch respawns the
+    child, which resumes from the latest checkpoint."""
+    import signal as _signal
+    import threading
+
+    base_dir = args.checkpoint_dir or os.path.join(
+        os.environ.get(
+            "PIO_FS_BASEDIR",
+            os.path.join(os.path.expanduser("~"), ".piotpu"),
+        ),
+        "trainer",
+        args.engine_id or args.engine or "default",
+    )
+    if not args.no_supervise and not args.once:
+        from predictionio_tpu.serving import workers as _workers
+
+        child_argv = list(args.raw_argv) + [
+            "--no-supervise", "--checkpoint-dir", base_dir,
+        ]
+
+        def spawn():
+            return subprocess.Popen(
+                [sys.executable, "-m", "predictionio_tpu.cli.main"]
+                + child_argv
+            )
+
+        stopping = threading.Event()
+        slots = [_workers.WorkerSlot(spawn)]
+
+        def _stop(signum, frame):
+            stopping.set()
+
+        _signal.signal(_signal.SIGTERM, _stop)
+        _signal.signal(_signal.SIGINT, _stop)
+        print(f"trainer supervisor: training child pid {slots[0].pid}")
+        try:
+            _workers.supervise_children(slots, stopping)
+        finally:
+            # the child finishes its current run on SIGTERM (the
+            # in-progress epoch chunk checkpoints on schedule either
+            # way); escalate only after a generous drain
+            _workers.terminate_children(slots, 30.0)
+        return 0
+
+    # ---- training child ----
+    from predictionio_tpu.training import ContinuousTrainer, TrainerConfig
+
+    engine, params, engine_id, variant, variant_dict = _resolve(args)
+    config = TrainerConfig(
+        app_name=args.app_name,
+        channel_name=args.channel or None,
+        poll_interval_s=args.poll_interval,
+        min_new_events=args.min_new_events,
+        full_every_events=args.full_every_events,
+        full_every_s=args.full_every_s,
+        checkpoint_dir=base_dir,
+        checkpoint_every=args.checkpoint_every,
+    )
+    os.makedirs(base_dir, exist_ok=True)
+    # pid marker: what a supervisor-external chaos driver (or operator)
+    # kills; the supervising parent respawns and training resumes
+    with open(os.path.join(base_dir, "trainer.pid"), "w") as f:
+        f.write(str(os.getpid()))
+    trainer = ContinuousTrainer(
+        engine,
+        params,
+        engine_id=engine_id,
+        engine_version="1",
+        engine_variant=variant,
+        config=config,
+        ctx=_mesh_ctx(args, variant_dict),
+    )
+    http = None
+    if args.metrics_port:
+        from predictionio_tpu.obs import get_registry, tracing
+        from predictionio_tpu.serving.config import ServerConfig
+        from predictionio_tpu.serving.http import (
+            HTTPServer,
+            Router,
+            install_metrics_routes,
+        )
+
+        router = Router()
+        install_metrics_routes(
+            router, get_registry(), tracing.get_tracer(),
+            server_config=ServerConfig.from_env(),
+        )
+        http = HTTPServer(
+            router,
+            host="127.0.0.1",
+            port=args.metrics_port,
+            service="trainer",
+        )
+        http.start()
+        print(f"trainer metrics on 127.0.0.1:{http.port}/metrics.json")
+    stopping = threading.Event()
+    _signal.signal(_signal.SIGTERM, lambda s, f: stopping.set())
+    try:
+        if args.once:
+            print(f"trainer action: {trainer.poll_once()}")
+        else:
+            trainer.run_forever(stopping)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if http is not None:
+            http.shutdown()
+    return 0
 
 
 def cmd_router(args) -> int:
@@ -1292,6 +1464,24 @@ def build_parser() -> argparse.ArgumentParser:
                 help="data,model mesh shape, e.g. 4,2",
             )
 
+    def _checkpoint_args(p):
+        p.add_argument(
+            "--checkpoint-dir", dest="checkpoint_dir", default="",
+            help="write mid-training factor checkpoints here "
+                 "(atomic npz; enables crash/preemption resume)",
+        )
+        p.add_argument(
+            "--checkpoint-every", dest="checkpoint_every", type=int,
+            default=5,
+            help="iterations between checkpoints (with --checkpoint-dir;"
+                 " default 5)",
+        )
+        p.add_argument(
+            "--resume", action="store_true",
+            help="resume from the latest checkpoint in --checkpoint-dir "
+                 "instead of restarting from scratch",
+        )
+
     p = sub.add_parser("unregister")
     p.add_argument("--engine-id", required=True)
     p.add_argument("--engine-version", default=None)
@@ -1318,6 +1508,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--stop-after-read", action="store_true")
     p.add_argument("--stop-after-prepare", action="store_true")
     p.add_argument("--no-save-model", action="store_true")
+    _checkpoint_args(p)
     p.set_defaults(func=cmd_train)
 
     p = sub.add_parser("eval")
@@ -1368,6 +1559,12 @@ def build_parser() -> argparse.ArgumentParser:
              "docs/robustness.md) — equivalent to PIO_ADMISSION=0",
     )
     p.add_argument(
+        "--canary", action="store_true",
+        help="guard /reload with shadow-scored canary promotion + "
+             "automatic rollback (PIO_CANARY_* env tunes the gate; "
+             "docs/training.md)",
+    )
+    p.add_argument(
         "--workers", type=int, default=1,
         help="SO_REUSEPORT worker processes sharing the port "
              "(CPU-backend serving fronts; 1 = single process)",
@@ -1381,6 +1578,50 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ip", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000)
     p.set_defaults(func=cmd_undeploy)
+
+    p = sub.add_parser("trainer")
+    _engine_args(p)
+    p.add_argument(
+        "--app", dest="app_name", required=True,
+        help="app whose event watermark drives the training triggers",
+    )
+    p.add_argument("--channel", default="")
+    p.add_argument(
+        "--poll-interval", dest="poll_interval", type=float, default=10.0,
+        help="seconds between watermark polls",
+    )
+    p.add_argument(
+        "--min-new-events", dest="min_new_events", type=int, default=1,
+        help="fold-in new users/items once this many events arrived "
+             "since the last published generation (0 = disable fold-in)",
+    )
+    p.add_argument(
+        "--full-every-events", dest="full_every_events", type=int,
+        default=0,
+        help="full retrain once this many events accumulated since the "
+             "last full train (0 = never by count)",
+    )
+    p.add_argument(
+        "--full-every-s", dest="full_every_s", type=float, default=0.0,
+        help="full retrain at least this often in seconds "
+             "(0 = never by time)",
+    )
+    _checkpoint_args(p)
+    p.add_argument(
+        "--metrics-port", dest="metrics_port", type=int, default=0,
+        help="serve /metrics + /metrics.json + /healthz on this port "
+             "(0 = no metrics server)",
+    )
+    p.add_argument(
+        "--once", action="store_true",
+        help="run one watermark poll (train if triggered) and exit",
+    )
+    p.add_argument(
+        "--no-supervise", dest="no_supervise", action="store_true",
+        help="run the training loop directly instead of supervising a "
+             "respawned child (the child mode of the supervisor)",
+    )
+    p.set_defaults(func=cmd_trainer)
 
     p = sub.add_parser("router")
     p.add_argument("--ip", default="0.0.0.0")

@@ -13,11 +13,26 @@ Capability parity with the reference's three-mode persistence
   sharded checkpoints are the intended implementation) and the store
   keeps a manifest marker.
 * RETRAIN — a marker only; deploy re-trains (Engine.scala:208-230).
+
+Transactional generations (docs/training.md "Model generations"): a
+published model is a *generation* — the artifact blob(s) plus a JSON
+manifest recording each artifact's SHA-256, byte size, the training
+watermark it was built from, and its parent generation. The publish
+protocol is write-all-then-commit: artifacts first, the manifest LAST
+(the commit point — a generation without a manifest is invisible to
+checksum-verified loads, so a publisher crash mid-write can never
+become the serving model). Loads verify every artifact's checksum;
+corrupt generations are quarantined (moved aside, counted in
+``pio_model_quarantined_total``) and the caller falls back to the
+last-good generation.
 """
 
 from __future__ import annotations
 
+import datetime as _dt
+import hashlib
 import io
+import json
 import logging
 import pickle
 from typing import Any, Sequence
@@ -30,6 +45,23 @@ from predictionio_tpu.core.controller import Algorithm, PersistenceMode
 logger = logging.getLogger(__name__)
 
 _FORMAT_VERSION = 1
+
+#: generation manifest schema version
+GENERATION_VERSION = 1
+
+
+class ModelIntegrityError(RuntimeError):
+    """A published generation failed checksum verification (torn write,
+    flipped bit, truncated upload). Carries the instance id so callers
+    can quarantine it and fall back to the parent generation."""
+
+    def __init__(self, instance_id: str, reason: str):
+        super().__init__(
+            f"model generation {instance_id} failed integrity "
+            f"verification: {reason}"
+        )
+        self.instance_id = instance_id
+        self.reason = reason
 
 
 def to_host(pytree: Any) -> Any:
@@ -81,3 +113,151 @@ def deserialize_models(blob: bytes) -> list[tuple[str, Any]]:
             f"unsupported model blob version {payload.get('version')}"
         )
     return payload["entries"]
+
+
+# --------------------------------------------------------------------------
+# Transactional generation publish / verified load
+# --------------------------------------------------------------------------
+
+
+def manifest_id(instance_id: str) -> str:
+    """Model-store id of a generation's manifest blob."""
+    return f"{instance_id}.manifest"
+
+
+def sha256_hex(blob: bytes) -> str:
+    return hashlib.sha256(blob).hexdigest()
+
+
+def build_manifest(
+    instance_id: str,
+    artifacts: dict[str, bytes],
+    watermark: dict | None = None,
+    parent: str | None = None,
+) -> dict:
+    """Generation manifest: artifact list with per-artifact SHA-256 +
+    size, the training watermark the generation was built from, and the
+    parent generation (the fallback target when this one is corrupt)."""
+    return {
+        "version": GENERATION_VERSION,
+        "instanceId": instance_id,
+        "artifacts": [
+            {
+                "id": art_id,
+                "sha256": sha256_hex(blob),
+                "bytes": len(blob),
+            }
+            for art_id, blob in sorted(artifacts.items())
+        ],
+        "watermark": watermark or {},
+        "parent": parent,
+        "createdAt": _dt.datetime.now(_dt.timezone.utc).isoformat(),
+    }
+
+
+def publish_generation(
+    models_backend,
+    instance_id: str,
+    blob: bytes,
+    watermark: dict | None = None,
+    parent: str | None = None,
+) -> dict:
+    """Write-all-then-commit publish of one generation.
+
+    The artifact blob lands first (under ``instance_id``, the id
+    ``load_deployment`` already reads — legacy readers keep working),
+    then the manifest (under :func:`manifest_id`) commits the
+    generation. A crash between the two leaves an uncommitted artifact
+    that verified loads treat as legacy-at-best; it can never pass
+    checksum verification with a manifest it does not have. Returns the
+    manifest dict."""
+    from predictionio_tpu.data.storage.base import Model
+
+    manifest = build_manifest(
+        instance_id, {instance_id: blob}, watermark=watermark,
+        parent=parent,
+    )
+    models_backend.insert(Model(id=instance_id, models=blob))
+    models_backend.insert(
+        Model(
+            id=manifest_id(instance_id),
+            models=json.dumps(manifest, sort_keys=True).encode("utf-8"),
+        )
+    )
+    logger.info(
+        "published model generation %s (%d bytes, parent=%s)",
+        instance_id, len(blob), parent,
+    )
+    return manifest
+
+
+def load_manifest(models_backend, instance_id: str) -> dict | None:
+    """The generation's manifest, or None for a legacy (pre-manifest)
+    publish. A malformed manifest is an integrity failure, not legacy:
+    it proves a manifest WAS written and is now damaged."""
+    record = models_backend.get(manifest_id(instance_id))
+    if record is None:
+        return None
+    try:
+        manifest = json.loads(record.models.decode("utf-8"))
+        if not isinstance(manifest, dict) or "artifacts" not in manifest:
+            raise ValueError("manifest is not a generation object")
+    except (ValueError, UnicodeDecodeError) as e:
+        raise ModelIntegrityError(
+            instance_id, f"unreadable manifest: {e}"
+        ) from e
+    return manifest
+
+
+def quarantine_generation(models_backend, instance_id: str) -> None:
+    """Move a corrupt generation aside so no later load can pick it up.
+
+    ``ModelsBackend.quarantine`` keeps the bytes for forensics —
+    localfs overrides with an atomic in-place rename, the base default
+    re-inserts under a ``quarantined/`` id and deletes the original.
+    Best-effort: quarantine runs on the failure path and must not mask
+    the integrity error."""
+    for art_id in (instance_id, manifest_id(instance_id)):
+        try:
+            models_backend.quarantine(art_id)
+        except Exception as e:  # noqa: BLE001 - failure-path best effort
+            logger.warning("could not quarantine %s: %s", art_id, e)
+
+
+def load_generation(models_backend, instance_id: str) -> bytes:
+    """Checksum-verified read of a generation's model blob.
+
+    Legacy publishes (no manifest) return the raw blob — they predate
+    integrity metadata and stay loadable. A manifest whose artifact is
+    missing, truncated, or checksum-divergent raises
+    :class:`ModelIntegrityError`; the caller decides quarantine +
+    fallback (see ``core/workflow.load_deployment``)."""
+    manifest = load_manifest(models_backend, instance_id)
+    record = models_backend.get(instance_id)
+    if manifest is None:
+        if record is None:
+            raise ModelIntegrityError(instance_id, "model blob missing")
+        return record.models
+    by_id = {a["id"]: a for a in manifest["artifacts"]}
+    spec = by_id.get(instance_id)
+    if spec is None:
+        raise ModelIntegrityError(
+            instance_id, "manifest lists no blob for this instance"
+        )
+    if record is None:
+        raise ModelIntegrityError(
+            instance_id, "manifest present but model blob missing"
+        )
+    if len(record.models) != spec["bytes"]:
+        raise ModelIntegrityError(
+            instance_id,
+            f"blob is {len(record.models)} bytes, manifest says "
+            f"{spec['bytes']} (truncated or torn write)",
+        )
+    digest = sha256_hex(record.models)
+    if digest != spec["sha256"]:
+        raise ModelIntegrityError(
+            instance_id,
+            f"sha256 {digest[:12]}… != manifest {spec['sha256'][:12]}…",
+        )
+    return record.models

@@ -46,6 +46,19 @@ _STATE_DIR = "state"
 _ARRAY_KINDS = (np.ndarray, jax.Array)
 
 
+class PersistentModelError(RuntimeError):
+    """A MANUAL-persistence restore failed: the checkpoint directory is
+    damaged (partial write, missing state dir, orbax restore error).
+    Typed so deploy-time callers can distinguish a corrupt model from a
+    programming error and fall back to the last-good generation instead
+    of serving a half-initialized model."""
+
+
+class PersistentModelMissing(PersistentModelError, FileNotFoundError):
+    """No persistent model exists at the directory (never saved, or
+    deleted) — distinct from a damaged one."""
+
+
 def _base_dir() -> str:
     return os.environ.get(
         "PIO_FS_BASEDIR", os.path.join(os.path.expanduser("~"), ".piotpu")
@@ -129,20 +142,60 @@ def save_persistent_model(
 
 
 def load_persistent_model(directory: str) -> Any:
+    """Restore a model; every failure path surfaces a typed error.
+
+    * no model at all → :class:`PersistentModelMissing` (still a
+      ``FileNotFoundError`` for legacy callers)
+    * unreadable aux pickle, missing/partial orbax state dir, orbax
+      restore raising, state missing declared keys →
+      :class:`PersistentModelError`
+
+    A partially-restored (half-initialized) model is never returned:
+    the aux skeleton and the full array set either both load or the
+    call raises.
+    """
     directory = os.path.abspath(directory)
     aux_path = os.path.join(directory, _AUX_FILE)
     if not os.path.exists(aux_path):
-        raise FileNotFoundError(
+        raise PersistentModelMissing(
             f"no persistent model at {directory} (missing {_AUX_FILE})"
         )
-    with open(aux_path, "rb") as f:
-        aux = pickle.load(f)
+    try:
+        with open(aux_path, "rb") as f:
+            aux = pickle.load(f)
+        array_keys = aux["array_keys"]
+        skeleton = aux["skeleton"]
+    except Exception as e:  # noqa: BLE001 - damaged pickle surfaces typed
+        raise PersistentModelError(
+            f"persistent model at {directory}: unreadable {_AUX_FILE}: {e}"
+        ) from e
     arrays: dict[str, Any] = {}
-    if aux["array_keys"]:
-        with _sync_checkpointer() as ckptr:
-            state = ckptr.restore(os.path.join(directory, _STATE_DIR))
-        arrays = {k: np.asarray(state[k]) for k in aux["array_keys"]}
-    return _join_model(arrays, aux["skeleton"])
+    if array_keys:
+        state_dir = os.path.join(directory, _STATE_DIR)
+        if not os.path.isdir(state_dir):
+            # the aux committed but the array state never did (crash
+            # between the two writes, or a partial copy): half a model
+            raise PersistentModelError(
+                f"persistent model at {directory}: aux declares "
+                f"{len(array_keys)} array field(s) but {_STATE_DIR}/ "
+                "is missing (partial checkpoint)"
+            )
+        try:
+            with _sync_checkpointer() as ckptr:
+                state = ckptr.restore(state_dir)
+        except Exception as e:  # noqa: BLE001 - orbax raise -> typed
+            raise PersistentModelError(
+                f"persistent model at {directory}: orbax restore "
+                f"failed: {e}"
+            ) from e
+        missing = [k for k in array_keys if k not in state]
+        if missing:
+            raise PersistentModelError(
+                f"persistent model at {directory}: restored state is "
+                f"missing array field(s) {missing} (torn checkpoint)"
+            )
+        arrays = {k: np.asarray(state[k]) for k in array_keys}
+    return _join_model(arrays, skeleton)
 
 
 class LocalFileSystemPersistentModel:

@@ -16,6 +16,7 @@ import datetime as _dt
 import json
 import logging
 import os
+import pickle
 from typing import Any, Sequence
 
 from predictionio_tpu.core.controller import PersistenceMode
@@ -27,12 +28,15 @@ from predictionio_tpu.core.engine import (
     WorkflowParams,
 )
 from predictionio_tpu.core.persistence import (
+    ModelIntegrityError,
     deserialize_models,
+    load_generation,
+    publish_generation,
+    quarantine_generation,
     serialize_models,
 )
 from predictionio_tpu.data.storage import (
     EngineInstance,
-    Model,
     Storage,
     get_storage,
 )
@@ -84,6 +88,58 @@ def _write_train_trace(
         logger.warning("could not write training trace: %s", e)
 
 
+def apply_checkpoint_params(
+    algorithms: Sequence[Any],
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 0,
+    resume: bool = False,
+) -> int:
+    """Thread CLI/trainer checkpoint settings into every algorithm whose
+    params dataclass declares the ``checkpoint_dir``/``checkpoint_every``
+    /``resume`` fields (the :mod:`~predictionio_tpu.ops.als` contract).
+    Returns how many algorithms were rewired — 0 means the engine has no
+    checkpointable algorithm and the flags are inert (logged, not an
+    error: mixed-engine variants are legal)."""
+    if not checkpoint_dir:
+        return 0
+    rewired = 0
+    for algo in algorithms:
+        p = algo.params
+        if not dataclasses.is_dataclass(p):
+            continue
+        names = {f.name for f in dataclasses.fields(p)}
+        if not {"checkpoint_dir", "checkpoint_every", "resume"} <= names:
+            continue
+        algo.params = dataclasses.replace(
+            p,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            resume=resume,
+        )
+        rewired += 1
+    if rewired == 0:
+        logger.warning(
+            "checkpoint_dir=%s requested but no algorithm supports "
+            "checkpointing; training runs without restore points",
+            checkpoint_dir,
+        )
+    return rewired
+
+
+def latest_completed_id(
+    storage: Storage,
+    engine_id: str,
+    engine_version: str = "1",
+    engine_variant: str = "default",
+) -> str | None:
+    """Id of the current latest COMPLETED instance (the parent of the
+    next published generation), or None for a first train."""
+    latest = storage.get_meta_data_engine_instances().get_latest_completed(
+        engine_id, engine_version, engine_variant
+    )
+    return latest.id if latest is not None else None
+
+
 def run_train(
     engine: Engine,
     params: EngineParams,
@@ -94,12 +150,24 @@ def run_train(
     workflow: WorkflowParams | None = None,
     ctx: ComputeContext | None = None,
     storage: Storage | None = None,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 0,
+    resume: bool = False,
+    watermark: dict | None = None,
 ) -> str:
     """Train + persist; returns the EngineInstance id.
 
     Lifecycle mirrors the reference (INIT on entry; COMPLETED only after
     models are persisted, so deploy's ``getLatestCompleted`` never picks
-    a half-written run; FAILED on error)."""
+    a half-written run; FAILED on error).
+
+    ``checkpoint_dir``/``checkpoint_every``/``resume`` thread the CLI's
+    mid-training checkpoint flags down to checkpoint-capable algorithms
+    (:func:`apply_checkpoint_params` → ``ops/als.py``), so a trainer
+    killed mid-epoch resumes from its latest restore point. ``watermark``
+    (event count / latest event time the training data was read at) is
+    recorded in the generation manifest — the freshness provenance the
+    continuous trainer keys its triggers off."""
     workflow = workflow or WorkflowParams()
     storage = storage or get_storage()
     instances = storage.get_meta_data_engine_instances()
@@ -155,6 +223,18 @@ def run_train(
             # (for MANUAL persistence) save, so trained state is what
             # gets saved
             algorithms = engine.make_algorithms(params)
+            apply_checkpoint_params(
+                algorithms,
+                checkpoint_dir=checkpoint_dir,
+                checkpoint_every=checkpoint_every,
+                resume=resume,
+            )
+            # the parent generation is whatever deploy would pick RIGHT
+            # NOW — recorded in the manifest so a corrupt publish has a
+            # named last-good to fall back to
+            parent_generation = latest_completed_id(
+                storage, engine_id, engine_version, engine_variant
+            )
             timer = StepTimer()
             for algo in algorithms:
                 algo.timer = timer
@@ -176,8 +256,16 @@ def run_train(
                     blob = serialize_models(
                         instance_id, algorithms, models
                     )
-                    storage.get_model_data_models().insert(
-                        Model(id=instance_id, models=blob)
+                    # transactional publish: blob first, checksum
+                    # manifest LAST (the commit point) — a crash
+                    # between the two can never become the serving
+                    # model (docs/training.md "Model generations")
+                    publish_generation(
+                        storage.get_model_data_models(),
+                        instance_id,
+                        blob,
+                        watermark=watermark,
+                        parent=parent_generation,
                     )
                 logger.info(
                     "persisted %d model(s) for instance %s (%d bytes)",
@@ -296,22 +384,31 @@ def load_deployment(
 
     ``instance_id=None`` picks the latest COMPLETED instance (the
     reference deploy path, Console.scala:844-879 →
-    CreateServer.scala:204-263)."""
+    CreateServer.scala:204-263) whose model blob passes checksum
+    verification: a corrupt generation (torn publish, flipped bit) is
+    quarantined — moved aside and counted in
+    ``pio_model_quarantined_total`` — and the NEXT newest COMPLETED
+    generation serves instead (last-good fallback). An explicit
+    ``instance_id`` never falls back silently: corruption raises
+    :class:`~predictionio_tpu.core.persistence.ModelIntegrityError`
+    after quarantining."""
     storage = storage or get_storage()
     instances = storage.get_meta_data_engine_instances()
-    if instance_id is None:
-        instance = instances.get_latest_completed(
+    explicit = instance_id is not None
+    if explicit:
+        instance = instances.get(instance_id)
+        if instance is None:
+            raise RuntimeError(f"engine instance {instance_id} not found")
+        candidates = [instance]
+    else:
+        candidates = instances.get_completed(
             engine_id, engine_version, engine_variant
         )
-        if instance is None:
+        if not candidates:
             raise RuntimeError(
                 f"No COMPLETED engine instance for {engine_id} "
                 f"{engine_version} {engine_variant}; run train first."
             )
-    else:
-        instance = instances.get(instance_id)
-        if instance is None:
-            raise RuntimeError(f"engine instance {instance_id} not found")
     ctx = ctx or ComputeContext.create(batch=f"serving:{engine_id}")
 
     algorithms = engine.make_algorithms(params)
@@ -319,13 +416,53 @@ def load_deployment(
         a.persistence_mode == PersistenceMode.AUTO for a in algorithms
     )
     stored: Sequence[Any]
+    instance = candidates[0]
     if needs_blob:
-        record = storage.get_model_data_models().get(instance.id)
-        if record is None:
+        models_backend = storage.get_model_data_models()
+        stored = None
+        last_error: Exception | None = None
+        for candidate in candidates:
+            try:
+                blob = load_generation(models_backend, candidate.id)
+                # a blob that passed (or predates) checksums can still
+                # be an unreadable pickle — for fallback purposes both
+                # are the same failure: this generation cannot serve
+                entries = deserialize_models(blob)
+            except (
+                ModelIntegrityError,
+                pickle.UnpicklingError,
+                ValueError,
+                EOFError,
+                KeyError,
+            ) as e:
+                last_error = e
+                logger.error(
+                    "model generation %s is unloadable (%s); "
+                    "quarantining%s",
+                    candidate.id, e,
+                    "" if explicit else " and falling back to last-good",
+                )
+                quarantine_generation(models_backend, candidate.id)
+                from predictionio_tpu.obs import get_registry
+
+                get_registry().counter(
+                    "pio_model_quarantined_total",
+                    "Published model generations that failed integrity "
+                    "verification at load and were moved aside",
+                ).inc()
+                if explicit:
+                    raise
+                continue
+            instance = candidate
+            stored = [payload for _tag, payload in entries]
+            break
+        if stored is None:
             raise RuntimeError(
-                f"model blob for instance {instance.id} missing"
+                f"no loadable model generation for {engine_id} "
+                f"{engine_version} {engine_variant} "
+                f"({len(candidates)} candidate(s) quarantined; last "
+                f"error: {last_error})"
             )
-        stored = [payload for _tag, payload in deserialize_models(record.models)]
     else:
         stored = [None] * len(algorithms)
     algorithms, models, serving = engine.prepare_deploy(

@@ -297,7 +297,14 @@ class EvaluationInstancesBackend(abc.ABC):
 
 
 class ModelsBackend(abc.ABC):
-    """Blob store for trained models (reference Models.scala:37-48)."""
+    """Blob store for trained models (reference Models.scala:37-48).
+
+    ``insert`` must be atomic per blob: a reader never observes a
+    partially-written model (localfs: unique tmp file + fsync + rename
+    in the same directory). Integrity across blobs is layered on top by
+    the generation manifests in
+    :mod:`predictionio_tpu.core.persistence`.
+    """
 
     @abc.abstractmethod
     def insert(self, model: Model) -> None: ...
@@ -307,6 +314,21 @@ class ModelsBackend(abc.ABC):
 
     @abc.abstractmethod
     def delete(self, model_id: str) -> bool: ...
+
+    def quarantine(self, model_id: str) -> bool:
+        """Move a corrupt blob aside so no later read can pick it up,
+        keeping the bytes for forensics. Default emulation re-inserts
+        under a ``quarantined/`` id and deletes the original; backends
+        with a native rename (localfs) override with an atomic move.
+        Returns False when the blob does not exist."""
+        record = self.get(model_id)
+        if record is None:
+            return False
+        self.insert(
+            Model(id=f"quarantined/{model_id}", models=record.models)
+        )
+        self.delete(model_id)
+        return True
 
 
 class EventsBackend(abc.ABC):

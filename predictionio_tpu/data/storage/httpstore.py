@@ -309,6 +309,7 @@ class HTTPStoreClient:
         params: dict[str, Any] | None = None,
         json_body: Any = None,
         raw_body: bytes | None = None,
+        extra_headers: dict[str, str] | None = None,
     ) -> tuple[int, bytes]:
         """One HTTP round trip; returns (status, body bytes)."""
         route = path  # pre-query-string, for bounded span cardinality
@@ -318,7 +319,7 @@ class HTTPStoreClient:
             )
             if qs:
                 path = f"{path}?{qs}"
-        headers = {}
+        headers = dict(extra_headers or {})
         if self._key:
             headers["Authorization"] = f"Bearer {self._key}"
         # the caller's request ID rides every store hop (even with
@@ -805,10 +806,20 @@ class HTTPModels(ModelsBackend):
         self._c = client
 
     def insert(self, model: Model) -> None:
+        # end-to-end upload integrity: the server recomputes the digest
+        # over the bytes it RECEIVED and refuses a mismatch with 422, so
+        # a bit flipped in transit (or a truncation a proxy papered
+        # over) never lands in the store. Read-side integrity is the
+        # generation manifest's job (core/persistence.load_generation).
+        import hashlib
+
         status, data = self._c.request(
             "PUT",
             f"/models/{_q(model.id)}",
             raw_body=model.models,
+            extra_headers={
+                "X-PIO-SHA256": hashlib.sha256(model.models).hexdigest()
+            },
         )
         if not 200 <= status < 300:
             raise StorageError(

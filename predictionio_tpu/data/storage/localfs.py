@@ -5,13 +5,60 @@ Counterpart of the reference's ``localfs`` backend
 under ``PIO_FS_BASEDIR``). Model checkpoints written by orbax (sharded
 array checkpoints) also live under this root — see
 :mod:`predictionio_tpu.core.persistence`.
+
+Durability contract (docs/training.md "Model generations"): every
+insert is write-to-unique-tmp → flush → fsync → rename within the same
+directory, then a best-effort directory fsync. Two racing publishers
+each own a distinct tmp file, so concurrent inserts of the same id
+resolve to one writer's complete bytes — never an interleaving — and a
+crash mid-write leaves only a ``.tmp.*`` turd that no reader opens.
 """
 
 from __future__ import annotations
 
 import os
+import secrets
 
 from predictionio_tpu.data.storage.base import Model, ModelsBackend
+
+
+def _fsync_dir(path: str) -> None:
+    """Persist a rename against power loss; best-effort on filesystems
+    (or platforms) whose directories cannot be opened for fsync."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Atomic, durable byte write: unique same-directory tmp + fsync +
+    rename + directory fsync. Shared by the model store and the trainer
+    state file."""
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    # unique per writer: two concurrent publishers must not share a tmp
+    tmp = f"{path}.tmp.{os.getpid()}.{secrets.token_hex(4)}"
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o644)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(directory)
 
 
 class LocalFSModels(ModelsBackend):
@@ -32,10 +79,7 @@ class LocalFSModels(ModelsBackend):
         return os.path.join(self._base, f"pio_model_{safe}.bin")
 
     def insert(self, model: Model) -> None:
-        tmp = self._path(model.id) + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(model.models)
-        os.replace(tmp, self._path(model.id))
+        atomic_write_bytes(self._path(model.id), model.models)
 
     def get(self, model_id: str) -> Model | None:
         try:
@@ -50,3 +94,16 @@ class LocalFSModels(ModelsBackend):
             return True
         except FileNotFoundError:
             return False
+
+    def quarantine(self, model_id: str) -> bool:
+        """Atomic move-aside of a corrupt blob: the original id stops
+        resolving in one rename (no read-copy-delete window), and the
+        bytes survive under ``.quarantined.<token>`` for forensics."""
+        src = self._path(model_id)
+        dst = f"{src}.quarantined.{secrets.token_hex(4)}"
+        try:
+            os.replace(src, dst)
+        except FileNotFoundError:
+            return False
+        _fsync_dir(os.path.dirname(src))
+        return True

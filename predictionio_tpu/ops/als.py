@@ -40,6 +40,7 @@ import dataclasses
 import logging
 import math
 import os
+import time
 from functools import partial
 
 import jax
@@ -1174,6 +1175,23 @@ class ALSFactors:
     item_factors: np.ndarray  # [n_items, k]
 
 
+def _train_chaos_sleep_s() -> float:
+    """Training-side chaos knob (mirrors the serving tier's
+    ``PIO_CHAOS``): ``PIO_TRAIN_CHAOS=epoch_sleep:<seconds>`` stretches
+    each epoch dispatch so preemption/kill-mid-train rehearsals
+    (scripts/trainer_smoke.py) get a deterministic window to land in.
+    Unset/garbage → 0 (no chaos in production paths)."""
+    raw = os.environ.get("PIO_TRAIN_CHAOS", "").strip()
+    for part in raw.split(";"):
+        key, _, value = part.partition(":")
+        if key.strip() == "epoch_sleep":
+            try:
+                return max(0.0, float(value))
+            except ValueError:
+                return 0.0
+    return 0.0
+
+
 def train_als(
     ctx: ComputeContext,
     user_ids: np.ndarray,
@@ -1260,19 +1278,30 @@ def train_als(
     )
     resumed_user_factors = None
     if resume and ckpt_path and os.path.exists(ckpt_path):
-        with np.load(ckpt_path) as ckpt:
-            if (
-                ckpt["item_factors"].shape == (n_items, rank)
-                and ckpt["user_factors"].shape == (n_users, rank)
-                and int(ckpt["iteration"]) <= iterations
-            ):
-                init = ckpt["item_factors"]
-                start_iteration = int(ckpt["iteration"])
-                resumed_user_factors = ckpt["user_factors"]
-                logger.info(
-                    "resuming ALS from checkpoint at iteration %d",
-                    start_iteration,
-                )
+        try:
+            with np.load(ckpt_path) as ckpt:
+                if (
+                    ckpt["item_factors"].shape == (n_items, rank)
+                    and ckpt["user_factors"].shape == (n_users, rank)
+                    and int(ckpt["iteration"]) <= iterations
+                ):
+                    init = ckpt["item_factors"]
+                    start_iteration = int(ckpt["iteration"])
+                    resumed_user_factors = ckpt["user_factors"]
+                    logger.info(
+                        "resuming ALS from checkpoint at iteration %d",
+                        start_iteration,
+                    )
+        except Exception as e:  # noqa: BLE001 - damaged ckpt = cold start
+            # a truncated/corrupt checkpoint (np.load raises BadZipFile,
+            # not OSError) must degrade to a from-scratch train, never
+            # crash-loop the resuming trainer
+            logger.warning(
+                "checkpoint %s unreadable (%s); training from scratch",
+                ckpt_path, e,
+            )
+            start_iteration = 0
+            resumed_user_factors = None
     if resume and ckpt_path and jax.process_count() > 1:
         # Checkpoints are written by rank 0 only; with a host-local
         # checkpoint_dir the other ranks see no file. Divergent resume
@@ -1385,9 +1414,12 @@ def train_als(
             )
 
     ran_any = False
+    chaos_sleep = _train_chaos_sleep_s()
     if timer is not None:
         # profiling mode: dispatch each half-iteration separately
         for it in range(start_iteration, iterations):
+            if chaos_sleep:
+                time.sleep(chaos_sleep)
             with timer.step("als/user_solve", sync_value=None):
                 user_factors = solve_u_half(item_factors, lam)
                 _sync_scalar(user_factors)
@@ -1417,6 +1449,8 @@ def train_als(
                 n = min(chunk - it % chunk, iterations - it)
             else:
                 n = min(chunk, iterations - it)
+            if chaos_sleep:
+                time.sleep(chaos_sleep)
             user_factors, item_factors = step(user_factors, item_factors, n)
             it += n
             ran_any = True
@@ -1461,12 +1495,25 @@ def _maybe_checkpoint(
             item_factors = gather(item_factors)
             user_factors = gather(user_factors)
         if jax.process_index() == 0:
-            _write_checkpoint(
-                ckpt_path,
-                iteration=iteration,
-                item_factors=np.asarray(item_factors)[:n_items],
-                user_factors=np.asarray(user_factors)[:n_users],
-            )
+            # the checkpoint is part of the training trace timeline AND
+            # the telemetry registry, so `pio-tpu status --metrics-url`
+            # on a trainer shows how many restore points it has banked
+            from predictionio_tpu.obs import get_registry, tracing
+
+            with tracing.span(
+                "als/checkpoint", iteration=iteration, total=total
+            ):
+                _write_checkpoint(
+                    ckpt_path,
+                    iteration=iteration,
+                    item_factors=np.asarray(item_factors)[:n_items],
+                    user_factors=np.asarray(user_factors)[:n_users],
+                )
+            get_registry().counter(
+                "pio_train_checkpoints_total",
+                "Mid-training factor checkpoints written (atomic npz; "
+                "resume picks up the latest after a crash)",
+            ).inc()
 
 
 def _sync_scalar(arr) -> None:
@@ -1482,4 +1529,96 @@ def _write_checkpoint(path: str, **arrays) -> None:
     os.makedirs(os.path.dirname(path), exist_ok=True)
     tmp = path + ".tmp.npz"  # .npz suffix keeps np.savez from renaming
     np.savez(tmp, **arrays)
+    # fsync before the rename: a restore point that evaporates on power
+    # loss is not a restore point (same discipline as the model store's
+    # atomic_write_bytes)
+    with open(tmp, "rb") as f:
+        os.fsync(f.fileno())
     os.replace(tmp, path)
+
+
+def checkpoint_path(checkpoint_dir: str) -> str:
+    """The checkpoint file :func:`train_als` writes/resumes under a
+    given ``checkpoint_dir`` — shared so supervisors (the continuous
+    trainer) can observe resume state without duplicating the name."""
+    return os.path.join(checkpoint_dir, "als_checkpoint.npz")
+
+
+def peek_checkpoint_iteration(checkpoint_dir: str | None) -> int:
+    """Iteration recorded in the latest checkpoint (0 = none/unreadable)
+    — what a ``resume=True`` run will continue from. Used by the
+    continuous trainer to record crash-resume provenance."""
+    if not checkpoint_dir:
+        return 0
+    path = checkpoint_path(checkpoint_dir)
+    try:
+        with np.load(path) as ckpt:
+            return int(ckpt["iteration"])
+    except Exception:  # noqa: BLE001 - np.load raises BadZipFile on a
+        # truncated npz (not OSError); "0 = none/unreadable" is the
+        # contract, never a crash-looping supervisor tick
+        return 0
+
+
+# --------------------------------------------------------------------------
+# Incremental fold-in (continuous training)
+# --------------------------------------------------------------------------
+
+
+def fold_in_users(
+    item_factors: np.ndarray,
+    user_rows: np.ndarray,
+    item_cols: np.ndarray,
+    values: np.ndarray,
+    n_new_users: int,
+    reg: float = 0.01,
+    alpha: float = 1.0,
+    implicit: bool = True,
+) -> np.ndarray:
+    """Solve factors for NEW users against a FIXED item matrix.
+
+    The continuous-training fast path (ROADMAP "continuous training"):
+    a cold-start user needs one ``k×k`` normal-equation solve — exactly
+    one ALS half-iteration restricted to their rows — not a full
+    retrain. Same math as :func:`_slab_stats` + :func:`_solve`
+    (implicit: ``A = YtY + Σ αw·y·yᵀ + λI``, ``b = Σ (1+αw)·y``;
+    explicit: ``A = Σ y·yᵀ + λ·n·I``, ``b = Σ r·y``), run on host
+    numpy — fold-ins touch a handful of rows, far below device
+    dispatch overhead. ``user_rows`` index the new users ``[0,
+    n_new_users)``; ``item_cols`` index into ``item_factors``. Users
+    with no in-range interactions (all their items unseen) get zero
+    factors. Non-finite solves degrade to zeros, never NaN factors.
+
+    Symmetric item fold-in is the same call with roles swapped.
+    """
+    y = np.asarray(item_factors, np.float32)
+    k = y.shape[1]
+    out = np.zeros((n_new_users, k), np.float32)
+    rows = np.asarray(user_rows, np.int64)
+    cols = np.asarray(item_cols, np.int64)
+    vals = np.asarray(values, np.float32)
+    keep = (cols >= 0) & (cols < len(y)) & (rows >= 0) & (
+        rows < n_new_users
+    )
+    rows, cols, vals = rows[keep], cols[keep], vals[keep]
+    if len(rows) == 0:
+        return out
+    yty = y.T @ y if implicit else None
+    eye = np.eye(k, dtype=np.float32)
+    for u in np.unique(rows):
+        sel = rows == u
+        yu = y[cols[sel]]                       # [n_u, k]
+        w = vals[sel]
+        if implicit:
+            a = yty + (yu * (alpha * w)[:, None]).T @ yu + reg * eye
+            b = ((1.0 + alpha * w)[:, None] * yu).sum(axis=0)
+        else:
+            a = yu.T @ yu + reg * max(len(w), 1) * eye
+            b = (w[:, None] * yu).sum(axis=0)
+        try:
+            x = np.linalg.solve(a, b)
+        except np.linalg.LinAlgError:
+            continue
+        if np.all(np.isfinite(x)):
+            out[int(u)] = x
+    return out

@@ -31,6 +31,7 @@ code.
 
 from __future__ import annotations
 
+import dataclasses
 import datetime as _dt
 import html as _html
 import json
@@ -56,6 +57,7 @@ from predictionio_tpu.obs import MetricRegistry, get_registry
 from predictionio_tpu.obs import tracing
 from predictionio_tpu.parallel.mesh import ComputeContext
 from predictionio_tpu.serving import admission as admission_mod
+from predictionio_tpu.serving import canary as canary_mod
 from predictionio_tpu.serving import resilience
 from predictionio_tpu.serving.batching import (
     BatcherOverloaded,
@@ -78,6 +80,18 @@ from predictionio_tpu.serving.http import (
 from predictionio_tpu.utils import profiling
 
 logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class _StagedGeneration:
+    """One loaded generation: the instance record, its serving layer,
+    and its (warmed) batchers — buildable beside the serving one, so
+    canary promotion and rollback are pointer swaps, not reloads."""
+
+    instance: Any
+    serving: Any
+    batchers: list
+    warmed: bool
 
 
 class EngineServer:
@@ -106,6 +120,7 @@ class EngineServer:
         registry: MetricRegistry | None = None,
         tracer: tracing.Tracer | None = None,
         admission: bool | admission_mod.AdmissionController = True,
+        canary: bool | canary_mod.CanaryConfig = False,
     ):
         self._engine = engine
         self._params = params
@@ -165,6 +180,40 @@ class EngineServer:
             "Per-algorithm dispatches abandoned by partially-shed batch "
             "slots that could not be cancelled before device dispatch",
         )
+        # guarded promotion (docs/training.md "Canary promotion"):
+        # /reload with canary stages the new generation beside the old,
+        # shadow-scores sampled live traffic, promotes on a clean gate,
+        # and auto-rolls-back on post-promotion regression
+        if canary is True:
+            self._canary_config = canary_mod.CanaryConfig.from_env()
+        elif isinstance(canary, canary_mod.CanaryConfig):
+            self._canary_config = canary
+        else:
+            self._canary_config = None
+        self._canary: canary_mod.ShadowCanary | None = None
+        self._last_canary: dict | None = None
+        # serializes /reload handling (staging can take seconds of
+        # warmup; two concurrent reloads must not both stage, and a
+        # manual reload must deterministically supersede a live canary)
+        self._reload_mutex = threading.Lock()
+        self._generation = 0
+        self._generation_gauge = self._registry.gauge(
+            "pio_model_generation",
+            "Monotonic count of model hot-swaps this process served "
+            "(promotions AND rollbacks each advance it — every serving-"
+            "model transition is scrape-visible)",
+        )
+        self._warmed_gauge = self._registry.gauge(
+            "pio_warmup_complete",
+            "1 once the newest generation's warmup compiled every "
+            "attempted bucket; 0 while cold (warmup running, disabled, "
+            "or every compile failed)",
+        )
+        self._registry.gauge(
+            "pio_model_age_seconds",
+            "Seconds since the serving generation finished training "
+            "(freshness of the model users are hitting)",
+        ).set_function(self._model_age_seconds)
         self._batchers: list[MicroBatcher] = []
         self._load()
 
@@ -175,6 +224,7 @@ class EngineServer:
             "POST", "/batch/queries.json", self._batch_queries
         )
         self.router.route("POST", "/reload", self._reload)
+        self.router.route("GET", "/canary", self._canary_status)
         self.router.route("POST", "/stop", self._stop)
         install_metrics_routes(
             self.router, self._registry, self._tracer,
@@ -206,7 +256,49 @@ class EngineServer:
             ).start()
 
     # -- model loading / hot swap ----------------------------------------
+    def _model_age_seconds(self) -> float:
+        instance = getattr(self, "_instance", None)
+        if instance is None:
+            return 0.0
+        age = (
+            _dt.datetime.now(_dt.timezone.utc) - instance.end_time
+        ).total_seconds()
+        return max(0.0, age)
+
     def _load(self) -> None:
+        """Load the latest generation and swap it in immediately (the
+        unguarded path: initial load, and /reload without canary)."""
+        self._activate(self._stage())
+
+    def _activate(self, staged: _StagedGeneration) -> None:
+        with self._lock:
+            old = self._batchers
+            self._instance = staged.instance
+            self._serving = staged.serving
+            self._batchers = staged.batchers
+            self._generation += 1
+            generation = self._generation
+        self._generation_gauge.set(generation)
+        self._warmed_gauge.set(1 if staged.warmed else 0)
+        for b in old:
+            b.close()
+        logger.info(
+            "engine server serving instance %s (%d algorithm(s), "
+            "generation %d)",
+            staged.instance.id, len(staged.batchers), generation,
+        )
+
+    def _stage(self, for_canary: bool = False) -> _StagedGeneration:
+        """Load + warm the latest generation WITHOUT touching the
+        serving pointers — the canary path evaluates the result beside
+        live traffic before :meth:`_activate` ever runs."""
+        if not for_canary:
+            # the gauge describes the NEWEST generation: an immediate
+            # reload makes the incoming (cold) generation newest, so it
+            # reads 0 through the compile window. Canary staging keeps
+            # it untouched — the WARM old generation is still serving
+            # (and the gate separately requires the candidate warm).
+            self._warmed_gauge.set(0)
         instance, algorithms, models, serving = load_deployment(
             self._engine,
             self._params,
@@ -216,16 +308,9 @@ class EngineServer:
             ctx=self._ctx,
             storage=self._storage,
         )
-        old = self._batchers
-        warmed = self._registry.gauge(
-            "pio_warmup_complete",
-            "1 once the newest generation's warmup compiled every "
-            "attempted bucket; 0 while cold (warmup running, disabled, "
-            "or every compile failed)",
+        warmed = bool(
+            self._warmup and self._precompile(algorithms, models)
         )
-        warmed.set(0)
-        if self._warmup and self._precompile(algorithms, models):
-            warmed.set(1)
 
         def batch_fn(a, m):
             has_launch = (
@@ -284,16 +369,11 @@ class EngineServer:
             )
             for i, (algo, model) in enumerate(zip(algorithms, models))
         ]
-        with self._lock:
-            self._instance = instance
-            self._serving = serving
-            self._batchers = batchers
-        for b in old:
-            b.close()
-        logger.info(
-            "engine server serving instance %s (%d algorithm(s))",
-            instance.id,
-            len(batchers),
+        return _StagedGeneration(
+            instance=instance,
+            serving=serving,
+            batchers=batchers,
+            warmed=warmed,
         )
 
     def _precompile(self, algorithms, models) -> bool:
@@ -394,6 +474,14 @@ class EngineServer:
                 "engineVersion": self._engine_version,
                 "engineVariant": self._engine_variant,
                 "engineInstanceId": self._instance.id,
+                "generation": self._generation,
+                "canaryState": (
+                    self._canary.state
+                    if self._canary is not None
+                    else (self._last_canary or {}).get(
+                        "state", canary_mod.IDLE
+                    )
+                ),
                 "trainingStartTime": self._instance.start_time.isoformat(),
                 "trainingEndTime": self._instance.end_time.isoformat(),
                 "startTime": self._start_time.isoformat(),
@@ -618,6 +706,14 @@ class EngineServer:
                 503, "shed under overload; retry later",
                 headers=self._shed_headers(),
             )
+        except Exception:
+            # a genuine serving error feeds the post-promotion watch
+            # (sheds/expiries above don't: they indict load, not the
+            # model) before surfacing to the client untouched
+            self._canary_observe(
+                supplemented, None, time.perf_counter() - t0, ok=False
+            )
+            raise
 
         elapsed = time.perf_counter() - t0
         with self._lock:
@@ -626,6 +722,7 @@ class EngineServer:
             self._avg_serving_sec += (
                 elapsed - self._avg_serving_sec
             ) / self._request_count
+        self._canary_observe(supplemented, prediction, elapsed, ok=True)
         return Response(200, prediction)
 
     def _serve_one(self, serving, query, supplemented, futures,
@@ -884,8 +981,273 @@ class EngineServer:
         # (reference ServerActor mixes in KeyAuthentication for /stop;
         # queries.json stays open)
         self._server_config.check_key(request)
-        self._load()
-        return Response(200, {"message": "reloaded", "engineInstanceId": self._instance.id})
+        body: Any = {}
+        if request.body:
+            try:
+                body = request.json()
+            except Exception:  # noqa: BLE001 - bad body is a 400
+                raise HTTPError(400, "reload body must be JSON") from None
+        if not isinstance(body, dict):
+            raise HTTPError(400, "reload body must be a JSON object")
+        want_canary = body.get("canary")
+        if want_canary is None:
+            want_canary = self._canary_config is not None
+        with self._reload_mutex:
+            if not want_canary:
+                # an explicit immediate reload supersedes whatever the
+                # canary was evaluating — resolved deterministically
+                # BEFORE the swap so a late watch verdict cannot roll a
+                # freshly-loaded generation back to an ancient one. The
+                # ≤0.15 s settle-retry inside deliberately holds the
+                # reload mutex: serializing reloads behind a racing
+                # verdict applier is the point of the mutex.
+                # pio-lint: disable-next=lock-blocking -- bounded 0.15s settle; reload serialization is intentional
+                self._cancel_active_canary("superseded by manual reload")
+                self._load()
+                return Response(
+                    200,
+                    {
+                        "message": "reloaded",
+                        "engineInstanceId": self._instance.id,
+                    },
+                )
+            return self._start_canary()
+
+    def _cancel_active_canary(self, reason: str) -> None:
+        """Resolve a live canary in favor of the CURRENT serving state:
+        shadowing → discard the staged candidate; watching → keep the
+        promoted generation and release the retained one. Claims the
+        verdict slot first so no request thread can apply a competing
+        verdict; if one was already claimed, a brief settle-retry lets
+        its applier finish (promotion resets the slot, so the second
+        attempt claims it)."""
+        for _attempt in range(3):
+            canary = self._canary
+            if canary is None:
+                return
+            if canary.cancel(reason):
+                if canary.state == canary_mod.WATCHING:
+                    canary.finished(canary_mod.STABLE)
+                    retained = canary.retained
+                    if (
+                        retained is not None
+                        and retained.batchers is not self._batchers
+                    ):
+                        self._close_batchers_async(retained.batchers)
+                else:
+                    canary.finished(canary_mod.REJECTED)
+                    if canary.staged.batchers is not self._batchers:
+                        self._close_batchers_async(canary.staged.batchers)
+                self._finish_canary(canary)
+                return
+            time.sleep(0.05)
+        logger.warning(
+            "could not cancel the active canary (verdict applier racing)"
+        )
+
+    def _start_canary(self) -> Response:
+        active = self._canary
+        if active is not None and active.state in (
+            canary_mod.SHADOWING, canary_mod.WATCHING
+        ):
+            raise HTTPError(
+                409,
+                f"a canary is already {active.state}; wait for its "
+                "verdict (GET /canary)",
+            )
+        staged = self._stage(for_canary=True)
+        with self._lock:
+            serving_id = self._instance.id
+        if staged.instance.id == serving_id:
+            self._close_batchers_async(staged.batchers)
+            return Response(
+                200,
+                {
+                    "message": "already serving the latest generation",
+                    "engineInstanceId": serving_id,
+                },
+            )
+        if self._warmup and not staged.warmed:
+            # the canary gate REQUIRES a warm candidate (a cold one
+            # would promote into compile-spike latency and instantly
+            # roll back); a never-warm generation fails the swap with
+            # the old generation untouched — router swap semantics
+            self._close_batchers_async(staged.batchers)
+            raise HTTPError(
+                409,
+                f"canary rejected: generation {staged.instance.id} "
+                "did not complete warmup",
+            )
+        self._canary = canary_mod.ShadowCanary(
+            staged,
+            config=self._canary_config or canary_mod.CanaryConfig(),
+            registry=self._registry,
+            shadow_fn=self._shadow_score,
+        )
+        logger.info(
+            "canary shadowing generation %s beside %s",
+            staged.instance.id, serving_id,
+        )
+        return Response(
+            202,
+            {
+                "message": "canary shadowing; promotion is gated on "
+                           "live-traffic shadow scores (GET /canary)",
+                "engineInstanceId": staged.instance.id,
+                "state": canary_mod.SHADOWING,
+            },
+        )
+
+    def _canary_status(self, request: Request) -> Response:
+        canary = self._canary
+        if canary is not None:
+            data = canary.to_dict()
+        else:
+            data = self._last_canary or {"state": canary_mod.IDLE}
+        with self._lock:
+            data = {
+                **data,
+                "servingInstanceId": self._instance.id,
+                "generation": self._generation,
+            }
+        return Response(200, data)
+
+    # -- canary plumbing --------------------------------------------------
+    def _shadow_score(self, supplemented):
+        """Score one sampled query on the staged generation (shadow
+        worker thread only). Infrastructure drops (shed, expired,
+        mid-close) raise ShadowDropped — never a gate veto; a model
+        exception propagates and vetoes the canary."""
+        canary = self._canary
+        if canary is None:
+            raise canary_mod.ShadowDropped()
+        staged = canary.staged
+        timeout = (
+            self._canary_config or canary_mod.CanaryConfig()
+        ).shadow_timeout_s
+        futures = []
+        try:
+            for b in staged.batchers:
+                futures.append(b.submit(supplemented))
+            predictions = [f.result(timeout=timeout) for f in futures]
+        except (
+            BatcherOverloaded,
+            resilience.DeadlineExceeded,
+            FuturesTimeout,
+            RuntimeError,
+        ) as e:
+            self._abandon([f for f in futures if not f.done()])
+            raise canary_mod.ShadowDropped() from e
+        prediction = staged.serving.serve(supplemented, predictions)
+        if self._feedback and isinstance(prediction, dict):
+            # mirror the prId strip on the old side (_canary_observe):
+            # only model-comparable content enters the divergence score
+            prediction = {
+                k: v for k, v in prediction.items() if k != "prId"
+            }
+        return prediction
+
+    def _canary_observe(
+        self, supplemented, prediction, elapsed_s: float, ok: bool
+    ) -> None:
+        """Request-path canary hook: feed the baseline/watch stats,
+        maybe enqueue a shadow score, and apply any pending verdict."""
+        canary = self._canary
+        if canary is None:
+            return
+        if self._feedback and isinstance(prediction, dict):
+            # _record_feedback injected a random prId AFTER serving;
+            # the shadow path never runs feedback, so leaving it in
+            # would score a guaranteed key-mismatch on every shadow
+            # sample and veto every canary
+            prediction = {
+                k: v for k, v in prediction.items() if k != "prId"
+            }
+        canary.observe(supplemented, prediction, elapsed_s, ok=ok)
+        decision = canary.take_decision()
+        if decision is not None:
+            self._apply_canary_decision(canary, decision)
+
+    def _apply_canary_decision(
+        self, canary: canary_mod.ShadowCanary, decision: str
+    ) -> None:
+        """Apply a single-fire canary verdict. Runs on a request
+        thread; generation swaps happen under the server lock, batcher
+        teardown is deferred to a closer thread (close() joins batcher
+        threads — never from a path a batcher callback might own)."""
+        if decision == "promote":
+            staged = canary.staged
+            with self._lock:
+                retained = _StagedGeneration(
+                    instance=self._instance,
+                    serving=self._serving,
+                    batchers=self._batchers,
+                    warmed=True,
+                )
+                self._instance = staged.instance
+                self._serving = staged.serving
+                self._batchers = staged.batchers
+                self._generation += 1
+                generation = self._generation
+            self._generation_gauge.set(generation)
+            self._warmed_gauge.set(1 if staged.warmed else 0)
+            canary.promoted(retained)
+            logger.info(
+                "canary PROMOTED generation %s (now generation %d); "
+                "watching for regression, previous %s retained",
+                staged.instance.id, generation, retained.instance.id,
+            )
+        elif decision == "reject":
+            canary.finished(canary_mod.REJECTED)
+            self._close_batchers_async(canary.staged.batchers)
+            self._finish_canary(canary)
+            logger.warning(
+                "canary REJECTED generation %s: %s (still serving %s)",
+                canary.staged.instance.id, canary.reason,
+                self._instance.id,
+            )
+        elif decision == "rollback":
+            retained = canary.retained
+            rolled_back = canary.staged
+            with self._lock:
+                self._instance = retained.instance
+                self._serving = retained.serving
+                self._batchers = retained.batchers
+                self._generation += 1
+                generation = self._generation
+            self._generation_gauge.set(generation)
+            self._warmed_gauge.set(1 if retained.warmed else 0)
+            canary.finished(canary_mod.ROLLED_BACK)
+            self._close_batchers_async(rolled_back.batchers)
+            self._finish_canary(canary)
+            logger.warning(
+                "canary ROLLED BACK to generation %s: %s",
+                retained.instance.id, canary.reason,
+            )
+        elif decision == "stable":
+            canary.finished(canary_mod.STABLE)
+            self._close_batchers_async(canary.retained.batchers)
+            self._finish_canary(canary)
+            logger.info(
+                "canary STABLE on generation %s (%s)",
+                canary.staged.instance.id, canary.reason,
+            )
+
+    def _finish_canary(self, canary: canary_mod.ShadowCanary) -> None:
+        self._last_canary = canary.to_dict()
+        # CAS, not blind clear: a verdict applier finishing late must
+        # not clobber a newer canary another reload already installed
+        if self._canary is canary:
+            self._canary = None
+
+    def _close_batchers_async(self, batchers) -> None:
+        # close() drains in-flight dispatches and joins the batcher's
+        # threads — bounded but slow; a request thread must not pay it
+        threading.Thread(
+            target=lambda: [b.close() for b in batchers],
+            name="generation-close",
+            daemon=True,
+        ).start()
 
     def _stop(self, request: Request) -> Response:
         self._server_config.check_key(request)
@@ -945,6 +1307,18 @@ class EngineServer:
         raise last_exc  # type: ignore[misc]
 
     def close(self) -> None:
+        # an in-flight canary's staged/retained generations hold their
+        # own batchers; close them too (skipping whichever set IS the
+        # serving one — closed below)
+        canary = self._canary
+        if canary is not None:
+            canary.close()
+            for gen in (canary.staged, canary.retained):
+                if gen is None or gen.batchers is self._batchers:
+                    continue
+                for b in gen.batchers:
+                    b.close()
+            self._canary = None
         for b in self._batchers:
             b.close()
         self._plugins.close()

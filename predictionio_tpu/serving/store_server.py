@@ -309,6 +309,20 @@ class StoreServer:
 
     def _model_put(self, request: Request) -> Response:
         model_id = urllib.parse.unquote(request.path_params["id"])
+        claimed = (request.headers.get("X-PIO-SHA256") or "").strip().lower()
+        if claimed:
+            # upload integrity (docs/training.md "Model generations"):
+            # verify the digest over the bytes that actually arrived —
+            # a transit flip or truncation is refused, never stored
+            import hashlib
+
+            actual = hashlib.sha256(request.body).hexdigest()
+            if actual != claimed:
+                raise HTTPError(
+                    422,
+                    f"model upload integrity failure: received sha256 "
+                    f"{actual[:12]}… != claimed {claimed[:12]}…",
+                )
         with tracing.span("dao/models.insert", bytes=len(request.body)):
             self._models().insert(Model(id=model_id, models=request.body))
         return Response(201, {"id": model_id})

@@ -1,0 +1,10 @@
+"""Continuous-training tier: the supervised background trainer that
+keeps the model store fresh beside — not inside — the serving path
+(docs/training.md "Continuous training")."""
+
+from predictionio_tpu.training.trainer import (  # noqa: F401
+    ContinuousTrainer,
+    TrainerConfig,
+    Watermark,
+    read_watermark,
+)

@@ -98,4 +98,16 @@ if ! timeout -k 10 420 env JAX_PLATFORMS=cpu \
     rc=1
 fi
 
+echo "== trainer smoke test (crash-safe continuous training, docs/training.md) =="
+# supervised trainer killed -9 mid-epoch resumes from checkpoint;
+# fold-in freshness recorded to SERVING_BENCH.json; corrupt artifact
+# quarantined with last-good serving; NaN generation rejected at the
+# canary gate; post-promotion regression auto-rolls-back — zero
+# non-200s under continuous traffic throughout
+if ! timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    python scripts/trainer_smoke.py; then
+    echo "trainer smoke test FAILED"
+    rc=1
+fi
+
 exit $rc

@@ -1,0 +1,600 @@
+"""Continuous-training smoke test: rehearse the crash-safe model
+lifecycle end to end (docs/training.md), under continuous traffic with
+ZERO non-200 responses. Proves, in order:
+
+1. **kill -9 resume** — a supervised `pio-tpu trainer` child is
+   SIGKILLed mid-epoch (PIO_TRAIN_CHAOS stretches epochs so the window
+   is deterministic); the supervisor respawns it and the retrain
+   RESUMES from the latest ALS checkpoint (state file records
+   ``resumedFromIteration`` ≥ the iteration observed at kill — never a
+   from-scratch restart);
+2. **fold-in freshness** — events for a brand-new user trigger an
+   incremental fold-in generation (parent pointer intact) and the
+   event→serving latency for that user is measured and appended to
+   SERVING_BENCH.json (schema serving-bench/v1);
+3. **quarantine + last-good** — a flipped bit in the latest published
+   artifact is caught by checksum verification at reload: the corrupt
+   generation is moved aside (``pio_model_quarantined_total``) and the
+   last-good generation keeps serving;
+4. **canary rejection** — a NaN-factor generation is staged, shadow-
+   scored on live traffic, and REJECTED at the gate; users never see
+   it;
+5. **automatic rollback** — a generation that passes the gate
+   (identical predictions) but regresses post-promotion latency is
+   promoted, detected by the regression watch, and rolled back — all
+   transitions visible as ``pio_model_generation`` /
+   ``pio_shadow_divergence`` / ``pio_canary_state`` moves in
+   /metrics.json.
+
+Run by ``scripts/check.sh`` next to the other smokes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+WORK = tempfile.mkdtemp(prefix="pio-trainer-smoke-")
+STORAGE_ENV = {
+    "PIO_STORAGE_SOURCES_SQL_TYPE": "sqlite",
+    "PIO_STORAGE_SOURCES_SQL_PATH": os.path.join(WORK, "pio.sqlite"),
+    "PIO_STORAGE_SOURCES_FS_TYPE": "localfs",
+    "PIO_STORAGE_SOURCES_FS_PATH": os.path.join(WORK, "models"),
+    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQL",
+    "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQL",
+    "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS",
+}
+os.environ.update(STORAGE_ENV)
+
+failures: list[str] = []
+
+
+def check(cond: bool, label: str) -> None:
+    print(("ok   " if cond else "FAIL ") + label, flush=True)
+    if not cond:
+        failures.append(label)
+
+
+def http_json(url, body=None, timeout=15):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(body).encode() if body is not None else None,
+        method="POST" if body is not None else "GET",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+def metric_value(base, name, default=0.0):
+    status, data = http_json(f"{base}/metrics.json")
+    family = (data or {}).get(name)
+    if not isinstance(family, dict):
+        return default
+    samples = family.get("samples") or []
+    total = 0.0
+    for s in samples:
+        total += s.get("value", s.get("count", 0.0)) or 0.0
+    return total if samples else default
+
+
+class Traffic:
+    """Continuous background load; every response must be 200."""
+
+    def __init__(self, base: str, body: dict, rate_hz: float = 80.0):
+        self.base = base
+        self.body = body
+        self.rate = rate_hz
+        self.ok = 0
+        self.non_200: list[tuple[int, object]] = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="smoke-traffic", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                status, out = http_json(
+                    f"{self.base}/queries.json", self.body, timeout=30
+                )
+            except OSError:
+                continue  # server not up yet / shutting down
+            if status == 200:
+                self.ok += 1
+            else:
+                self.non_200.append((status, out))
+            self._stop.wait(1.0 / self.rate)
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=10)
+
+
+def wait_for(predicate, timeout_s, label, poll_s=0.1):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+    check(False, f"timed out waiting for {label}")
+    return None
+
+
+# --------------------------------------------------------------------------
+# Phase A: supervised trainer — kill -9 resume + fold-in freshness
+# --------------------------------------------------------------------------
+
+
+def phase_trainer() -> None:
+    import numpy as np
+
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage import App, get_storage
+    from predictionio_tpu.ops import als as als_ops
+
+    storage = get_storage()
+    app_id = storage.get_meta_data_apps().insert(App(id=0, name="smoke"))
+    events = storage.get_events()
+    events.init(app_id)
+    for u in range(10):
+        for i in range(6):
+            events.insert(
+                Event(
+                    event="rate", entity_type="user",
+                    entity_id=f"u{u}", target_entity_type="item",
+                    target_entity_id=f"i{i}",
+                    properties={"rating": 1.0 + (u + i) % 3},
+                ),
+                app_id,
+            )
+
+    variant_path = os.path.join(WORK, "engine.json")
+    with open(variant_path, "w") as f:
+        json.dump(
+            {
+                "engineFactory": "recommendation",
+                "id": "rec-smoke",
+                "datasource": {"params": {"app_name": "smoke"}},
+                "algorithms": [
+                    {
+                        "name": "als",
+                        "params": {
+                            "rank": 8,
+                            "num_iterations": 30,
+                            "block_len": 8,
+                        },
+                    }
+                ],
+            },
+            f,
+        )
+
+    ckpt_dir = os.path.join(WORK, "ckpt")
+    child_env = {
+        **os.environ,
+        # stretch each 2-iteration dispatch chunk so SIGKILL lands
+        # mid-train deterministically
+        "PIO_TRAIN_CHAOS": "epoch_sleep:0.3",
+    }
+    supervisor = subprocess.Popen(
+        [
+            sys.executable, "-m", "predictionio_tpu.cli.main", "trainer",
+            "--engine", "recommendation", "--variant", variant_path,
+            "--engine-id", "rec-smoke", "--app", "smoke",
+            "--poll-interval", "0.3", "--min-new-events", "1",
+            "--checkpoint-dir", ckpt_dir, "--checkpoint-every", "2",
+        ],
+        env=child_env,
+    )
+    try:
+        # 1. wait for a mid-train checkpoint, then kill -9 the child
+        ckpt_file = als_ops.checkpoint_path(ckpt_dir)
+        wait_for(
+            lambda: als_ops.peek_checkpoint_iteration(ckpt_dir) >= 4,
+            90, "mid-train checkpoint",
+        )
+        iter_at_kill = als_ops.peek_checkpoint_iteration(ckpt_dir)
+        pid_file = os.path.join(ckpt_dir, "trainer.pid")
+        with open(pid_file) as f:
+            child_pid = int(f.read().strip())
+        check(
+            child_pid != supervisor.pid,
+            "supervisor runs the trainer in a separate child process",
+        )
+        os.kill(child_pid, signal.SIGKILL)
+        print(
+            f"     killed -9 trainer pid {child_pid} at iteration "
+            f"{iter_at_kill}", flush=True,
+        )
+
+        # 2. the supervisor respawns; the retrain resumes and completes
+        instances = storage.get_meta_data_engine_instances()
+
+        def completed():
+            return instances.get_latest_completed(
+                "rec-smoke", "1", "default"
+            )
+
+        first_gen = wait_for(completed, 120, "resumed retrain COMPLETED")
+        state_path = os.path.join(ckpt_dir, "trainer_state.json")
+
+        def trainer_state():
+            try:
+                with open(state_path) as f:
+                    return json.load(f)
+            except (OSError, ValueError):
+                return {}
+
+        def finalized_state():
+            s = trainer_state()
+            # "publishing" is the crash-recoverable intermediate phase:
+            # wait for the finalized ("idle") state before asserting
+            return s if (
+                s.get("lastInstanceId") and s.get("phase") == "idle"
+            ) else None
+
+        state = wait_for(finalized_state, 30, "trainer state file") or {}
+        resumed = int(state.get("resumedFromIteration", -1))
+        check(
+            resumed >= iter_at_kill > 0,
+            f"trainer resumed from checkpoint iteration {resumed} >= "
+            f"{iter_at_kill} at kill (no from-scratch restart)",
+        )
+        check(
+            int(state.get("fullTrains", 0)) == 1,
+            "exactly one COMPLETED full train across both incarnations",
+        )
+        check(
+            not os.path.exists(ckpt_file),
+            "checkpoint cleared after the COMPLETED train",
+        )
+
+        # 3. serve the generation under continuous traffic
+        from predictionio_tpu.models.recommendation import (
+            recommendation_engine,
+        )
+        from predictionio_tpu.serving.engine_server import EngineServer
+
+        engine = recommendation_engine()
+        with open(variant_path) as f:
+            params = engine.params_from_variant(json.load(f))
+        server = EngineServer(
+            engine, params, engine_id="rec-smoke",
+            storage=storage, max_wait_ms=0.5,
+        )
+        http = server.serve(host="127.0.0.1", port=0)
+        http.start()
+        base = f"http://127.0.0.1:{http.port}"
+        traffic = Traffic(base, {"user": "u1", "num": 3})
+        try:
+            status, out = http_json(
+                f"{base}/queries.json", {"user": "u1", "num": 3}
+            )
+            check(
+                status == 200 and out.get("itemScores"),
+                "known user served from the trainer's generation",
+            )
+
+            # 4. fold-in freshness: events for a NEW user → generation →
+            #    reload → served, clocked end to end
+            t0 = time.monotonic()
+            for item in ("i0", "i1"):
+                events.insert(
+                    Event(
+                        event="rate", entity_type="user",
+                        entity_id="u_new", target_entity_type="item",
+                        target_entity_id=item,
+                        properties={"rating": 2.0},
+                    ),
+                    app_id,
+                )
+
+            def fold_in_gen():
+                latest = completed()
+                if latest and latest.id != first_gen.id:
+                    return latest
+                return None
+
+            gen = wait_for(fold_in_gen, 60, "fold-in generation")
+            freshness = None
+            if gen is not None:
+                check(
+                    gen.env.get("foldIn", "").startswith("users=1"),
+                    f"fold-in generation published ({gen.env.get('foldIn')}"
+                    f", parent={gen.env.get('parent', '?')[:8]}…)",
+                )
+                status, _ = http_json(f"{base}/reload", body={})
+                check(status == 200, "hot reload picked up the fold-in")
+
+                def new_user_served():
+                    s, out = http_json(
+                        f"{base}/queries.json",
+                        {"user": "u_new", "num": 3},
+                    )
+                    return s == 200 and out.get("itemScores")
+
+                if wait_for(new_user_served, 30, "new user served"):
+                    freshness = time.monotonic() - t0
+                    check(
+                        True,
+                        f"event→serving freshness for fold-in: "
+                        f"{freshness:.2f}s",
+                    )
+            if freshness is not None:
+                import serving_bench
+
+                serving_bench.persist_record(
+                    {
+                        "bench": "trainer-freshness",
+                        "mode": "fold-in",
+                        "freshnessSec": round(freshness, 3),
+                        "newUserEvents": 2,
+                        "pass": True,
+                    },
+                    os.path.join(REPO, "SERVING_BENCH.json"),
+                )
+                print(
+                    "     freshness recorded to SERVING_BENCH.json",
+                    flush=True,
+                )
+        finally:
+            traffic.stop()
+            http.shutdown()
+        check(
+            not traffic.non_200,
+            f"zero non-200s during trainer phase "
+            f"({traffic.ok} requests; first bad: "
+            f"{traffic.non_200[:1]})",
+        )
+    finally:
+        supervisor.send_signal(signal.SIGTERM)
+        try:
+            supervisor.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            supervisor.kill()
+
+
+# --------------------------------------------------------------------------
+# Phase B: canary gate — quarantine, NaN rejection, rollback
+# --------------------------------------------------------------------------
+
+
+def phase_canary() -> None:
+    import glob
+
+    from predictionio_tpu.core import (
+        Algorithm,
+        DataSource,
+        Engine,
+        EngineParams,
+        Params,
+        Preparator,
+        Serving,
+    )
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.data.storage import get_storage
+    from predictionio_tpu.parallel.mesh import ComputeContext
+    from predictionio_tpu.serving.canary import CanaryConfig
+    from predictionio_tpu.serving.engine_server import EngineServer
+
+    @dataclasses.dataclass(frozen=True)
+    class P(Params):
+        pass
+
+    class Src(DataSource):
+        params_class = P
+
+        def read_training(self, ctx):
+            return {}
+
+    class Prep(Preparator):
+        params_class = P
+
+        def prepare(self, ctx, td):
+            return td
+
+    class GenAlgo(Algorithm):
+        """Model value frozen at train time from class attrs, so each
+        run_train publishes an observably different generation."""
+
+        params_class = P
+        train_value = 1.0
+        train_slow_s = 0.0
+
+        def train(self, ctx, pd):
+            return {
+                "value": type(self).train_value,
+                "slow_s": type(self).train_slow_s,
+            }
+
+        def predict(self, model, query):
+            return self.batch_predict(model, [query])[0]
+
+        def batch_predict(self, model, queries):
+            if model["slow_s"]:
+                time.sleep(model["slow_s"])
+            return [{"result": model["value"]} for _ in queries]
+
+    class First(Serving):
+        params_class = P
+
+        def serve(self, query, predictions):
+            return predictions[0]
+
+    storage = get_storage()
+    ctx = ComputeContext.create(batch="canary-smoke")
+    engine = Engine(Src, Prep, GenAlgo, First)
+    params = EngineParams(
+        data_source=("", P()), preparator=("", P()),
+        algorithms=[("", P())], serving=("", P()),
+    )
+
+    def train():
+        return run_train(
+            engine, params, engine_id="cnry-smoke", ctx=ctx,
+            storage=storage,
+        )
+
+    g1 = train()
+    config = CanaryConfig(
+        shadow_sample=1.0, min_shadow=5, max_divergence=0.05,
+        watch_min_requests=10, watch_s=0.5, latency_factor=4.0,
+        error_rate_limit=0.2, shadow_timeout_s=10.0,
+    )
+    server = EngineServer(
+        engine, params, engine_id="cnry-smoke", storage=storage,
+        ctx=ctx, canary=config, max_wait_ms=0.5,
+    )
+    http = server.serve(host="127.0.0.1", port=0)
+    http.start()
+    base = f"http://127.0.0.1:{http.port}"
+    traffic = Traffic(base, {"x": 1})
+    try:
+        gen_before = metric_value(base, "pio_model_generation")
+
+        # -- corrupt artifact → quarantine + last-good serve --
+        g2 = train()
+        blob_path = glob.glob(
+            os.path.join(WORK, "models", f"pio_model_{g2}.bin")
+        )
+        check(bool(blob_path), "published artifact on localfs")
+        with open(blob_path[0], "r+b") as f:
+            f.seek(10)
+            byte = f.read(1)
+            f.seek(10)
+            f.write(bytes([byte[0] ^ 0xFF]))  # one flipped bit-pattern
+        status, body = http_json(f"{base}/reload", body={})
+        check(
+            status == 200 and "already serving" in body.get("message", ""),
+            "corrupt generation never staged: reload fell back to "
+            "last-good",
+        )
+        status, data = http_json(base)
+        check(
+            data.get("engineInstanceId") == g1,
+            "last-good generation still serving after corruption",
+        )
+        check(
+            metric_value(base, "pio_model_quarantined_total") >= 1,
+            "corrupt generation quarantined "
+            "(pio_model_quarantined_total >= 1)",
+        )
+        quarantined = glob.glob(
+            os.path.join(WORK, "models", "*.quarantined.*")
+        )
+        check(bool(quarantined), "corrupt blob moved aside on disk")
+
+        # -- NaN-factor generation rejected at the canary gate --
+        GenAlgo.train_value = float("nan")
+        train()
+        status, body = http_json(f"{base}/reload", body={})
+        check(status == 202, "NaN generation staged as canary (202)")
+        wait_for(
+            lambda: (server._last_canary or {}).get("state") == "rejected",
+            60, "canary rejection",
+        )
+        status, data = http_json(base)
+        check(
+            data.get("engineInstanceId") == g1,
+            "NaN generation rejected at the gate; last-good serving",
+        )
+        check(
+            "NaN" in (server._last_canary or {}).get("reason", ""),
+            "rejection reason names the NaN",
+        )
+
+        # -- slow generation: promoted, then auto-rolled-back --
+        GenAlgo.train_value = 1.0  # identical output: gate passes
+        GenAlgo.train_slow_s = 0.06
+        g4 = train()
+        status, body = http_json(f"{base}/reload", body={})
+        check(status == 202, "slow generation staged as canary (202)")
+        promoted = wait_for(
+            lambda: http_json(base)[1].get("engineInstanceId") == g4,
+            60, "canary promotion",
+        )
+        check(bool(promoted), "slow generation passed the gate and promoted")
+        wait_for(
+            lambda: (server._last_canary or {}).get("state")
+            == "rolled_back",
+            60, "automatic rollback",
+        )
+        status, data = http_json(base)
+        check(
+            data.get("engineInstanceId") == g1,
+            "rollback restored the previous generation",
+        )
+        check(
+            "latency" in (server._last_canary or {}).get("reason", ""),
+            "rollback reason names the latency regression",
+        )
+
+        # -- lifecycle visible in /metrics.json --
+        gen_after = metric_value(base, "pio_model_generation")
+        check(
+            gen_after >= gen_before + 2,
+            f"pio_model_generation advanced {gen_before} → {gen_after} "
+            "(promotion + rollback each visible)",
+        )
+        status, metrics = http_json(f"{base}/metrics.json")
+        shadow = (metrics or {}).get("pio_shadow_divergence") or {}
+        shadow_count = sum(
+            s.get("count", 0) for s in shadow.get("samples", [])
+        )
+        check(
+            shadow_count >= config.min_shadow,
+            f"pio_shadow_divergence recorded {shadow_count} shadow "
+            "comparisons",
+        )
+        check(
+            metric_value(base, "pio_model_age_seconds") >= 0,
+            "pio_model_age_seconds exported",
+        )
+    finally:
+        traffic.stop()
+        http.shutdown()
+    check(
+        not traffic.non_200,
+        f"zero non-200s across quarantine/rejection/rollback "
+        f"({traffic.ok} requests; first bad: {traffic.non_200[:1]})",
+    )
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    print("== trainer smoke: crash-safe continuous training ==", flush=True)
+    phase_trainer()
+    print("== canary smoke: quarantine / rejection / rollback ==",
+          flush=True)
+    phase_canary()
+    took = time.monotonic() - t0
+    if failures:
+        print(f"\nFAILED {len(failures)} check(s) in {took:.1f}s:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print(f"\nall checks passed in {took:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,466 @@
+"""Shadow-scored canary promotion: divergence scoring, the gate state
+machine, and end-to-end promote / NaN-reject / latency-rollback against
+a real EngineServer (docs/training.md "Canary promotion")."""
+
+import json
+import math
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from fake_engine import (
+    FakeAlgorithm,
+    FakeDataSource,
+    FakeParams,
+    FakePreparator,
+    FakeServing,
+)
+from predictionio_tpu.core import Engine, EngineParams
+from predictionio_tpu.core.workflow import run_train
+from predictionio_tpu.parallel.mesh import ComputeContext
+from predictionio_tpu.serving import canary as canary_mod
+from predictionio_tpu.serving.canary import (
+    CanaryConfig,
+    ShadowCanary,
+    ShadowDropped,
+    contains_nan,
+    divergence,
+)
+from predictionio_tpu.serving.engine_server import EngineServer
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return ComputeContext.create(batch="canary-test")
+
+
+class TestDivergence:
+    def test_identical_is_zero(self):
+        pred = {"itemScores": [{"item": "a", "score": 1.5}]}
+        assert divergence(pred, pred) == 0.0
+
+    def test_numeric_relative_difference(self):
+        assert divergence({"s": 100.0}, {"s": 110.0}) == pytest.approx(
+            10.0 / 110.0
+        )
+
+    def test_missing_key_scores_one(self):
+        assert divergence({"a": 1.0, "b": 2.0}, {"a": 1.0}) == 0.5
+
+    def test_length_mismatch_penalized(self):
+        assert divergence([1.0], [1.0, 2.0]) == 0.5
+
+    def test_string_mismatch(self):
+        assert divergence({"item": "a"}, {"item": "b"}) == 1.0
+        assert divergence({"item": "a"}, {"item": "a"}) == 0.0
+
+    def test_nan_counts_as_full_divergence(self):
+        assert divergence({"s": 1.0}, {"s": float("nan")}) == 1.0
+
+    def test_contains_nan(self):
+        assert contains_nan({"x": [{"s": float("nan")}]})
+        assert contains_nan(float("inf"))
+        assert not contains_nan({"x": [1.0, "a", None, True]})
+
+
+def _wait_decision(canary, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        decision = canary.take_decision()
+        if decision is not None:
+            return decision
+        time.sleep(0.01)
+    raise AssertionError(f"no canary decision; state={canary.to_dict()}")
+
+
+class TestShadowCanaryUnit:
+    CFG = CanaryConfig(
+        shadow_sample=1.0, min_shadow=3, max_divergence=0.05,
+        watch_min_requests=3, watch_s=0.0, latency_factor=3.0,
+        error_rate_limit=0.2, shadow_timeout_s=2.0,
+    )
+
+    def _canary(self, shadow_fn):
+        return ShadowCanary(
+            staged=object(), config=self.CFG, shadow_fn=shadow_fn
+        )
+
+    def test_clean_gate_promotes(self):
+        canary = self._canary(lambda q: {"score": 1.0})
+        for _ in range(3):
+            canary.observe({"q": 1}, {"score": 1.0}, 0.001)
+        assert _wait_decision(canary) == "promote"
+        assert "gate passed" in canary.reason
+
+    def test_nan_rejects_immediately(self):
+        canary = self._canary(lambda q: {"score": float("nan")})
+        canary.observe({"q": 1}, {"score": 1.0}, 0.001)
+        assert _wait_decision(canary) == "reject"
+        assert "NaN" in canary.reason
+
+    def test_divergence_rejects(self):
+        canary = self._canary(lambda q: {"score": 9.0})
+        for _ in range(3):
+            canary.observe({"q": 1}, {"score": 1.0}, 0.001)
+        assert _wait_decision(canary) == "reject"
+        assert "divergence" in canary.reason
+
+    def test_model_exception_vetoes(self):
+        def boom(q):
+            raise ValueError("model broke")
+
+        canary = self._canary(boom)
+        canary.observe({"q": 1}, {"score": 1.0}, 0.001)
+        assert _wait_decision(canary) == "reject"
+        assert "exception" in canary.reason
+
+    def test_infrastructure_drop_never_vetoes(self):
+        def dropped(q):
+            raise ShadowDropped()
+
+        canary = self._canary(dropped)
+        for _ in range(5):
+            canary.observe({"q": 1}, {"score": 1.0}, 0.001)
+        time.sleep(0.3)
+        assert canary.take_decision() is None
+        assert canary.state == canary_mod.SHADOWING
+
+    def test_watch_latency_regression_rolls_back(self):
+        canary = self._canary(lambda q: {"score": 1.0})
+        for _ in range(3):
+            canary.observe({"q": 1}, {"score": 1.0}, 0.01)
+        assert _wait_decision(canary) == "promote"
+        canary.promoted(retained=object())
+        for _ in range(3):
+            canary.observe({"q": 1}, {"score": 1.0}, 0.2)
+        assert _wait_decision(canary) == "rollback"
+        assert "latency" in canary.reason
+
+    def test_watch_error_rate_rolls_back(self):
+        canary = self._canary(lambda q: {"score": 1.0})
+        for _ in range(3):
+            canary.observe({"q": 1}, {"score": 1.0}, 0.01)
+        assert _wait_decision(canary) == "promote"
+        canary.promoted(retained=object())
+        for i in range(4):
+            canary.observe({"q": 1}, None, 0.01, ok=(i != 0))
+        assert _wait_decision(canary) == "rollback"
+        assert "error rate" in canary.reason
+
+    def test_watch_clean_window_is_stable(self):
+        canary = self._canary(lambda q: {"score": 1.0})
+        for _ in range(3):
+            canary.observe({"q": 1}, {"score": 1.0}, 0.01)
+        assert _wait_decision(canary) == "promote"
+        canary.promoted(retained=object())
+        for _ in range(3):
+            canary.observe({"q": 1}, {"score": 1.0}, 0.01)
+        assert _wait_decision(canary) == "stable"
+
+    def test_decision_is_single_fire(self):
+        canary = self._canary(lambda q: {"score": float("nan")})
+        canary.observe({"q": 1}, {"score": 1.0}, 0.001)
+        assert _wait_decision(canary) == "reject"
+        assert canary.take_decision() is None
+
+
+# --------------------------------------------------------------------------
+# End-to-end: EngineServer + canary reload
+# --------------------------------------------------------------------------
+
+
+class GenAlgorithm(FakeAlgorithm):
+    """Model value is frozen at TRAIN time from a class attribute, so
+    consecutive run_trains publish observably different generations —
+    including NaN and slow ones."""
+
+    train_value = 1.0
+    train_slow_s = 0.0
+
+    def train(self, ctx, pd):
+        return {
+            "value": type(self).train_value,
+            "slow_s": type(self).train_slow_s,
+        }
+
+    def predict(self, model, query):
+        if model["slow_s"]:
+            time.sleep(model["slow_s"])
+        return {"result": model["value"]}
+
+    def batch_predict(self, model, queries):
+        if model["slow_s"]:
+            time.sleep(model["slow_s"])
+        return [{"result": model["value"]} for _ in queries]
+
+
+class GenServing(FakeServing):
+    def serve(self, query, predictions):
+        return predictions[0]
+
+
+def _engine():
+    return Engine(FakeDataSource, FakePreparator, GenAlgorithm, GenServing)
+
+
+def _params():
+    return EngineParams(
+        data_source=("", FakeParams(id=1)),
+        preparator=("", FakeParams(id=2)),
+        algorithms=[("", FakeParams(id=3))],
+        serving=("", FakeParams()),
+    )
+
+
+def _call(url, method="GET", body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+@pytest.fixture()
+def canary_server(ctx, memory_storage):
+    GenAlgorithm.train_value = 1.0
+    GenAlgorithm.train_slow_s = 0.0
+    run_train(
+        _engine(), _params(), engine_id="cnry", ctx=ctx,
+        storage=memory_storage,
+    )
+    config = CanaryConfig(
+        shadow_sample=1.0, min_shadow=3, max_divergence=0.05,
+        watch_min_requests=3, watch_s=0.0, latency_factor=4.0,
+        error_rate_limit=0.2, shadow_timeout_s=5.0,
+    )
+    es = EngineServer(
+        _engine(), _params(), engine_id="cnry",
+        storage=memory_storage, ctx=ctx, canary=config,
+        max_wait_ms=0.5,
+    )
+    http = es.serve(host="127.0.0.1", port=0)
+    http.start()
+    yield f"http://127.0.0.1:{http.port}", es, memory_storage
+    http.shutdown()
+
+
+def _drive_until(base, predicate, n_max=300, body=None):
+    """Fire queries until ``predicate(es)`` holds; every response must
+    be 200 (the zero-non-200 contract under canary transitions)."""
+    for _ in range(n_max):
+        status, out = _call(f"{base}/queries.json", "POST", {"x": 1})
+        assert status == 200, out
+        if predicate():
+            return out
+        time.sleep(0.005)
+    raise AssertionError("predicate never held")
+
+
+class TestCanaryEndToEnd:
+    def test_promote_then_stable(self, canary_server, ctx, memory_storage):
+        base, es, storage = canary_server
+        GenAlgorithm.train_value = 1.0  # identical output: divergence 0
+        g2 = run_train(
+            _engine(), _params(), engine_id="cnry", ctx=ctx,
+            storage=memory_storage,
+        )
+        status, body = _call(f"{base}/reload", "POST")
+        assert status == 202 and body["state"] == "shadowing"
+        _drive_until(base, lambda: es._status_data()[
+            "engineInstanceId"] == g2)
+        # promotion happened with zero non-200s; watch settles to stable
+        _drive_until(
+            base,
+            lambda: (es._last_canary or {}).get("state") == "stable",
+        )
+        status, state = _call(f"{base}/canary")
+        assert state["state"] == "stable"
+        assert state["servingInstanceId"] == g2
+
+    def test_nan_generation_rejected_at_gate(
+        self, canary_server, ctx, memory_storage
+    ):
+        base, es, storage = canary_server
+        serving_before = es._status_data()["engineInstanceId"]
+        GenAlgorithm.train_value = float("nan")
+        run_train(
+            _engine(), _params(), engine_id="cnry", ctx=ctx,
+            storage=memory_storage,
+        )
+        status, body = _call(f"{base}/reload", "POST")
+        assert status == 202
+        _drive_until(
+            base,
+            lambda: (es._last_canary or {}).get("state") == "rejected",
+        )
+        data = es._status_data()
+        assert data["engineInstanceId"] == serving_before
+        assert "NaN" in (es._last_canary or {}).get("reason", "")
+        # traffic still serves the last-good value
+        status, out = _call(f"{base}/queries.json", "POST", {"x": 1})
+        assert status == 200 and out["result"] == 1.0
+
+    def test_post_promotion_latency_regression_rolls_back(
+        self, canary_server, ctx, memory_storage
+    ):
+        base, es, storage = canary_server
+        g1 = es._status_data()["engineInstanceId"]
+        # identical predictions (passes the gate) but slow to serve:
+        # the regression only shows AFTER promotion, which is exactly
+        # what the watch exists for
+        GenAlgorithm.train_value = 1.0
+        GenAlgorithm.train_slow_s = 0.05
+        g2 = run_train(
+            _engine(), _params(), engine_id="cnry", ctx=ctx,
+            storage=memory_storage,
+        )
+        status, body = _call(f"{base}/reload", "POST")
+        assert status == 202
+        _drive_until(
+            base, lambda: es._status_data()["engineInstanceId"] == g2
+        )
+        _drive_until(
+            base,
+            lambda: (es._last_canary or {}).get("state") == "rolled_back",
+        )
+        assert es._status_data()["engineInstanceId"] == g1
+        assert "latency" in (es._last_canary or {}).get("reason", "")
+
+    def test_second_reload_while_shadowing_conflicts(
+        self, canary_server, ctx, memory_storage
+    ):
+        base, es, storage = canary_server
+        GenAlgorithm.train_value = 1.0
+        run_train(
+            _engine(), _params(), engine_id="cnry", ctx=ctx,
+            storage=memory_storage,
+        )
+        status, _ = _call(f"{base}/reload", "POST")
+        assert status == 202
+        status, body = _call(f"{base}/reload", "POST")
+        assert status == 409
+
+    def test_reload_same_generation_is_noop(
+        self, canary_server, ctx, memory_storage
+    ):
+        base, es, storage = canary_server
+        status, body = _call(f"{base}/reload", "POST")
+        assert status == 200
+        assert "already serving" in body["message"]
+
+    def test_immediate_reload_opt_out(
+        self, canary_server, ctx, memory_storage
+    ):
+        base, es, storage = canary_server
+        GenAlgorithm.train_value = 2.0
+        g2 = run_train(
+            _engine(), _params(), engine_id="cnry", ctx=ctx,
+            storage=memory_storage,
+        )
+        status, body = _call(
+            f"{base}/reload", "POST", {"canary": False}
+        )
+        assert status == 200 and body["engineInstanceId"] == g2
+
+    def test_warmup_gauge_stays_warm_during_canary_staging(
+        self, canary_server, ctx, memory_storage
+    ):
+        """Canary staging must not zero pio_warmup_complete: the WARM
+        old generation is still serving, and the router's admission
+        gate reads that gauge."""
+        base, es, storage = canary_server
+        assert es._warmed_gauge.value == 1
+        GenAlgorithm.train_value = 1.0
+        run_train(
+            _engine(), _params(), engine_id="cnry", ctx=ctx,
+            storage=memory_storage,
+        )
+        status, _ = _call(f"{base}/reload", "POST")
+        assert status == 202
+        assert es._warmed_gauge.value == 1  # serving gen still warm
+
+    def test_manual_reload_supersedes_watching_canary(
+        self, canary_server, ctx, memory_storage
+    ):
+        """A non-canary reload during the post-promotion watch resolves
+        the canary first: a late watch verdict must never roll the
+        freshly-loaded generation back to an ancient one."""
+        base, es, storage = canary_server
+        GenAlgorithm.train_value = 1.0
+        g2 = run_train(
+            _engine(), _params(), engine_id="cnry", ctx=ctx,
+            storage=memory_storage,
+        )
+        status, _ = _call(f"{base}/reload", "POST")
+        assert status == 202
+        _drive_until(
+            base, lambda: es._status_data()["engineInstanceId"] == g2
+        )
+        assert es._canary is not None  # watching
+        GenAlgorithm.train_value = 3.0
+        g3 = run_train(
+            _engine(), _params(), engine_id="cnry", ctx=ctx,
+            storage=memory_storage,
+        )
+        status, body = _call(
+            f"{base}/reload", "POST", {"canary": False}
+        )
+        assert status == 200 and body["engineInstanceId"] == g3
+        # the superseded canary resolved in favor of what was serving;
+        # further traffic never rolls back off g3
+        for _ in range(30):
+            status, out = _call(
+                f"{base}/queries.json", "POST", {"x": 1}
+            )
+            assert status == 200 and out["result"] == 3.0
+        assert es._status_data()["engineInstanceId"] == g3
+        assert (es._last_canary or {}).get("reason", "").startswith(
+            "superseded"
+        )
+
+
+class TestFeedbackCompatibility:
+    def test_feedback_prid_does_not_poison_divergence(
+        self, ctx, memory_storage
+    ):
+        """--feedback injects a random prId into every served
+        prediction AFTER the model ran; the shadow comparison must
+        strip it on both sides or every canary is vetoed on a
+        guaranteed key-mismatch."""
+        GenAlgorithm.train_value = 1.0
+        GenAlgorithm.train_slow_s = 0.0
+        run_train(
+            _engine(), _params(), engine_id="cnry-fb", ctx=ctx,
+            storage=memory_storage,
+        )
+        memory_storage.get_events().init(1)
+        config = CanaryConfig(
+            shadow_sample=1.0, min_shadow=3, max_divergence=0.05,
+            watch_min_requests=3, watch_s=0.0, shadow_timeout_s=5.0,
+        )
+        es = EngineServer(
+            _engine(), _params(), engine_id="cnry-fb",
+            storage=memory_storage, ctx=ctx, canary=config,
+            max_wait_ms=0.5, feedback=True, feedback_app_id=1,
+        )
+        http = es.serve(host="127.0.0.1", port=0)
+        http.start()
+        base = f"http://127.0.0.1:{http.port}"
+        try:
+            g2 = run_train(
+                _engine(), _params(), engine_id="cnry-fb", ctx=ctx,
+                storage=memory_storage,
+            )
+            status, _ = _call(f"{base}/reload", "POST")
+            assert status == 202
+            _drive_until(
+                base,
+                lambda: es._status_data()["engineInstanceId"] == g2,
+            )
+            assert (es._canary or es._last_canary) is not None
+        finally:
+            http.shutdown()

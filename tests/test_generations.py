@@ -1,0 +1,257 @@
+"""Transactional model generations: checksum manifests, write-all-then-
+commit publish, quarantine + last-good fallback, and localfs atomic
+write discipline (docs/training.md "Model generations")."""
+
+import glob
+import os
+import threading
+
+import pytest
+
+from fake_engine import FakeParams
+from predictionio_tpu.core import persistence
+from predictionio_tpu.core.persistence import (
+    ModelIntegrityError,
+    load_generation,
+    load_manifest,
+    manifest_id,
+    publish_generation,
+    quarantine_generation,
+    sha256_hex,
+)
+from predictionio_tpu.core.workflow import load_deployment, run_train
+from predictionio_tpu.data.storage import Model
+from predictionio_tpu.data.storage.localfs import (
+    LocalFSModels,
+    atomic_write_bytes,
+)
+from predictionio_tpu.data.storage.memory import MemoryModels
+from predictionio_tpu.obs import get_registry
+from predictionio_tpu.parallel.mesh import ComputeContext
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return ComputeContext.create(batch="gen-test")
+
+
+class TestPublishLoad:
+    def test_roundtrip_and_manifest(self):
+        backend = MemoryModels()
+        blob = b"model-bytes-1"
+        manifest = publish_generation(
+            backend, "g1", blob,
+            watermark={"count": 42, "latestTime": "2026-08-03T00:00:00"},
+            parent="g0",
+        )
+        assert load_generation(backend, "g1") == blob
+        stored = load_manifest(backend, "g1")
+        assert stored == manifest
+        art = stored["artifacts"][0]
+        assert art["sha256"] == sha256_hex(blob)
+        assert art["bytes"] == len(blob)
+        assert stored["parent"] == "g0"
+        assert stored["watermark"]["count"] == 42
+
+    def test_legacy_blob_without_manifest_loads(self):
+        backend = MemoryModels()
+        backend.insert(Model(id="old", models=b"legacy"))
+        assert load_generation(backend, "old") == b"legacy"
+
+    def test_corrupt_blob_raises_integrity_error(self):
+        backend = MemoryModels()
+        publish_generation(backend, "g1", b"good-bytes")
+        backend.insert(Model(id="g1", models=b"good-bytez"))  # flipped
+        with pytest.raises(ModelIntegrityError, match="sha256"):
+            load_generation(backend, "g1")
+
+    def test_truncated_blob_raises(self):
+        backend = MemoryModels()
+        publish_generation(backend, "g1", b"0123456789")
+        backend.insert(Model(id="g1", models=b"01234"))
+        with pytest.raises(ModelIntegrityError, match="torn write"):
+            load_generation(backend, "g1")
+
+    def test_manifest_without_blob_raises(self):
+        """A crashed publish that somehow lost the artifact can never
+        serve: the manifest's presence makes the loss an integrity
+        failure, not a legacy load."""
+        backend = MemoryModels()
+        publish_generation(backend, "g1", b"bytes")
+        backend.delete("g1")
+        with pytest.raises(ModelIntegrityError, match="missing"):
+            load_generation(backend, "g1")
+
+    def test_unreadable_manifest_is_integrity_failure(self):
+        backend = MemoryModels()
+        publish_generation(backend, "g1", b"bytes")
+        backend.insert(Model(id=manifest_id("g1"), models=b"{not json"))
+        with pytest.raises(ModelIntegrityError, match="manifest"):
+            load_generation(backend, "g1")
+
+    def test_quarantine_emulation_moves_aside(self):
+        backend = MemoryModels()
+        publish_generation(backend, "g1", b"bytes")
+        quarantine_generation(backend, "g1")
+        assert backend.get("g1") is None
+        assert backend.get(manifest_id("g1")) is None
+        assert backend.get("quarantined/g1").models == b"bytes"
+
+
+class TestLocalFS:
+    def test_atomic_insert_no_tmp_left(self, tmp_path):
+        backend = LocalFSModels({"PATH": str(tmp_path)})
+        backend.insert(Model(id="m1", models=b"x" * 1000))
+        assert backend.get("m1").models == b"x" * 1000
+        assert not glob.glob(str(tmp_path / "*.tmp*"))
+
+    def test_quarantine_renames_in_place(self, tmp_path):
+        backend = LocalFSModels({"PATH": str(tmp_path)})
+        backend.insert(Model(id="m1", models=b"payload"))
+        assert backend.quarantine("m1") is True
+        assert backend.get("m1") is None
+        moved = glob.glob(str(tmp_path / "*.quarantined.*"))
+        assert len(moved) == 1
+        with open(moved[0], "rb") as f:
+            assert f.read() == b"payload"  # bytes kept for forensics
+
+    def test_quarantine_missing_returns_false(self, tmp_path):
+        backend = LocalFSModels({"PATH": str(tmp_path)})
+        assert backend.quarantine("nope") is False
+
+    def test_atomic_write_replaces_and_cleans(self, tmp_path):
+        target = str(tmp_path / "f.bin")
+        atomic_write_bytes(target, b"a")
+        atomic_write_bytes(target, b"b")
+        with open(target, "rb") as f:
+            assert f.read() == b"b"
+        assert not glob.glob(str(tmp_path / "*.tmp*"))
+
+    def test_concurrent_publishers_never_tear(self, tmp_path):
+        """Two racing publishers of the SAME id: the final file is one
+        writer's complete payload, never an interleaving — the
+        satellite's torn-generation proof."""
+        backend = LocalFSModels({"PATH": str(tmp_path)})
+        payloads = [bytes([i]) * 65536 for i in range(4)]
+        errors = []
+
+        def publish(payload):
+            try:
+                for _ in range(8):
+                    backend.insert(Model(id="shared", models=payload))
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=publish, args=(p,)) for p in payloads
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        final = backend.get("shared").models
+        assert final in payloads  # complete payload, no interleave
+        assert not glob.glob(str(tmp_path / "*.tmp*"))
+
+
+def _fake_engine():
+    from fake_engine import FakePreparator, FakeDataSource
+    from predictionio_tpu.core import Engine
+    from test_engine_server import DictQueryAlgorithm, DictServing
+
+    return Engine(
+        FakeDataSource, FakePreparator, DictQueryAlgorithm, DictServing
+    )
+
+
+def _fake_params():
+    from predictionio_tpu.core import EngineParams
+
+    return EngineParams(
+        data_source=("", FakeParams(id=1)),
+        preparator=("", FakeParams(id=2)),
+        algorithms=[("", FakeParams(id=3))],
+        serving=("", FakeParams()),
+    )
+
+
+class TestDeployFallback:
+    def test_run_train_publishes_manifest(self, ctx, memory_storage):
+        iid = run_train(
+            _fake_engine(), _fake_params(), engine_id="gen",
+            ctx=ctx, storage=memory_storage,
+            watermark={"count": 7, "latestTime": ""},
+        )
+        backend = memory_storage.get_model_data_models()
+        manifest = load_manifest(backend, iid)
+        assert manifest is not None
+        assert manifest["watermark"]["count"] == 7
+        assert manifest["parent"] is None
+        # second train records the first as its parent generation
+        iid2 = run_train(
+            _fake_engine(), _fake_params(), engine_id="gen",
+            ctx=ctx, storage=memory_storage,
+        )
+        assert load_manifest(backend, iid2)["parent"] == iid
+
+    def test_corrupt_latest_falls_back_to_last_good(
+        self, ctx, memory_storage
+    ):
+        g1 = run_train(
+            _fake_engine(), _fake_params(), engine_id="gen",
+            ctx=ctx, storage=memory_storage,
+        )
+        g2 = run_train(
+            _fake_engine(), _fake_params(), engine_id="gen",
+            ctx=ctx, storage=memory_storage,
+        )
+        backend = memory_storage.get_model_data_models()
+        backend.insert(Model(id=g2, models=b"bit-flipped-garbage"))
+        before = get_registry().counter(
+            "pio_model_quarantined_total"
+        ).value
+        instance, algorithms, models, serving = load_deployment(
+            _fake_engine(), _fake_params(), engine_id="gen",
+            ctx=ctx, storage=memory_storage,
+        )
+        assert instance.id == g1  # last-good serves
+        after = get_registry().counter(
+            "pio_model_quarantined_total"
+        ).value
+        assert after == before + 1
+        # the corrupt generation was moved aside, not left loadable
+        assert backend.get(g2) is None
+
+    def test_explicit_corrupt_instance_raises(self, ctx, memory_storage):
+        g1 = run_train(
+            _fake_engine(), _fake_params(), engine_id="gen",
+            ctx=ctx, storage=memory_storage,
+        )
+        backend = memory_storage.get_model_data_models()
+        backend.insert(Model(id=g1, models=b"garbage"))
+        with pytest.raises(ModelIntegrityError):
+            load_deployment(
+                _fake_engine(), _fake_params(), engine_id="gen",
+                instance_id=g1, ctx=ctx, storage=memory_storage,
+            )
+
+    def test_all_corrupt_raises_with_context(self, ctx, memory_storage):
+        g1 = run_train(
+            _fake_engine(), _fake_params(), engine_id="gen",
+            ctx=ctx, storage=memory_storage,
+        )
+        backend = memory_storage.get_model_data_models()
+        backend.insert(Model(id=g1, models=b"garbage"))
+        with pytest.raises(RuntimeError, match="no loadable model"):
+            load_deployment(
+                _fake_engine(), _fake_params(), engine_id="gen",
+                ctx=ctx, storage=memory_storage,
+            )
+
+
+class TestVersionGuard:
+    def test_manifest_version_recorded(self):
+        backend = MemoryModels()
+        manifest = publish_generation(backend, "g1", b"x")
+        assert manifest["version"] == persistence.GENERATION_VERSION

@@ -151,3 +151,99 @@ class TestManualLifecycle:
         assert ManualAlgorithm.train_calls == 1  # no retrain happened
         np.testing.assert_allclose(models[0].weights, 7.0)
         assert serving.serve(1, [algos[0].predict(models[0], 1)]) == 8.0
+
+
+class TestRestoreFailurePaths:
+    """Every damaged-checkpoint shape surfaces a typed error — a
+    half-initialized model is never returned (ISSUE 9 satellite)."""
+
+    def _save(self, tmp_path):
+        import shutil
+
+        from predictionio_tpu.core.persistent_model import (
+            save_persistent_model,
+        )
+
+        d = str(tmp_path / "model")
+        save_persistent_model(
+            d,
+            ToyModel(
+                weights=np.ones((2, 2), np.float32),
+                bias=np.zeros(2, np.float32),
+                vocab=["v"],
+                scale=1.0,
+            ),
+        )
+        return d, shutil
+
+    def test_missing_model_is_typed_and_filenotfound(self, tmp_path):
+        from predictionio_tpu.core.persistent_model import (
+            PersistentModelError,
+            PersistentModelMissing,
+        )
+
+        with pytest.raises(PersistentModelMissing):
+            load_persistent_model(str(tmp_path / "never-saved"))
+        # legacy callers catching FileNotFoundError keep working
+        assert issubclass(PersistentModelMissing, FileNotFoundError)
+        assert issubclass(PersistentModelMissing, PersistentModelError)
+
+    def test_missing_state_dir_raises_typed(self, tmp_path):
+        from predictionio_tpu.core.persistent_model import (
+            PersistentModelError,
+        )
+
+        d, shutil = self._save(tmp_path)
+        shutil.rmtree(f"{d}/state")
+        with pytest.raises(PersistentModelError, match="partial"):
+            load_persistent_model(d)
+
+    def test_orbax_restore_raising_raises_typed(self, tmp_path):
+        """Garbage inside the orbax state dir: whatever orbax raises
+        surfaces as PersistentModelError, never propagates raw or
+        returns a half-initialized model."""
+        import shutil as _shutil
+
+        from predictionio_tpu.core.persistent_model import (
+            PersistentModelError,
+        )
+
+        d, shutil = self._save(tmp_path)
+        state = f"{d}/state"
+        _shutil.rmtree(state)
+        import os as _os
+
+        _os.makedirs(state)
+        with open(f"{state}/not-a-checkpoint", "w") as f:
+            f.write("garbage")
+        with pytest.raises(PersistentModelError):
+            load_persistent_model(d)
+
+    def test_corrupt_aux_pickle_raises_typed(self, tmp_path):
+        from predictionio_tpu.core.persistent_model import (
+            PersistentModelError,
+        )
+
+        d, _ = self._save(tmp_path)
+        with open(f"{d}/aux.pkl", "wb") as f:
+            f.write(b"\x80\x05corrupt")
+        with pytest.raises(PersistentModelError, match="unreadable"):
+            load_persistent_model(d)
+
+    def test_state_missing_declared_key_raises_typed(self, tmp_path):
+        """aux declares array fields the restored state lacks (torn
+        multi-field checkpoint)."""
+        import pickle
+
+        from predictionio_tpu.core.persistent_model import (
+            PersistentModelError,
+        )
+
+        d, _ = self._save(tmp_path)
+        with open(f"{d}/aux.pkl", "rb") as f:
+            aux = pickle.load(f)
+        aux["array_keys"] = aux["array_keys"] + ["phantom_field"]
+        with open(f"{d}/aux.pkl", "wb") as f:
+            pickle.dump(aux, f)
+        with pytest.raises(PersistentModelError, match="phantom_field"):
+            load_persistent_model(d)

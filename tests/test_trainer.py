@@ -1,0 +1,507 @@
+"""Continuous trainer: watermark reads, trigger policy, crash-resume
+provenance, and incremental ALS fold-in end-to-end over the real
+recommendation engine (docs/training.md "Continuous training")."""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from fake_engine import (
+    FakeDataSource,
+    FakeParams,
+    FakePreparator,
+    FakeServing,
+)
+from predictionio_tpu.core import Engine, EngineParams
+from predictionio_tpu.core.engine import EmptyParams
+from predictionio_tpu.core.persistence import (
+    deserialize_models,
+    load_generation,
+    load_manifest,
+)
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import App
+from predictionio_tpu.models.recommendation import (
+    ALSParams,
+    RecDataSourceParams,
+    RecPreparatorParams,
+    recommendation_engine,
+)
+from predictionio_tpu.ops import als as als_ops
+from predictionio_tpu.parallel.mesh import ComputeContext
+from predictionio_tpu.training import (
+    ContinuousTrainer,
+    TrainerConfig,
+    Watermark,
+    read_watermark,
+)
+
+from test_engine_server import DictQueryAlgorithm, DictServing
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return ComputeContext.create(batch="trainer-test")
+
+
+def _make_app(storage, name="tapp"):
+    app_id = storage.get_meta_data_apps().insert(
+        App(id=0, name=name)
+    )
+    storage.get_events().init(app_id)
+    return app_id
+
+
+def _rate(user, item, rating=1.0):
+    return Event(
+        event="rate",
+        entity_type="user",
+        entity_id=user,
+        target_entity_type="item",
+        target_entity_id=item,
+        properties={"rating": rating},
+    )
+
+
+class TestWatermark:
+    def test_empty_store(self, memory_storage):
+        app_id = _make_app(memory_storage)
+        wm = read_watermark(memory_storage.get_events(), app_id)
+        assert wm == Watermark(count=0, latest_time="")
+
+    def test_count_and_latest(self, memory_storage):
+        app_id = _make_app(memory_storage)
+        events = memory_storage.get_events()
+        for i in range(3):
+            events.insert(_rate(f"u{i}", "i0"), app_id)
+        wm = read_watermark(events, app_id)
+        assert wm.count == 3
+        assert wm.latest_time  # ISO of the newest event
+
+    def test_roundtrips_through_json(self):
+        wm = Watermark(count=5, latest_time="2026-08-03T00:00:00+00:00")
+        assert Watermark.from_json(wm.to_json()) == wm
+
+
+def _fake_engine():
+    return Engine(
+        FakeDataSource, FakePreparator, DictQueryAlgorithm, DictServing
+    )
+
+
+def _fake_engine_params():
+    return EngineParams(
+        data_source=("", FakeParams(id=1)),
+        preparator=("", FakeParams(id=2)),
+        algorithms=[("", FakeParams(id=3))],
+        serving=("", FakeParams()),
+    )
+
+
+def _fake_trainer(storage, ctx, tmp_path, **config_kw):
+    _make_app(storage)
+    config = TrainerConfig(
+        app_name="tapp",
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        poll_interval_s=0.01,
+        **config_kw,
+    )
+    return ContinuousTrainer(
+        _fake_engine(),
+        _fake_engine_params(),
+        engine_id="tr",
+        config=config,
+        storage=storage,
+        ctx=ctx,
+    )
+
+
+class TestTriggerPolicy:
+    def test_cold_state_triggers_full(self, memory_storage, ctx, tmp_path):
+        trainer = _fake_trainer(memory_storage, ctx, tmp_path)
+        assert trainer.decide(Watermark(count=0)) == "full"
+
+    def test_poll_runs_full_then_idles(self, memory_storage, ctx, tmp_path):
+        trainer = _fake_trainer(memory_storage, ctx, tmp_path)
+        events = memory_storage.get_events()
+        events.insert(_rate("u0", "i0"), 1)
+        assert trainer.poll_once() == "full"
+        # no new events since: idle
+        assert trainer.poll_once() == "idle"
+        state = trainer.state
+        assert state["lastInstanceId"]
+        assert state["fullTrains"] == 1
+        # the published generation carries the training watermark
+        manifest = load_manifest(
+            memory_storage.get_model_data_models(),
+            state["lastInstanceId"],
+        )
+        assert manifest["watermark"]["count"] == 1
+
+    def test_new_events_escalate_to_full_for_non_als(
+        self, memory_storage, ctx, tmp_path
+    ):
+        """fold_in on a non-ALS-shaped model returns None; the trigger
+        escalates to a full retrain so freshness is never dropped."""
+        trainer = _fake_trainer(memory_storage, ctx, tmp_path)
+        events = memory_storage.get_events()
+        events.insert(_rate("u0", "i0"), 1)
+        assert trainer.poll_once() == "full"
+        events.insert(_rate("u1", "i1"), 1)
+        assert trainer.poll_once() == "full"
+        assert trainer.state["fullTrains"] == 2
+
+    def test_full_every_events(self, memory_storage, ctx, tmp_path):
+        trainer = _fake_trainer(
+            memory_storage, ctx, tmp_path,
+            min_new_events=0, full_every_events=3,
+        )
+        events = memory_storage.get_events()
+        events.insert(_rate("u0", "i0"), 1)
+        assert trainer.poll_once() == "full"
+        events.insert(_rate("u1", "i0"), 1)
+        assert trainer.poll_once() == "idle"
+        events.insert(_rate("u2", "i0"), 1)
+        events.insert(_rate("u3", "i0"), 1)
+        assert trainer.poll_once() == "full"
+
+    def test_state_survives_restart(self, memory_storage, ctx, tmp_path):
+        trainer = _fake_trainer(memory_storage, ctx, tmp_path)
+        memory_storage.get_events().insert(_rate("u0", "i0"), 1)
+        trainer.poll_once()
+        reborn = ContinuousTrainer(
+            _fake_engine(),
+            _fake_engine_params(),
+            engine_id="tr",
+            config=trainer._config,
+            storage=memory_storage,
+            ctx=ctx,
+        )
+        assert reborn.state["lastInstanceId"] == (
+            trainer.state["lastInstanceId"]
+        )
+        assert reborn.poll_once() == "idle"
+
+
+class TestCrashResume:
+    def test_resume_provenance_recorded(
+        self, memory_storage, ctx, tmp_path
+    ):
+        """A checkpoint left by a killed incarnation is picked up: the
+        resume iteration lands in the state file and the stale
+        checkpoint is cleared after the COMPLETED train."""
+        trainer = _fake_trainer(memory_storage, ctx, tmp_path)
+        memory_storage.get_events().insert(_rate("u0", "i0"), 1)
+        ckpt_dir = trainer._config.checkpoint_dir
+        os.makedirs(ckpt_dir, exist_ok=True)
+        ckpt = als_ops.checkpoint_path(ckpt_dir)
+        np.savez(
+            ckpt,
+            iteration=3,
+            user_factors=np.zeros((1, 2), np.float32),
+            item_factors=np.zeros((1, 2), np.float32),
+        )
+        assert trainer.poll_once() == "full"
+        assert trainer.state["resumedFromIteration"] == 3
+        assert not os.path.exists(ckpt)  # cleared after COMPLETED
+
+    def test_interrupted_publish_recovered_on_restart(
+        self, memory_storage, ctx, tmp_path
+    ):
+        """Crash between run_train COMPLETING and the trainer clearing
+        the checkpoint: the next incarnation finalizes the publish and
+        DELETES the stale checkpoint instead of seeding the next
+        train's resume with already-published factors."""
+        trainer = _fake_trainer(memory_storage, ctx, tmp_path)
+        ckpt_dir = trainer._config.checkpoint_dir
+        os.makedirs(ckpt_dir, exist_ok=True)
+        ckpt = als_ops.checkpoint_path(ckpt_dir)
+        np.savez(ckpt, iteration=9, user_factors=np.zeros(1),
+                 item_factors=np.zeros(1))
+        trainer._state.update(
+            phase="publishing",
+            lastInstanceId="ghost-instance",
+            pendingWatermark={"count": 11, "latestTime": ""},
+            fullTrains=1,
+        )
+        trainer._save_state()
+        reborn = ContinuousTrainer(
+            _fake_engine(),
+            _fake_engine_params(),
+            engine_id="tr",
+            config=trainer._config,
+            storage=memory_storage,
+            ctx=ctx,
+        )
+        assert not os.path.exists(ckpt)  # stale checkpoint cleared
+        state = reborn.state
+        assert state["phase"] == "idle"
+        assert state["fullTrains"] == 2
+        assert state["trainedWatermark"]["count"] == 11
+        assert "pendingWatermark" not in state
+
+    def test_corrupt_checkpoint_reads_as_none(self, tmp_path):
+        """A truncated npz (np.load raises BadZipFile, not OSError)
+        must read as 'no checkpoint', never crash-loop the trainer."""
+        ckpt_dir = str(tmp_path)
+        with open(als_ops.checkpoint_path(ckpt_dir), "wb") as f:
+            f.write(b"PK\x03\x04 truncated garbage")
+        assert als_ops.peek_checkpoint_iteration(ckpt_dir) == 0
+
+    def test_train_als_survives_corrupt_checkpoint(self, ctx, tmp_path):
+        ckpt_dir = str(tmp_path)
+        with open(als_ops.checkpoint_path(ckpt_dir), "wb") as f:
+            f.write(b"PK\x03\x04 truncated garbage")
+        factors = als_ops.train_als(
+            ctx,
+            np.array([0, 1]), np.array([0, 1]), np.array([1.0, 1.0]),
+            n_users=2, n_items=2, rank=2, iterations=1, block_len=2,
+            checkpoint_dir=ckpt_dir, checkpoint_every=1, resume=True,
+        )
+        assert np.all(np.isfinite(factors.user_factors))
+
+    def test_torn_state_file_degrades_to_cold(
+        self, memory_storage, ctx, tmp_path
+    ):
+        trainer = _fake_trainer(memory_storage, ctx, tmp_path)
+        state_path = trainer._config.resolved_state_path()
+        os.makedirs(os.path.dirname(state_path), exist_ok=True)
+        with open(state_path, "w") as f:
+            f.write("{torn")
+        reborn = ContinuousTrainer(
+            _fake_engine(),
+            _fake_engine_params(),
+            engine_id="tr",
+            config=trainer._config,
+            storage=memory_storage,
+            ctx=ctx,
+        )
+        assert reborn.decide(Watermark(count=1)) == "full"
+
+
+class TestFoldInMath:
+    def test_explicit_orthonormal_items_recover_ratings(self):
+        y = np.eye(2, dtype=np.float32)
+        x = als_ops.fold_in_users(
+            y,
+            user_rows=np.array([0, 0]),
+            item_cols=np.array([0, 1]),
+            values=np.array([2.0, 3.0]),
+            n_new_users=1,
+            reg=0.0,
+            implicit=False,
+        )
+        np.testing.assert_allclose(x, [[2.0, 3.0]], atol=1e-5)
+
+    def test_implicit_solves_normal_equations(self):
+        rng = np.random.default_rng(7)
+        y = rng.normal(size=(20, 4)).astype(np.float32)
+        rows = np.zeros(5, np.int64)
+        cols = np.arange(5)
+        vals = np.ones(5, np.float32)
+        alpha, reg = 2.0, 0.1
+        x = als_ops.fold_in_users(
+            y, rows, cols, vals, 1, reg=reg, alpha=alpha, implicit=True
+        )[0]
+        yu = y[:5]
+        a = y.T @ y + (yu * alpha).T @ yu + reg * np.eye(4)
+        b = ((1 + alpha) * yu).sum(axis=0)
+        np.testing.assert_allclose(x, np.linalg.solve(a, b), atol=1e-4)
+
+    def test_user_without_interactions_gets_zeros(self):
+        y = np.eye(3, dtype=np.float32)
+        x = als_ops.fold_in_users(
+            y, np.array([1]), np.array([0]), np.array([1.0]), 3
+        )
+        assert np.all(x[0] == 0) and np.all(x[2] == 0)
+        assert np.any(x[1] != 0)
+
+    def test_out_of_range_items_filtered(self):
+        y = np.eye(2, dtype=np.float32)
+        x = als_ops.fold_in_users(
+            y,
+            np.array([0, 0]),
+            np.array([0, 99]),  # 99 unseen by the model
+            np.array([1.0, 1.0]),
+            1,
+        )
+        assert np.all(np.isfinite(x))
+
+    def test_never_produces_nan(self):
+        y = np.zeros((2, 2), np.float32)  # singular Gramian
+        x = als_ops.fold_in_users(
+            y, np.array([0]), np.array([0]), np.array([1.0]), 1,
+            reg=0.0, implicit=False,
+        )
+        assert np.all(np.isfinite(x))
+
+    def test_fold_in_model_honors_objective_params(self):
+        """The fold-in must solve under the parent generation's own
+        reg/alpha/implicit — different objectives give different
+        factors (review finding: hardcoded defaults)."""
+        from predictionio_tpu.data.eventframe import Interactions
+        from predictionio_tpu.utils.bimap import BiMap as BM
+
+        model_cls = dataclasses.make_dataclass(
+            "M", ["user_factors", "item_factors", "user_map", "item_map"]
+        )
+        rng = np.random.default_rng(3)
+        base = model_cls(
+            user_factors=rng.normal(size=(2, 3)).astype(np.float32),
+            item_factors=rng.normal(size=(2, 3)).astype(np.float32),
+            user_map=BM(np.array(["u0", "u1"])),
+            item_map=BM(np.array(["i0", "i1"])),
+        )
+        inter = Interactions(
+            entity_map=BM(np.array(["u0", "u1", "u2"])),
+            target_map=BM(np.array(["i0", "i1"])),
+            rows=np.array([2, 2], np.int32),
+            cols=np.array([0, 1], np.int32),
+            values=np.array([4.0, 1.0], np.float32),
+            times=np.zeros(2, np.int64),
+        )
+        implicit_model, n_u, _ = ContinuousTrainer._fold_in_model(
+            base, inter, reg=0.1, alpha=5.0, implicit=True
+        )
+        explicit_model, _, _ = ContinuousTrainer._fold_in_model(
+            base, inter, reg=0.1, alpha=5.0, implicit=False
+        )
+        assert n_u == 1
+        iu = implicit_model.user_map.get("u2")
+        assert not np.allclose(
+            np.asarray(implicit_model.user_factors)[iu],
+            np.asarray(explicit_model.user_factors)[iu],
+        )
+
+
+def _als_engine_params(app_name="tapp"):
+    return EngineParams(
+        data_source=("", RecDataSourceParams(
+            app_name=app_name, event_names=("rate",),
+        )),
+        preparator=("", RecPreparatorParams()),
+        algorithms=[("als", ALSParams(rank=4, num_iterations=2))],
+        serving=("", EmptyParams()),
+    )
+
+
+class TestFoldInEndToEnd:
+    @pytest.fixture()
+    def als_trainer(self, memory_storage, ctx, tmp_path):
+        _make_app(memory_storage)
+        events = memory_storage.get_events()
+        for u in range(4):
+            for i in range(3):
+                events.insert(_rate(f"u{u}", f"i{i}", 1.0 + (u + i) % 2), 1)
+        config = TrainerConfig(
+            app_name="tapp",
+            checkpoint_dir=str(tmp_path / "ckpt"),
+            min_new_events=1,
+        )
+        return ContinuousTrainer(
+            recommendation_engine(),
+            _als_engine_params(),
+            engine_id="rec",
+            config=config,
+            storage=memory_storage,
+            ctx=ctx,
+        )
+
+    def test_new_user_folds_in_without_full_retrain(
+        self, als_trainer, memory_storage
+    ):
+        assert als_trainer.poll_once() == "full"
+        g1 = als_trainer.state["lastInstanceId"]
+        events = memory_storage.get_events()
+        events.insert(_rate("u_new", "i0"), 1)
+        events.insert(_rate("u_new", "i1"), 1)
+        assert als_trainer.poll_once() == "fold_in"
+        g2 = als_trainer.state["lastInstanceId"]
+        assert g2 != g1
+        backend = memory_storage.get_model_data_models()
+        manifest = load_manifest(backend, g2)
+        assert manifest["parent"] == g1
+        entries = deserialize_models(load_generation(backend, g2))
+        model = entries[0][1]
+        idx = model.user_map.get("u_new")
+        assert idx is not None
+        factors = np.asarray(model.user_factors)
+        assert np.all(np.isfinite(factors))
+        assert np.any(factors[idx] != 0)  # real factors, not padding
+        # the fold-in instance is COMPLETED and deployable
+        instance = (
+            memory_storage.get_meta_data_engine_instances().get(g2)
+        )
+        assert instance.status == "COMPLETED"
+        assert instance.env["foldIn"].startswith("users=1")
+
+    def test_new_item_folds_in(self, als_trainer, memory_storage):
+        assert als_trainer.poll_once() == "full"
+        events = memory_storage.get_events()
+        events.insert(_rate("u0", "i_new"), 1)
+        assert als_trainer.poll_once() == "fold_in"
+        backend = memory_storage.get_model_data_models()
+        g2 = als_trainer.state["lastInstanceId"]
+        model = deserialize_models(load_generation(backend, g2))[0][1]
+        idx = model.item_map.get("i_new")
+        assert idx is not None
+        assert np.any(np.asarray(model.item_factors)[idx] != 0)
+
+    def test_fold_in_respects_data_source_event_filter(
+        self, als_trainer, memory_storage
+    ):
+        """A user seen only through NON-training events ("view" when
+        the data source trains on "rate") must not be folded in — the
+        fold-in reads the same event slice the full train reads."""
+        assert als_trainer.poll_once() == "full"
+        events = memory_storage.get_events()
+        events.insert(
+            Event(
+                event="view", entity_type="user", entity_id="u_viewer",
+                target_entity_type="item", target_entity_id="i0",
+            ),
+            1,
+        )
+        als_trainer.poll_once()  # watermark moved; escalates to full
+        backend = memory_storage.get_model_data_models()
+        g = als_trainer.state["lastInstanceId"]
+        model = deserialize_models(load_generation(backend, g))[0][1]
+        assert model.user_map.get("u_viewer") is None
+
+    def test_known_pair_events_advance_watermark_without_publish(
+        self, als_trainer, memory_storage
+    ):
+        assert als_trainer.poll_once() == "full"
+        g1 = als_trainer.state["lastInstanceId"]
+        # more events for KNOWN users/items: nothing fold-innable
+        memory_storage.get_events().insert(_rate("u0", "i0"), 1)
+        assert als_trainer.poll_once() == "full"  # escalates honestly
+        assert als_trainer.state["lastInstanceId"] != g1
+
+
+class TestCLIWiring:
+    def test_trainer_parser(self):
+        from predictionio_tpu.cli.main import build_parser
+
+        args = build_parser().parse_args([
+            "trainer", "--app", "tapp", "--engine", "recommendation",
+            "--poll-interval", "0.5", "--min-new-events", "2",
+            "--full-every-s", "60", "--checkpoint-dir", "/tmp/x",
+            "--once",
+        ])
+        assert args.app_name == "tapp"
+        assert args.full_every_s == 60.0
+        assert args.once and not args.no_supervise
+
+    def test_config_requires_state_location(self):
+        with pytest.raises(ValueError):
+            TrainerConfig(app_name="a").resolved_state_path()
+
+    def test_state_path_override(self, tmp_path):
+        cfg = TrainerConfig(
+            app_name="a", state_path=str(tmp_path / "s.json")
+        )
+        assert cfg.resolved_state_path().endswith("s.json")

@@ -182,6 +182,85 @@ class TestPersistenceHelpers:
         assert isinstance(entries[0][1]["w"], np.ndarray)
 
 
+@dataclasses.dataclass(frozen=True)
+class CheckpointableParams(FakeParams):
+    """Params with the ops/als checkpoint contract fields."""
+
+    checkpoint_dir: str = ""
+    checkpoint_every: int = 0
+    resume: bool = False
+
+
+class CheckpointableAlgorithm(FakeAlgorithm):
+    params_class = CheckpointableParams
+
+    def train(self, ctx, pd):
+        # the model records the params the algorithm actually trained
+        # with, so the test can assert the CLI flags reached it
+        return {
+            "checkpoint_dir": self.params.checkpoint_dir,
+            "checkpoint_every": self.params.checkpoint_every,
+            "resume": self.params.resume,
+        }
+
+
+class TestCheckpointThreading:
+    """`pio-tpu train --checkpoint-*` reaches the algorithm params
+    (ISSUE 9 satellite: previously the ops/als support was unreachable
+    from the CLI)."""
+
+    def test_flags_rewire_checkpoint_capable_algorithms(
+        self, ctx, memory_storage
+    ):
+        from predictionio_tpu.core.persistence import deserialize_models
+
+        params = EngineParams(
+            data_source=("", FakeParams(id=1)),
+            preparator=("", FakeParams(id=2)),
+            algorithms=[("", CheckpointableParams(id=3))],
+            serving=("", FakeParams()),
+        )
+        iid = run_train(
+            _engine(CheckpointableAlgorithm), params, engine_id="ckpt",
+            ctx=ctx, storage=memory_storage,
+            checkpoint_dir="/tmp/ckpt-test", checkpoint_every=4,
+            resume=True,
+        )
+        blob = memory_storage.get_model_data_models().get(iid).models
+        model = deserialize_models(blob)[0][1]
+        assert model == {
+            "checkpoint_dir": "/tmp/ckpt-test",
+            "checkpoint_every": 4,
+            "resume": True,
+        }
+
+    def test_flags_inert_for_non_checkpoint_algorithms(
+        self, ctx, memory_storage
+    ):
+        # FakeParams has no checkpoint fields: flags are inert, train
+        # still completes (mixed-engine variants are legal)
+        iid = run_train(
+            _engine(), _params(), engine_id="ckpt2",
+            ctx=ctx, storage=memory_storage,
+            checkpoint_dir="/tmp/nope", checkpoint_every=2, resume=True,
+        )
+        assert iid
+
+    def test_apply_checkpoint_params_counts(self):
+        from predictionio_tpu.core.workflow import apply_checkpoint_params
+
+        capable = CheckpointableAlgorithm(CheckpointableParams(id=1))
+        plain = FakeAlgorithm(FakeParams(id=2))
+        assert apply_checkpoint_params(
+            [capable, plain], checkpoint_dir="/tmp/x",
+            checkpoint_every=3, resume=True,
+        ) == 1
+        assert capable.params.checkpoint_dir == "/tmp/x"
+        assert plain.params == FakeParams(id=2)
+        # no checkpoint_dir: nothing rewired
+        assert apply_checkpoint_params([capable], checkpoint_dir=None) == 0
+
+
 class TestReviewRegressions:
     def test_manual_save_sees_trained_instance(self, ctx, memory_storage):
         """MANUAL save_model must run on the same instance that trained."""
