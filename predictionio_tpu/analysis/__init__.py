@@ -1,5 +1,5 @@
-"""AST-based concurrency & device-discipline analyzer (``pio-tpu
-lint``) — see docs/static_analysis.md for the rule catalog.
+"""AST-based concurrency & JAX-compilation-discipline analyzer
+(``pio-tpu lint``) — see docs/static_analysis.md for the rule catalog.
 
 Public surface: :func:`run_lint`, :class:`LintResult`,
 :class:`Finding`, the rule table ``RULES``, and the baseline helpers.

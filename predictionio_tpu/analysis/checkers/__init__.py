@@ -10,7 +10,10 @@ from __future__ import annotations
 from predictionio_tpu.analysis.checkers import (
     clock,
     device_sync,
+    donation,
+    jit_retrace,
     locks,
+    sharding_spec,
     telemetry,
     threads,
 )
@@ -19,6 +22,9 @@ ALL_CHECKERS = (
     locks.check,
     clock.check,
     device_sync.check,
+    jit_retrace.check,
+    sharding_spec.check,
+    donation.check,
     threads.check,
     telemetry.check,
 )

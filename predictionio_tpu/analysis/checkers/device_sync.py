@@ -2,11 +2,13 @@
 
 Two scopes, two rules:
 
-* ``device-sync-jit`` — inside a ``jit``/``pjit``-decorated function,
-  host conversions (``float()``/``int()``/``bool()`` on non-constants,
-  ``.item()``, ``.tolist()``, ``np.asarray``/``np.array``,
-  ``jax.device_get``, ``.block_until_ready()``) either fail at trace
-  time or silently force a host round-trip per call.
+* ``device-sync-jit`` — inside a ``jit``/``pjit``-compiled function
+  (decorator, ``@partial``, or the ``jax.jit(body)`` call form — see
+  :mod:`predictionio_tpu.analysis.jaxast`), host conversions
+  (``float()``/``int()``/``bool()`` on non-constants, ``.item()``,
+  ``.tolist()``, ``np.asarray``/``np.array``, ``jax.device_get``,
+  ``.block_until_ready()``) either fail at trace time or silently
+  force a host round-trip per call.
 * ``device-sync-hot`` — inside ``batch_predict_launch`` (and
   ``dispatch`` methods of two-phase batch_fn classes that also define
   ``collect``), the PR 4 contract is *enqueue-only*: the device barrier
@@ -14,60 +16,24 @@ Two scopes, two rules:
   ``block_until_ready``, ``.tolist()``) defeat the pipeline overlap.
   Host prep (``np.asarray`` on host inputs) is legitimate there and is
   not flagged.
+
+Jit identification and the value-taint engine (with shape-kill:
+``x.shape[0]`` is a trace-time constant) are shared with the
+jit-retrace and donation checkers via ``SourceModule.jit_model()``.
 """
 
 from __future__ import annotations
 
 import ast
 
-from predictionio_tpu.analysis import astutil
+from predictionio_tpu.analysis import astutil, jaxast
 from predictionio_tpu.analysis.model import Finding
 from predictionio_tpu.analysis.source import SourceModule
-
-_JIT_NAMES = {
-    "jit",
-    "jax.jit",
-    "pjit",
-    "jax.pjit",
-    "jax.experimental.pjit.pjit",
-}
 
 _NP_SYNC = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
 _SYNC_DOTTED = {"jax.device_get", "device_get"}
 _SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
 _HOST_CASTS = {"float", "int", "bool"}
-
-
-def _is_jit_decorated(fn: ast.AST) -> bool:
-    for dec in getattr(fn, "decorator_list", ()):
-        name = astutil.dotted_name(dec)
-        if name in _JIT_NAMES:
-            return True
-        if isinstance(dec, ast.Call):
-            fname = astutil.dotted_name(dec.func)
-            if fname in _JIT_NAMES:
-                return True  # @jax.jit(static_argnums=...)
-            if fname in ("partial", "functools.partial") and dec.args:
-                if astutil.dotted_name(dec.args[0]) in _JIT_NAMES:
-                    return True  # @partial(jax.jit, ...)
-    return False
-
-
-def _jit_wrapped_names(tree: ast.AST) -> set[str]:
-    """Function names jitted in *call form* — ``jax.jit(body)`` /
-    ``f = jax.jit(fn)`` / ``partial(jax.jit, ...)(fn)`` — anywhere in
-    the module. Matched by bare name: a collision only makes the lint
-    conservative."""
-    names: set[str] = set()
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fname = astutil.dotted_name(node.func)
-        if fname in _JIT_NAMES:
-            for arg in node.args:
-                if isinstance(arg, ast.Name):
-                    names.add(arg.id)
-    return names
 
 
 def _is_hot_path(qual: str, fn: ast.AST,
@@ -81,40 +47,13 @@ def _is_hot_path(qual: str, fn: ast.AST,
     return False
 
 
-def _tainted_names(fn: ast.AST) -> set[str]:
-    """Names that (may) carry traced values inside a jit function: the
-    parameters, plus locals assigned from expressions mentioning an
-    already-tainted name (single forward pass in textual order — jit
-    bodies are straight-line enough for that to converge)."""
-    args = fn.args
-    tainted = {
-        a.arg
-        for a in (
-            *args.posonlyargs, *args.args, *args.kwonlyargs,
-            *((args.vararg,) if args.vararg else ()),
-            *((args.kwarg,) if args.kwarg else ()),
-        )
-    }
-    for node in ast.walk(fn):
-        if not isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
-            continue
-        value = node.value
-        if value is None:
-            continue
-        if any(
-            isinstance(n, ast.Name) and n.id in tainted
-            for n in ast.walk(value)
-        ):
-            targets = (
-                node.targets
-                if isinstance(node, ast.Assign)
-                else [node.target]
-            )
-            for t in targets:
-                for n in ast.walk(t):
-                    if isinstance(n, ast.Name):
-                        tainted.add(n.id)
-    return tainted
+def _static_names(spec: jaxast.JitSpec) -> set[str]:
+    names = set(spec.static_names)
+    for i in spec.static_nums:
+        p = spec.param_at(i)
+        if p:
+            names.add(p)
+    return names
 
 
 def _sync_desc(
@@ -136,11 +75,9 @@ def _sync_desc(
         and call.func.id in _HOST_CASTS
         and call.args
         # only when the argument can actually be a tracer — casts of
-        # host closure values (float(max(n_baskets, 1))) are fine
-        and any(
-            isinstance(n, ast.Name) and n.id in tainted
-            for n in ast.walk(call.args[0])
-        )
+        # host closure values (float(max(n_baskets, 1))) and of shape
+        # reads (float(x.shape[0])) are trace-time constants
+        and jaxast.expr_is_tainted(call.args[0], tainted)
     ):
         return f"{call.func.id}() on a traced value"
     return None
@@ -150,11 +87,10 @@ def check(modules: list[SourceModule]) -> list[Finding]:
     findings: list[Finding] = []
     for mod in modules:
         index = mod.index()
-        call_form_jitted = _jit_wrapped_names(mod.tree)
+        jit_fns = mod.jit_model().jit_fns
         for qual, fn in index.funcs.items():
-            jit_scope = _is_jit_decorated(fn) or (
-                qual.rsplit(".", 1)[-1] in call_form_jitted
-            )
+            spec = jit_fns.get(qual)
+            jit_scope = spec is not None
             hot_scope = not jit_scope and _is_hot_path(qual, fn, index)
             if not (jit_scope or hot_scope):
                 continue
@@ -164,7 +100,11 @@ def check(modules: list[SourceModule]) -> list[Finding]:
                 if jit_scope
                 else "enqueue-only dispatch path"
             )
-            tainted = _tainted_names(fn) if jit_scope else set()
+            tainted = (
+                jaxast.value_tainted_names(fn, _static_names(spec))
+                if jit_scope
+                else set()
+            )
             for call in astutil.calls_in(fn):
                 desc = _sync_desc(call, jit_scope, tainted)
                 if desc is None:

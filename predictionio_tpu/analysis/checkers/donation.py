@@ -1,0 +1,318 @@
+"""donation — donated buffers must not be read after the jitted call.
+
+``donate_argnums``/``donate_argnames`` hand an argument's device buffer
+to XLA for in-place reuse: after the call returns, the original array
+is *deleted* and any read raises ``RuntimeError: Array has been
+deleted`` — but only on backends that honor donation, so the bug ships
+silently from CPU dev boxes and detonates on the TPU. Flagged:
+
+* a donated local read (including being passed onward) after the
+  donating call, in statement order — rebinding the name (the
+  ``x, y = step(x, y)`` carry pattern) clears it;
+* a donating call inside a loop whose body never rebinds the donated
+  name: iteration 2 re-donates a dead buffer;
+* a donated ``self.<attr>`` read after the call, directly or through
+  same-module helpers (per-function attribute-read summaries chased to
+  a fixpoint, like the lock checker's blocking summaries).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from predictionio_tpu.analysis import astutil, jaxast
+from predictionio_tpu.analysis.model import Finding
+from predictionio_tpu.analysis.source import SourceModule
+
+
+def check(modules: list[SourceModule]) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in modules:
+        index = mod.index()
+        jm = mod.jit_model()
+        if not any(
+            s.donates or s.donates_unknown
+            for s in (
+                *jm.jit_fns.values(),
+                *jm.bindings.values(),
+                *jm.self_bindings.values(),
+            )
+        ):
+            continue
+        reads = _attr_read_summaries(mod, index)
+        for qual, fn in index.funcs.items():
+            findings.extend(
+                _check_function(mod, index, jm, qual, fn, reads)
+            )
+    return findings
+
+
+# -- self-attr read summaries ----------------------------------------------
+
+
+def _attr_read_summaries(
+    mod: SourceModule, index: astutil.FunctionIndex
+) -> dict[str, set[str]]:
+    """qualname -> self-attributes the function (transitively, through
+    same-module calls) reads."""
+    reads: dict[str, set[str]] = {}
+    calls: dict[str, set[str]] = {}
+    for qual, fn in index.funcs.items():
+        r: set[str] = set()
+        c: set[str] = set()
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.ctx, ast.Load)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in ("self", "cls")
+            ):
+                r.add(node.attr)
+            elif isinstance(node, ast.Call):
+                callee = _callee_qual(node, index)
+                if callee:
+                    c.add(callee)
+        reads[qual] = r
+        calls[qual] = c
+    changed = True
+    while changed:
+        changed = False
+        for qual, callees in calls.items():
+            for callee in callees:
+                extra = reads.get(callee, set()) - reads[qual]
+                if extra:
+                    reads[qual] |= extra
+                    changed = True
+    return reads
+
+
+def _callee_qual(
+    call: ast.Call, index: astutil.FunctionIndex
+) -> str | None:
+    func = call.func
+    ctx = index.context_of(call)
+    if isinstance(func, ast.Attribute) and isinstance(
+        func.value, ast.Name
+    ) and func.value.id in ("self", "cls"):
+        owner = index.owner_class.get(ctx, "")
+        qual = f"{owner}.{func.attr}" if owner else func.attr
+        return qual if qual in index.funcs else None
+    if isinstance(func, ast.Name):
+        fn = jaxast.lookup_scope_chain(index.funcs, ctx, func.id)
+        if fn is not None:
+            for qual, node in index.funcs.items():
+                if node is fn:
+                    return qual
+    return None
+
+
+# -- per-function donation analysis ----------------------------------------
+
+
+def _check_function(
+    mod: SourceModule,
+    index: astutil.FunctionIndex,
+    jm: jaxast.JitModel,
+    qual: str,
+    fn: ast.AST,
+    attr_reads: dict[str, set[str]],
+) -> list[Finding]:
+    findings: list[Finding] = []
+    for call in astutil.calls_in(fn):
+        spec = _resolve_call(call, jm, index)
+        if spec is None or not spec.donates:
+            continue
+        donated_locals: list[str] = []
+        donated_attrs: list[str] = []
+        for pos, a in enumerate(call.args):
+            if isinstance(a, ast.Starred):
+                break
+            if not spec.is_donated(pos, None):
+                continue
+            _classify(a, donated_locals, donated_attrs)
+        for kw in call.keywords:
+            if kw.arg and spec.is_donated(None, kw.arg):
+                _classify(kw.value, donated_locals, donated_attrs)
+        if not donated_locals and not donated_attrs:
+            continue
+        rebound = _rebound_by_statement(call)
+        for name in donated_locals:
+            if name in rebound:
+                continue
+            read = _first_read_after(fn, call, name)
+            if read is not None:
+                findings.append(
+                    _finding(
+                        mod, read.lineno, read.col_offset, qual,
+                        f"`{name}` is donated to {spec.name}() and "
+                        f"read again afterwards — the buffer is "
+                        "deleted by donation on device backends",
+                    )
+                )
+            elif _loop_without_rebind(call, name):
+                findings.append(
+                    _finding(
+                        mod, call.lineno, call.col_offset, qual,
+                        f"`{name}` is donated to {spec.name}() inside "
+                        "a loop that never rebinds it — the next "
+                        "iteration re-donates a deleted buffer",
+                    )
+                )
+        for attr in donated_attrs:
+            site = _attr_read_after(
+                fn, index, call, attr, attr_reads
+            )
+            if site is not None:
+                node, via = site
+                suffix = f" via {via}()" if via else ""
+                findings.append(
+                    _finding(
+                        mod, node.lineno, node.col_offset, qual,
+                        f"`self.{attr}` is donated to {spec.name}() "
+                        f"and read again afterwards{suffix} — the "
+                        "buffer is deleted by donation on device "
+                        "backends",
+                    )
+                )
+    return findings
+
+
+def _classify(
+    expr: ast.AST, locals_out: list[str], attrs_out: list[str]
+) -> None:
+    if isinstance(expr, ast.Name):
+        locals_out.append(expr.id)
+    elif isinstance(expr, ast.Attribute) and isinstance(
+        expr.value, ast.Name
+    ) and expr.value.id in ("self", "cls"):
+        attrs_out.append(expr.attr)
+
+
+def _resolve_call(
+    call: ast.Call, jm: jaxast.JitModel, index: astutil.FunctionIndex
+) -> jaxast.JitSpec | None:
+    func = call.func
+    ctx = index.context_of(call)
+    if isinstance(func, ast.Name):
+        return jaxast.lookup_scope_chain(jm.bindings, ctx, func.id)
+    if isinstance(func, ast.Attribute) and isinstance(
+        func.value, ast.Name
+    ) and func.value.id in ("self", "cls"):
+        owner = index.owner_class.get(ctx, "")
+        return jm.self_bindings.get((owner, func.attr))
+    return None
+
+
+def _enclosing_statement(node: ast.AST) -> ast.stmt | None:
+    while node is not None and not isinstance(node, ast.stmt):
+        node = astutil.parent_of(node)
+    return node
+
+
+def _rebound_by_statement(call: ast.Call) -> set[str]:
+    """Names the donating call's own statement rebinds (``x = f(x)``)."""
+    stmt = _enclosing_statement(call)
+    out: set[str] = set()
+    if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = (
+            stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        )
+        for t in targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+    return out
+
+
+def _after(call: ast.Call, node: ast.AST) -> bool:
+    if not hasattr(node, "lineno"):
+        return False  # helper nodes (arguments, operators) carry no pos
+    end_line = getattr(call, "end_lineno", call.lineno)
+    end_col = getattr(call, "end_col_offset", call.col_offset)
+    return (node.lineno, node.col_offset) > (end_line, end_col)
+
+
+def _first_read_after(
+    fn: ast.AST, call: ast.Call, name: str
+) -> ast.AST | None:
+    """Earliest Load of ``name`` after the donating call that is not
+    preceded by an intervening rebinding (crude but effective linear
+    order over the flat statement list — jit call sites in this tree
+    are straight-line)."""
+    events: list[tuple[int, int, str, ast.AST]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and node.id == name:
+            if not _after(call, node):
+                continue
+            kind = "store" if isinstance(
+                node.ctx, (ast.Store, ast.Del)
+            ) else "load"
+            events.append((node.lineno, node.col_offset, kind, node))
+    events.sort(key=lambda e: (e[0], e[1]))
+    for _line, _col, kind, node in events:
+        if kind == "store":
+            return None
+        return node
+    return None
+
+
+def _loop_without_rebind(call: ast.Call, name: str) -> bool:
+    node: ast.AST | None = call
+    while node is not None:
+        node = astutil.parent_of(node)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            for sub in ast.walk(node):
+                if (
+                    isinstance(sub, ast.Name)
+                    and sub.id == name
+                    and isinstance(sub.ctx, ast.Store)
+                ):
+                    return False
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                for sub in ast.walk(node.target):
+                    if isinstance(sub, ast.Name) and sub.id == name:
+                        return False
+            return True
+    return False
+
+
+def _attr_read_after(
+    fn: ast.AST,
+    index: astutil.FunctionIndex,
+    call: ast.Call,
+    attr: str,
+    attr_reads: dict[str, set[str]],
+) -> tuple[ast.AST, str | None] | None:
+    for node in ast.walk(fn):
+        if not _after(call, node):
+            continue
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.ctx, ast.Load)
+            and isinstance(node.value, ast.Name)
+            and node.value.id in ("self", "cls")
+            and node.attr == attr
+            and astutil.parent_of(node) is not call.func
+        ):
+            return node, None
+        if isinstance(node, ast.Call):
+            callee = _callee_qual(node, index)
+            if callee and attr in attr_reads.get(callee, set()):
+                return node, callee
+    return None
+
+
+def _finding(
+    mod: SourceModule, line: int, col: int, ctx: str, message: str
+) -> Finding:
+    return Finding(
+        rule="donation",
+        path=mod.rel_path,
+        line=line,
+        col=col,
+        message=message,
+        context=ctx,
+        source=mod.source_line(line),
+    )

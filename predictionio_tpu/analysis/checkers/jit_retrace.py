@@ -1,0 +1,338 @@
+"""jit-retrace — compile-cache discipline for jit/pjit functions.
+
+A jit cache miss in the serving hot path is a silent p99 catastrophe:
+the request that triggers it pays a full XLA compile (seconds) while
+every queued request behind it waits. The hazards are mechanical and
+visible in the AST:
+
+* **tracer-dependent Python control flow** in a jit body — ``if``/
+  ``while`` on a value derived from a traced parameter either raises at
+  trace time or (via rank-0 bool coercion on older paths) bakes one
+  branch in and retraces per boolean. ``x is None`` structure checks
+  and shape-derived conditions (``if x.shape[0] > 1``) are trace-time
+  constants and stay legal; so does ``lax.cond``/``lax.while_loop``.
+* **shape-derived Python scalars passed to traced parameters** —
+  ``f(x, x.shape[0])`` where the parameter is not in
+  ``static_argnums``/``static_argnames``. The value is trace-constant,
+  so as a traced argument it silently re-promotes per call; declared
+  static it is bounded by the caller's bucketing and hits the cache.
+* **unbounded or unhashable static arguments** — an f-string (or any
+  str-building expression) fed to a static parameter makes every call a
+  new cache entry; a list/dict/set literal raises ``TypeError``
+  (unhashable) at call time.
+* **str arguments to traced parameters** — strings cannot be traced;
+  they must be declared static.
+
+Call sites are resolved through the module's jit bindings (decorated
+defs, ``name = jax.jit(...)`` assignments, ``self._f = jax.jit(...)``
+attributes, and the ``jax.jit(body)`` closure pattern) plus
+``from <analyzed module> import <jit fn>`` imports across the project.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from predictionio_tpu.analysis import astutil, jaxast
+from predictionio_tpu.analysis.model import Finding
+from predictionio_tpu.analysis.source import SourceModule
+
+
+def _module_dotted(rel_path: str) -> str:
+    path = rel_path[:-3] if rel_path.endswith(".py") else rel_path
+    if path.endswith("/__init__"):
+        path = path[: -len("/__init__")]
+    return path.replace("/", ".")
+
+
+def _control_flow_tainted(test: ast.expr, tainted: set[str]) -> bool:
+    """Value-taint for an if/while test, exempting pure identity
+    checks (``x is None`` / ``x is not None`` are structural, resolved
+    at trace time)."""
+    if isinstance(test, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+    ):
+        return False
+    if isinstance(test, ast.BoolOp):
+        return any(
+            _control_flow_tainted(v, tainted) for v in test.values
+        )
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _control_flow_tainted(test.operand, tainted)
+    return jaxast.expr_is_tainted(test, tainted)
+
+
+def _static_param_names(spec: jaxast.JitSpec) -> set[str]:
+    names = set(spec.static_names)
+    for i in spec.static_nums:
+        p = spec.param_at(i)
+        if p:
+            names.add(p)
+    return names
+
+
+def _iter_own_statements(fn: ast.AST):
+    """Statements of ``fn`` without descending into nested defs (those
+    are separate analyses — fori/scan bodies get flagged only when they
+    are themselves jit-identified, mirroring the device-sync checker)."""
+    yield from astutil.walk_statements(fn.body)
+
+
+def _is_str_building(expr: ast.AST) -> bool:
+    if isinstance(expr, ast.JoinedStr):
+        return True
+    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Attribute):
+        if expr.func.attr == "format" and isinstance(
+            expr.func.value, (ast.Constant, ast.JoinedStr)
+        ):
+            return True
+    if isinstance(expr, ast.BinOp) and isinstance(
+        expr.op, (ast.Add, ast.Mod)
+    ):
+        for side in (expr.left, expr.right):
+            if isinstance(side, ast.Constant) and isinstance(
+                side.value, str
+            ):
+                return True
+    return False
+
+
+_UNHASHABLE = (
+    ast.List, ast.Dict, ast.Set,
+    ast.ListComp, ast.DictComp, ast.SetComp, ast.GeneratorExp,
+)
+
+
+def check(modules: list[SourceModule]) -> list[Finding]:
+    models: dict[str, jaxast.JitModel] = {}
+    exported: dict[str, dict[str, jaxast.JitSpec]] = {}
+    for mod in modules:
+        jm = mod.jit_model()
+        models[mod.rel_path] = jm
+        exported[_module_dotted(mod.rel_path)] = {
+            name: spec
+            for (scope, name), spec in jm.bindings.items()
+            if scope == ""
+        }
+
+    findings: list[Finding] = []
+    for mod in modules:
+        jm = models[mod.rel_path]
+        index = mod.index()
+        imported = _imported_jit(mod, exported)
+        findings.extend(_check_bodies(mod, jm))
+        findings.extend(_check_call_sites(mod, jm, index, imported))
+    return findings
+
+
+def _imported_jit(
+    mod: SourceModule, exported: dict[str, dict[str, jaxast.JitSpec]]
+) -> dict[str, jaxast.JitSpec]:
+    """Local name -> spec for jit functions imported from analyzed
+    modules (``from predictionio_tpu.ops.x import jitted_fn``)."""
+    out: dict[str, jaxast.JitSpec] = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ImportFrom) or node.level:
+            continue
+        table = exported.get(node.module or "")
+        if not table:
+            continue
+        for alias in node.names:
+            spec = table.get(alias.name)
+            if spec is not None:
+                out[alias.asname or alias.name] = spec
+    return out
+
+
+def _check_bodies(mod: SourceModule, jm: jaxast.JitModel) -> list[Finding]:
+    findings: list[Finding] = []
+    for qual, spec in jm.jit_fns.items():
+        fn = spec.fn
+        if fn is None or isinstance(fn, ast.Lambda):
+            continue
+        tainted = jaxast.value_tainted_names(fn, _static_param_names(spec))
+        for stmt in _iter_own_statements(fn):
+            if isinstance(stmt, (ast.If, ast.While)) and (
+                _control_flow_tainted(stmt.test, tainted)
+            ):
+                kind = "if" if isinstance(stmt, ast.If) else "while"
+                findings.append(
+                    _finding(
+                        mod, stmt.lineno, stmt.col_offset, qual,
+                        f"Python `{kind}` on a traced value inside "
+                        f"jit function {qual}() — fails at trace time "
+                        "or retraces per branch; use lax.cond/"
+                        "lax.while_loop (shape checks are exempt)",
+                    )
+                )
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                it = stmt.iter
+                if (
+                    isinstance(it, ast.Call)
+                    and astutil.dotted_name(it.func) == "range"
+                    and any(
+                        jaxast.expr_is_tainted(a, tainted)
+                        for a in it.args
+                    )
+                ):
+                    findings.append(
+                        _finding(
+                            mod, stmt.lineno, stmt.col_offset, qual,
+                            f"`range()` over a traced value inside jit "
+                            f"function {qual}() — the loop bound must "
+                            "be static; use lax.fori_loop or declare "
+                            "the bound static",
+                        )
+                    )
+    return findings
+
+
+def _check_call_sites(
+    mod: SourceModule,
+    jm: jaxast.JitModel,
+    index: astutil.FunctionIndex,
+    imported: dict[str, jaxast.JitSpec],
+) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        spec = _resolve_call(node, jm, index, imported)
+        if spec is None:
+            continue
+        args = _map_arguments(node, spec)
+        if args is None:
+            continue  # arity can't belong to this spec — misresolved
+        ctx = index.context_of(node)
+        for pos, kw_name, expr in args:
+            name = kw_name or (
+                spec.param_at(pos) if pos is not None else None
+            )
+            if spec.statics_unknown:
+                continue
+            if spec.is_static(pos, name):
+                label = name or f"arg {pos}"
+                if _is_str_building(expr):
+                    findings.append(
+                        _finding(
+                            mod, expr.lineno, expr.col_offset, ctx,
+                            f"str-building expression passed to static "
+                            f"arg `{label}` of jit function "
+                            f"{spec.name}() — every distinct string is "
+                            "a fresh compile cache entry",
+                        )
+                    )
+                elif isinstance(expr, _UNHASHABLE):
+                    findings.append(
+                        _finding(
+                            mod, expr.lineno, expr.col_offset, ctx,
+                            f"non-hashable literal passed to static "
+                            f"arg `{label}` of jit function "
+                            f"{spec.name}() — static args must be "
+                            "hashable (use a tuple)",
+                        )
+                    )
+            else:
+                label = name or (f"arg {pos}" if pos is not None else "?")
+                if jaxast.scalar_shape_derived(expr):
+                    findings.append(
+                        _finding(
+                            mod, expr.lineno, expr.col_offset, ctx,
+                            f"shape-derived Python scalar passed to "
+                            f"traced arg `{label}` of jit function "
+                            f"{spec.name}() — it is trace-constant; "
+                            "declare it in static_argnums/"
+                            "static_argnames so the cache keys on it",
+                        )
+                    )
+                elif isinstance(expr, ast.Constant) and isinstance(
+                    expr.value, str
+                ):
+                    findings.append(
+                        _finding(
+                            mod, expr.lineno, expr.col_offset, ctx,
+                            f"str passed to traced arg `{label}` of "
+                            f"jit function {spec.name}() — strings "
+                            "cannot be traced; declare the parameter "
+                            "static",
+                        )
+                    )
+                elif _is_str_building(expr):
+                    findings.append(
+                        _finding(
+                            mod, expr.lineno, expr.col_offset, ctx,
+                            f"str-building expression passed to traced "
+                            f"arg `{label}` of jit function "
+                            f"{spec.name}() — strings cannot be "
+                            "traced; declare the parameter static",
+                        )
+                    )
+    return findings
+
+
+def _resolve_call(
+    call: ast.Call,
+    jm: jaxast.JitModel,
+    index: astutil.FunctionIndex,
+    imported: dict[str, jaxast.JitSpec],
+) -> jaxast.JitSpec | None:
+    func = call.func
+    ctx = index.context_of(call)
+    if isinstance(func, ast.Name):
+        spec = jaxast.lookup_scope_chain(jm.bindings, ctx, func.id)
+        if spec is not None:
+            return spec
+        return imported.get(func.id)
+    if isinstance(func, ast.Attribute) and isinstance(
+        func.value, ast.Name
+    ) and func.value.id in ("self", "cls"):
+        owner = index.owner_class.get(ctx, "")
+        return jm.self_bindings.get((owner, func.attr))
+    return None
+
+
+def _map_arguments(
+    call: ast.Call, spec: jaxast.JitSpec
+) -> list[tuple[int | None, str | None, ast.expr]] | None:
+    """(positional index, keyword name, expr) triples; None when the
+    positional arity cannot belong to this spec (bare-name collision
+    with an unrelated function — stay silent rather than misreport)."""
+    out: list[tuple[int | None, str | None, ast.expr]] = []
+    n_pos = 0
+    for i, a in enumerate(call.args):
+        if isinstance(a, ast.Starred):
+            return out  # positions after *args are unknowable
+        out.append((i, None, a))
+        n_pos += 1
+    if spec.params and not spec.has_vararg and n_pos > len(spec.params):
+        return None
+    for kw in call.keywords:
+        if kw.arg is None:
+            continue  # **kwargs — unknowable
+        if spec.params and kw.arg not in spec.params and not _maybe_kwonly(
+            spec, kw.arg
+        ):
+            return None
+        out.append((None, kw.arg, kw.value))
+    return out
+
+
+def _maybe_kwonly(spec: jaxast.JitSpec, name: str) -> bool:
+    fn = spec.fn
+    if fn is None:
+        return True  # unknown signature — accept
+    return name in jaxast.all_param_names(fn)
+
+
+def _finding(
+    mod: SourceModule, line: int, col: int, ctx: str, message: str
+) -> Finding:
+    return Finding(
+        rule="jit-retrace",
+        path=mod.rel_path,
+        line=line,
+        col=col,
+        message=message,
+        context=ctx,
+        source=mod.source_line(line),
+    )

@@ -5,12 +5,27 @@ Deliberately jax-free and stdlib-only: the lint gate must run in
 seconds on any checkout (CI sets it up before the heavyweight test
 deps), and importing an accelerator runtime to parse python would be
 absurd.
+
+Each checker's wall time is recorded (``LintResult.timings_ms``,
+surfaced as ``timingsMs`` under ``--json``) so the growing rule set
+can't silently bloat the CI gate — ``scripts/check.sh`` enforces a
+30 s total budget.
+
+``changed_ref`` scopes *reporting* to files touched vs a git ref
+(``pio-tpu lint --changed``): the full tree is still loaded and
+analyzed so project-wide rules (lock cycles, metric-name registry,
+mesh-axis registry) keep their context, but findings are only reported
+in changed files. When git is unavailable the scope silently widens
+back to the full tree — the fast path must never be less strict than
+the slow one.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import os
+import subprocess
+import time
 
 from predictionio_tpu.analysis import baseline as baseline_mod
 from predictionio_tpu.analysis.checkers import ALL_CHECKERS
@@ -29,6 +44,13 @@ class LintResult:
     stale_baseline: list[baseline_mod.BaselineEntry]
     errors: list[str]
     files_checked: int
+    #: checker module name -> wall milliseconds
+    timings_ms: dict[str, float] = dataclasses.field(default_factory=dict)
+    total_ms: float = 0.0
+    #: repo-relative changed files reporting was scoped to
+    #: (None = full-tree run)
+    scoped_to: list[str] | None = None
+    notes: list[str] = dataclasses.field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -38,12 +60,26 @@ class LintResult:
         return sorted(self.new + self.baselined, key=Finding.sort_key)
 
 
-def analyze_modules(modules: list[SourceModule]) -> list[Finding]:
-    """Run every checker, drop suppressed findings."""
+def analyze_modules(
+    modules: list[SourceModule],
+    timings_ms: dict[str, float] | None = None,
+) -> list[Finding]:
+    """Run every checker, drop suppressed findings. When ``timings_ms``
+    is given, each checker's wall time lands in it keyed by module
+    name (``locks``, ``jit_retrace``, ...)."""
     by_path = {m.rel_path: m for m in modules}
     findings: list[Finding] = []
     for checker in ALL_CHECKERS:
-        for f in checker(modules):
+        start = time.monotonic()
+        checker_findings = checker(modules)
+        if timings_ms is not None:
+            name = checker.__module__.rsplit(".", 1)[-1]
+            timings_ms[name] = round(
+                timings_ms.get(name, 0.0)
+                + (time.monotonic() - start) * 1000.0,
+                2,
+            )
+        for f in checker_findings:
             mod = by_path.get(f.path)
             if mod is not None and mod.suppressed(f.rule, f.line):
                 continue
@@ -51,15 +87,107 @@ def analyze_modules(modules: list[SourceModule]) -> list[Finding]:
     return sorted(findings, key=Finding.sort_key)
 
 
+class _BadRefError(Exception):
+    """``--changed REF`` named something git cannot resolve to a
+    commit — a typo'd branch or (classically) a path swallowed by the
+    optional REF argument. Loud failure, never a silent wrong scope."""
+
+
+def _git_changed_files(root: str, ref: str) -> tuple[set[str] | None, str]:
+    """Root-relative changed + untracked files vs ``ref``; (None,
+    reason) when git itself is unavailable (not a repo, no binary).
+    An unresolvable ref raises :class:`_BadRefError` instead — git
+    *is* available, so widening the scope would mask a user error
+    (``git diff <dir>`` happily treats the bad ref as a pathspec).
+    """
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            cwd=root, capture_output=True, text=True, timeout=10,
+        )
+        if top.returncode != 0:
+            return None, top.stderr.strip() or "not a git repository"
+        git_root = top.stdout.strip()
+        verify = subprocess.run(
+            ["git", "rev-parse", "--verify", "--quiet",
+             f"{ref}^{{commit}}"],
+            cwd=root, capture_output=True, text=True, timeout=10,
+        )
+        if verify.returncode != 0:
+            raise _BadRefError(
+                f"--changed: {ref!r} does not name a commit "
+                "(note: `--changed <path>` parses the path as the REF "
+                "— put paths before the flag or use `--changed HEAD`)"
+            )
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", ref],
+            cwd=root, capture_output=True, text=True, timeout=10,
+        )
+        if diff.returncode != 0:
+            return None, diff.stderr.strip() or f"git diff {ref} failed"
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True, timeout=10,
+        )
+    except (OSError, subprocess.SubprocessError) as e:
+        return None, str(e)
+    rel: set[str] = set()
+    # `git diff --name-only` prints repo-root-relative paths; but
+    # `ls-files --others` prints them relative to the cwd it ran in
+    for base, out in (
+        (git_root, diff.stdout),
+        (root, untracked.stdout if untracked.returncode == 0 else ""),
+    ):
+        for ln in out.splitlines():
+            name = ln.strip()
+            if not name:
+                continue
+            abs_path = os.path.join(base, name)
+            rel.add(
+                os.path.relpath(abs_path, root).replace(os.sep, "/")
+            )
+    return rel, ""
+
+
 def run_lint(
     paths: list[str],
     root: str | None = None,
     baseline_path: str | None = None,
+    changed_ref: str | None = None,
 ) -> LintResult:
     root = os.path.abspath(root or os.getcwd())
+    start = time.monotonic()
     files = iter_python_files(paths)
     modules, errors = load_modules(files, root)
-    findings = analyze_modules(modules)
+    timings: dict[str, float] = {}
+    findings = analyze_modules(modules, timings_ms=timings)
+
+    notes: list[str] = []
+    scoped_to: list[str] | None = None
+    if changed_ref is not None:
+        try:
+            changed, reason = _git_changed_files(root, changed_ref)
+        except _BadRefError as e:
+            # git answered but the ref is garbage: fail loudly — a
+            # silent full-tree (or worse, wrong-scope) run would mask
+            # the user error
+            errors.append(str(e))
+            changed, reason = None, None
+        if changed is None:
+            if reason is not None:
+                notes.append(
+                    f"--changed: {reason}; falling back to the "
+                    "full tree"
+                )
+        else:
+            scoped_to = sorted(
+                changed & {m.rel_path for m in modules}
+            )
+            findings = [f for f in findings if f.path in changed]
+            errors = [
+                e for e in errors
+                if e.split(":", 1)[0] in changed
+            ]
 
     entries: list[baseline_mod.BaselineEntry] = []
     if baseline_path and os.path.exists(baseline_path):
@@ -70,10 +198,18 @@ def run_lint(
     new, baselined, stale = baseline_mod.split_by_baseline(
         findings, entries
     )
+    if scoped_to is not None:
+        # a scoped run sees only a slice of the findings — baseline
+        # entries matching nothing here are NOT stale, just out of view
+        stale = []
     return LintResult(
         new=new,
         baselined=baselined,
         stale_baseline=stale,
         errors=errors,
         files_checked=len(modules),
+        timings_ms=timings,
+        total_ms=round((time.monotonic() - start) * 1000.0, 2),
+        scoped_to=scoped_to,
+        notes=notes,
     )

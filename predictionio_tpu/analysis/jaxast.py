@@ -1,0 +1,470 @@
+"""Shared jit/pjit recognition + value-taint machinery for the JAX
+compilation-discipline checkers (device-sync, jit-retrace, donation).
+
+Three ways a function ends up "jit scope" in this tree, all recognized:
+
+* decorator form — ``@jax.jit`` / ``@partial(jax.jit, ...)``;
+* call form on a def — ``return jax.jit(body)`` (the ``ops/als.py``
+  closure pattern): every def with that bare name in the module is
+  treated as traced, a collision only makes the lint conservative;
+* binding form — ``step = jax.jit(fn, ...)`` / ``self._f = jax.jit(...)``:
+  the *name* becomes a jit callable whose call sites can be checked.
+
+A :class:`JitSpec` carries the wrapped signature plus the resolved
+``static_argnums``/``static_argnames``/``donate_argnums``/
+``donate_argnames``. Resolution follows simple local/module assignments
+and takes the union over ``a if cond else b`` branches (the
+``donate = (0, 1) if backend != "cpu" else ()`` pattern), so a spec is
+only ``None``-unknown when the value genuinely can't be read statically.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from predictionio_tpu.analysis import astutil
+
+JIT_NAMES = {
+    "jit",
+    "jax.jit",
+    "pjit",
+    "jax.pjit",
+    "jax.experimental.pjit.pjit",
+}
+
+#: attribute reads that yield trace-time *constants* even on a traced
+#: receiver — they kill value taint (``x.shape[0]`` is static under jit)
+SHAPE_ATTRS = {"shape", "ndim", "size", "dtype"}
+
+
+def jit_call_target(call: ast.Call) -> bool:
+    """True when ``call`` is ``jax.jit(...)``/``pjit(...)`` itself."""
+    return astutil.dotted_name(call.func) in JIT_NAMES
+
+
+@dataclasses.dataclass
+class JitSpec:
+    """One jit-compiled callable: signature + static/donate decl."""
+
+    name: str                       # bare name the callable binds to
+    scope: str                      # qualname the binding lives in
+    fn: ast.AST | None              # FunctionDef/Lambda body, if known
+    params: tuple[str, ...]         # positional params, in order
+    has_vararg: bool
+    static_names: frozenset[str]
+    static_nums: frozenset[int]
+    donate_names: frozenset[str]
+    donate_nums: frozenset[int]
+    #: True when static_argnums/argnames could not be resolved — the
+    #: call-site checks must then stay silent rather than guess
+    statics_unknown: bool
+    donates_unknown: bool
+    line: int
+
+    @property
+    def donates(self) -> bool:
+        return bool(self.donate_names or self.donate_nums)
+
+    def param_at(self, pos: int) -> str | None:
+        if pos < len(self.params):
+            return self.params[pos]
+        return None
+
+    def is_static(self, pos: int | None, name: str | None) -> bool:
+        if pos is not None and pos in self.static_nums:
+            return True
+        if name is not None and name in self.static_names:
+            return True
+        if pos is not None and self.param_at(pos) in self.static_names:
+            return True
+        return False
+
+    def is_donated(self, pos: int | None, name: str | None) -> bool:
+        if pos is not None and pos in self.donate_nums:
+            return True
+        if name is not None and name in self.donate_names:
+            return True
+        if pos is not None and self.param_at(pos) in self.donate_names:
+            return True
+        return False
+
+
+def param_names(fn: ast.AST) -> tuple[str, ...]:
+    """Positional parameter names of a def/lambda, in call order
+    (posonly then regular); kwonly/vararg/kwarg excluded."""
+    args = fn.args
+    return tuple(a.arg for a in (*args.posonlyargs, *args.args))
+
+
+def all_param_names(fn: ast.AST) -> set[str]:
+    """Every bindable parameter name, including kwonly/vararg/kwarg."""
+    args = fn.args
+    return {
+        a.arg
+        for a in (
+            *args.posonlyargs, *args.args, *args.kwonlyargs,
+            *((args.vararg,) if args.vararg else ()),
+            *((args.kwarg,) if args.kwarg else ()),
+        )
+    }
+
+
+class JitModel:
+    """Per-module jit inventory.
+
+    * ``jit_fns`` — qualname -> spec for every function whose *body*
+      runs under trace (decorated, or bare-name matched by a call-form
+      ``jax.jit(name)`` anywhere in the module);
+    * ``bindings`` — (scope qualname, bare name) -> spec for names that
+      are jit callables at their call sites;
+    * ``self_bindings`` — (class qualname, attr) -> spec for
+      ``self._f = jax.jit(...)`` instance attributes.
+    """
+
+    def __init__(self, mod, index: astutil.FunctionIndex):
+        self.mod = mod
+        self.index = index
+        self.jit_fns: dict[str, JitSpec] = {}
+        self.bindings: dict[tuple[str, str], JitSpec] = {}
+        self.self_bindings: dict[tuple[str, str], JitSpec] = {}
+        self._collect()
+
+    # -- construction ------------------------------------------------------
+    def _collect(self) -> None:
+        wrapped = self._call_form_names()
+        for qual, fn in self.index.funcs.items():
+            dec = _jit_decorator(fn)
+            if dec is not None:
+                spec = self._make_spec(qual, fn, dec)
+            elif fn.name in wrapped:
+                spec = self._make_spec(qual, fn, wrapped[fn.name])
+            else:
+                continue
+            self.jit_fns[qual] = spec
+            scope = qual.rsplit(".", 1)[0] if "." in qual else ""
+            self.bindings.setdefault((scope, fn.name), spec)
+        self._collect_assignments()
+
+    def _call_form_names(self) -> dict[str, ast.Call]:
+        """Bare names passed to ``jax.jit(...)`` in call form."""
+        out: dict[str, ast.Call] = {}
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, ast.Call) and jit_call_target(node):
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        out.setdefault(arg.id, node)
+        return out
+
+    def _collect_assignments(self) -> None:
+        """``name = jax.jit(fn_or_lambda, ...)`` bindings."""
+        for node in ast.walk(self.mod.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = node.value
+            if not isinstance(value, ast.Call) or not jit_call_target(value):
+                continue
+            ctx = self.index.context_of(node)
+            fn = self._resolve_wrapped(value, ctx)
+            targets = (
+                node.targets
+                if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for target in targets:
+                if isinstance(target, ast.Name):
+                    spec = self._make_spec(
+                        f"{ctx}.{target.id}" if ctx else target.id,
+                        fn, value, name=target.id, scope=ctx,
+                    )
+                    self.bindings.setdefault((ctx, target.id), spec)
+                elif (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id in ("self", "cls")
+                ):
+                    owner = self.index.owner_class.get(ctx, "")
+                    spec = self._make_spec(
+                        f"{owner}.{target.attr}", fn, value,
+                        name=target.attr, scope=owner,
+                    )
+                    self.self_bindings.setdefault(
+                        (owner, target.attr), spec
+                    )
+
+    def _resolve_wrapped(self, call: ast.Call, ctx: str) -> ast.AST | None:
+        """The function node wrapped by a ``jax.jit(...)`` call."""
+        if not call.args:
+            return None
+        arg = call.args[0]
+        if isinstance(arg, ast.Lambda):
+            return arg
+        if isinstance(arg, ast.Name):
+            found = lookup_scope_chain(self.index.funcs, ctx, arg.id)
+            if found is not None:
+                return found
+        return None
+
+    def _make_spec(
+        self,
+        qual: str,
+        fn: ast.AST | None,
+        jit_call_or_dec: ast.AST,
+        name: str | None = None,
+        scope: str | None = None,
+    ) -> JitSpec:
+        kwargs = _jit_keywords(jit_call_or_dec)
+        ctx = self.index.context_of(jit_call_or_dec)
+        static_names, sn_known = self._str_set(kwargs.get("static_argnames"), ctx)
+        static_nums, si_known = self._int_set(kwargs.get("static_argnums"), ctx)
+        donate_names, dn_known = self._str_set(kwargs.get("donate_argnames"), ctx)
+        donate_nums, di_known = self._int_set(kwargs.get("donate_argnums"), ctx)
+        params = param_names(fn) if fn is not None else ()
+        if scope is None:
+            scope = qual.rsplit(".", 1)[0] if "." in qual else ""
+        return JitSpec(
+            name=name or qual.rsplit(".", 1)[-1],
+            scope=scope,
+            fn=fn,
+            params=params,
+            has_vararg=bool(fn is not None and fn.args.vararg),
+            static_names=frozenset(static_names),
+            static_nums=frozenset(static_nums),
+            donate_names=frozenset(donate_names),
+            donate_nums=frozenset(donate_nums),
+            statics_unknown=not (sn_known and si_known),
+            donates_unknown=not (dn_known and di_known),
+            line=getattr(jit_call_or_dec, "lineno", 0),
+        )
+
+    # -- constant resolution -----------------------------------------------
+    def _resolve_name_value(self, name: str, ctx: str) -> ast.expr | None:
+        """The single assigned value of ``name`` in ctx's scope chain
+        (function locals first, then module level); None when the name
+        is reassigned or never simply assigned."""
+        scopes = scope_chain(ctx)
+        for scope in scopes:
+            candidates = []
+            for node in ast.walk(self.mod.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if self.index.context_of(node) != scope:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == name:
+                        candidates.append(node.value)
+            if len(candidates) == 1:
+                return candidates[0]
+            if candidates:
+                return None  # ambiguous rebinding
+        return None
+
+    def _int_set(self, expr, ctx: str) -> tuple[set[int], bool]:
+        return self._const_set(expr, ctx, int)
+
+    def _str_set(self, expr, ctx: str) -> tuple[set[str], bool]:
+        return self._const_set(expr, ctx, str)
+
+    def _const_set(self, expr, ctx: str, typ) -> tuple[set, bool]:
+        """(values, known) — union over IfExp branches; (set(), False)
+        when any part is unresolvable."""
+        if expr is None:
+            return set(), True
+        if isinstance(expr, ast.Constant):
+            if expr.value is None:
+                return set(), True
+            if isinstance(expr.value, typ) and not isinstance(
+                expr.value, bool
+            ):
+                return {expr.value}, True
+            return set(), False
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            out: set = set()
+            for elt in expr.elts:
+                vals, known = self._const_set(elt, ctx, typ)
+                if not known:
+                    return set(), False
+                out |= vals
+            return out, True
+        if isinstance(expr, ast.IfExp):
+            a, ka = self._const_set(expr.body, ctx, typ)
+            b, kb = self._const_set(expr.orelse, ctx, typ)
+            return a | b, ka and kb
+        if isinstance(expr, ast.Name):
+            value = self._resolve_name_value(expr.id, ctx)
+            if value is not None:
+                return self._const_set(value, ctx, typ)
+        return set(), False
+
+
+def _jit_decorator(fn: ast.AST) -> ast.AST | None:
+    """The jit decorator node (bare name or Call), if present."""
+    for dec in getattr(fn, "decorator_list", ()):
+        if astutil.dotted_name(dec) in JIT_NAMES:
+            return dec
+        if isinstance(dec, ast.Call):
+            fname = astutil.dotted_name(dec.func)
+            if fname in JIT_NAMES:
+                return dec
+            if fname in ("partial", "functools.partial") and dec.args:
+                if astutil.dotted_name(dec.args[0]) in JIT_NAMES:
+                    return dec
+    return None
+
+
+def _jit_keywords(node: ast.AST) -> dict[str, ast.expr]:
+    """static_argnums/static_argnames/donate_* keyword exprs of a jit
+    decorator or call (bare ``@jax.jit`` has none)."""
+    if not isinstance(node, ast.Call):
+        return {}
+    return {
+        kw.arg: kw.value
+        for kw in node.keywords
+        if kw.arg
+        in (
+            "static_argnums", "static_argnames",
+            "donate_argnums", "donate_argnames",
+        )
+    }
+
+
+# -- scope-chain lookup ----------------------------------------------------
+
+
+def scope_chain(ctx: str) -> list[str]:
+    """``"a.b.c"`` -> ``["a.b.c", "a.b", "a", ""]``."""
+    out = [ctx]
+    while ctx:
+        ctx = ctx.rsplit(".", 1)[0] if "." in ctx else ""
+        out.append(ctx)
+    return out
+
+
+def lookup_scope_chain(table: dict, ctx: str, name: str):
+    """Resolve ``name`` referenced from scope ``ctx`` against a table
+    keyed either by ``(scope, name)`` or by qualified ``scope.name``."""
+    for scope in scope_chain(ctx):
+        if (scope, name) in table:
+            return table[(scope, name)]
+        qual = f"{scope}.{name}" if scope else name
+        if qual in table:
+            return table[qual]
+    return None
+
+
+# -- value taint -----------------------------------------------------------
+
+
+def expr_is_tainted(expr: ast.AST, tainted: set[str]) -> bool:
+    """Does ``expr``'s *value* depend on a traced name?
+
+    Shape reads kill taint: ``x.shape[0]``, ``len(x)``, ``x.ndim`` are
+    trace-time constants even when ``x`` is a tracer.
+    """
+    if isinstance(expr, ast.Attribute) and expr.attr in SHAPE_ATTRS:
+        return False
+    if isinstance(expr, ast.Call):
+        if astutil.dotted_name(expr.func) == "len":
+            return False
+        # a bare callee name is not a value read, but a method call's
+        # receiver is: x.sum() carries x's taint
+        receiver = (
+            (expr.func.value,)
+            if isinstance(expr.func, ast.Attribute)
+            else ()
+        )
+        return any(
+            expr_is_tainted(c, tainted)
+            for c in (
+                *receiver,
+                *expr.args,
+                *(kw.value for kw in expr.keywords),
+            )
+        )
+    if isinstance(expr, ast.Name):
+        return expr.id in tainted
+    if isinstance(
+        expr, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+               ast.ClassDef)
+    ):
+        return False
+    return any(
+        expr_is_tainted(c, tainted) for c in ast.iter_child_nodes(expr)
+    )
+
+
+def _target_names(target: ast.expr) -> list[str]:
+    return [
+        n.id
+        for n in ast.walk(target)
+        if isinstance(n, ast.Name)
+    ]
+
+
+def value_tainted_names(fn: ast.AST, static: set[str]) -> set[str]:
+    """Names that may carry traced values inside a jit function: the
+    non-static parameters, plus anything assigned (``=``, walrus, for
+    targets, comprehension variables) from a tainted expression.
+    Iterated to a fixpoint so out-of-order helper assignments converge.
+    """
+    tainted = all_param_names(fn) - set(static)
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn):
+            pairs: list[tuple[list[str], ast.AST]] = []
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                if node.value is None:
+                    continue
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    pairs.append((_target_names(t), node.value))
+            elif isinstance(node, ast.NamedExpr):
+                pairs.append((_target_names(node.target), node.value))
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                pairs.append((_target_names(node.target), node.iter))
+            elif isinstance(node, ast.comprehension):
+                pairs.append((_target_names(node.target), node.iter))
+            for names, value in pairs:
+                if not names or all(n in tainted for n in names):
+                    continue
+                if expr_is_tainted(value, tainted):
+                    tainted.update(names)
+                    changed = True
+    return tainted
+
+
+# -- shape-derived scalar detection ----------------------------------------
+
+_SCALAR_WRAPPERS = {"int", "float", "bool", "min", "max", "abs", "round", "len"}
+
+
+def scalar_shape_derived(expr: ast.AST) -> bool:
+    """True for expressions that *are* a Python scalar derived from an
+    array's shape: ``x.shape[0]``, ``len(x)``, ``x.ndim``, and
+    arithmetic / ``int()``/``min()``-style wrappers over those. An array
+    expression that merely *mentions* ``.shape`` (``x.reshape(x.shape[0],
+    -1)``) is not scalar-shape-derived."""
+    if isinstance(expr, ast.Subscript):
+        v = expr.value
+        return isinstance(v, ast.Attribute) and v.attr == "shape"
+    if isinstance(expr, ast.Attribute):
+        return expr.attr in ("ndim", "size")
+    if isinstance(expr, ast.Call):
+        name = astutil.dotted_name(expr.func)
+        if name == "len":
+            return True
+        if name in _SCALAR_WRAPPERS:
+            return any(scalar_shape_derived(a) for a in expr.args)
+        return False
+    if isinstance(expr, ast.BinOp):
+        return scalar_shape_derived(expr.left) or scalar_shape_derived(
+            expr.right
+        )
+    if isinstance(expr, ast.UnaryOp):
+        return scalar_shape_derived(expr.operand)
+    return False

@@ -54,6 +54,28 @@ RULES: dict[str, Rule] = {
             "un-fetched device arrays and pay the barrier in collect()",
         ),
         Rule(
+            "jit-retrace",
+            "jit compile-cache miss / retrace hazard",
+            "keep Python control flow off traced values (lax.cond/"
+            "lax.while_loop), declare trace-constant scalars in "
+            "static_argnums/static_argnames (bounded by bucketing), "
+            "and keep static args hashable and bounded",
+        ),
+        Rule(
+            "sharding-spec",
+            "PartitionSpec/mesh axis or spec-arity inconsistency",
+            "PartitionSpec axes must name a mesh axis; in_specs/"
+            "out_specs arity must match the mapped function; pass an "
+            "explicit NamedSharding to jax.device_put in mesh code",
+        ),
+        Rule(
+            "donation",
+            "donated buffer read after the jitted call",
+            "rebind the call's result to the donated name (x, y = "
+            "step(x, y)) or drop the argument from donate_argnums — "
+            "donation deletes the input buffer on device backends",
+        ),
+        Rule(
             "thread-lifecycle",
             "thread neither daemonized nor joined",
             "pass daemon=True (documenting the shutdown contract) or "

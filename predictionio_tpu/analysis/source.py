@@ -41,18 +41,29 @@ class SourceModule:
         self.line_suppressions: dict[int, set[str]] = {}
         self.file_suppressions: set[str] = set()
         self._index = None
+        self._jit_model = None
         self._parse_suppressions()
 
     def index(self):
         """Parent-stamped :class:`astutil.FunctionIndex` for this tree,
-        built once and shared by every checker (5 checkers × N files
-        would otherwise re-walk each AST five times)."""
+        built once and shared by every checker (8 checkers × N files
+        would otherwise re-walk each AST eight times)."""
         if self._index is None:
             from predictionio_tpu.analysis import astutil
 
             astutil.attach_parents(self.tree)
             self._index = astutil.FunctionIndex(self.tree)
         return self._index
+
+    def jit_model(self):
+        """Cached :class:`jaxast.JitModel` (jit bindings + static/
+        donate specs), shared by the device-sync, jit-retrace, and
+        donation checkers."""
+        if self._jit_model is None:
+            from predictionio_tpu.analysis import jaxast
+
+            self._jit_model = jaxast.JitModel(self, self.index())
+        return self._jit_model
 
     def _parse_suppressions(self) -> None:
         try:

@@ -326,12 +326,13 @@ def cmd_trace(args) -> int:
 
 
 def cmd_lint(args) -> int:
-    """AST-based concurrency & device-discipline analyzer
+    """AST-based concurrency & compilation-discipline analyzer
     (docs/static_analysis.md): lock-order cycles, blocking calls under
     locks, wall-clock misuse, implicit device syncs on the dispatch
-    path, thread lifecycle, telemetry hygiene. Pure stdlib — never
-    imports jax. Exit 0 = clean (baselined findings allowed), 1 = new
-    findings or unanalyzable files."""
+    path, jit retrace hazards, mesh/PartitionSpec hygiene, donated-
+    buffer reuse, thread lifecycle, telemetry hygiene. Pure stdlib —
+    never imports jax. Exit 0 = clean (baselined findings allowed),
+    1 = new findings or unanalyzable files."""
     from predictionio_tpu.analysis import render_baseline, run_lint
 
     paths = args.paths or ["predictionio_tpu", "scripts"]
@@ -343,8 +344,22 @@ def cmd_lint(args) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.write_baseline and args.changed is not None:
+        # a scoped run sees a slice of the findings — writing it back
+        # would silently delete every baseline entry outside the scope
+        print(
+            "error: --write-baseline requires a full-tree run "
+            "(drop --changed)",
+            file=sys.stderr,
+        )
+        return 2
     baseline_path = None if args.no_baseline else args.baseline
-    result = run_lint(paths, root=os.getcwd(), baseline_path=baseline_path)
+    result = run_lint(
+        paths,
+        root=os.getcwd(),
+        baseline_path=baseline_path,
+        changed_ref=args.changed,
+    )
 
     if args.write_baseline:
         for err in result.errors:
@@ -367,26 +382,43 @@ def cmd_lint(args) -> int:
         return 0
 
     if args.json:
-        print(json.dumps(
-            {
-                "filesChecked": result.files_checked,
-                "new": [f.to_dict() for f in result.new],
-                "baselined": [f.to_dict() for f in result.baselined],
-                "staleBaseline": [
-                    f"{e.rule}|{e.path}|{e.context}|{e.line}"
-                    for e in result.stale_baseline
-                ],
-                "errors": result.errors,
-                "ok": result.ok,
-            },
-            indent=2,
-        ))
+        payload = {
+            "filesChecked": result.files_checked,
+            "new": [f.to_dict() for f in result.new],
+            "baselined": [f.to_dict() for f in result.baselined],
+            "staleBaseline": [
+                f"{e.rule}|{e.path}|{e.context}|{e.line}"
+                for e in result.stale_baseline
+            ],
+            "errors": result.errors,
+            "ok": result.ok,
+            "timingsMs": result.timings_ms,
+            "totalMs": result.total_ms,
+        }
+        if result.scoped_to is not None:
+            payload["scopedTo"] = result.scoped_to
+        if result.notes:
+            payload["notes"] = result.notes
+        print(json.dumps(payload, indent=2))
         return 0 if result.ok else 1
 
-    for err in result.errors:
-        print(f"[ERROR] {err}", file=sys.stderr)
-    for f in result.new:
-        print(f.render())
+    for note in result.notes:
+        print(f"note: {note}", file=sys.stderr)
+    if args.format == "github":
+        # GitHub Actions workflow commands: findings render inline on
+        # the PR diff. One line per finding; no newlines allowed.
+        for err in result.errors:
+            print(f"::error title=pio-lint::{err}")
+        for f in result.new:
+            print(
+                f"::error file={f.path},line={f.line},col={f.col + 1},"
+                f"title=pio-lint {f.rule}::{f.message} — fix: {f.hint}"
+            )
+    else:
+        for err in result.errors:
+            print(f"[ERROR] {err}", file=sys.stderr)
+        for f in result.new:
+            print(f.render())
     if result.stale_baseline:
         print(
             f"note: {len(result.stale_baseline)} baseline entr"
@@ -401,10 +433,18 @@ def cmd_lint(args) -> int:
                 f"(baseline line {e.raw_line_no})",
                 file=sys.stderr,
             )
+    scope = ""
+    if result.scoped_to is not None:
+        scope = f", scoped to {len(result.scoped_to)} changed file(s)"
+    slowest = ""
+    if result.timings_ms:
+        name, ms = max(result.timings_ms.items(), key=lambda kv: kv[1])
+        slowest = f" (slowest checker: {name} {ms:.0f} ms)"
     summary = (
-        f"{result.files_checked} file(s) checked: "
+        f"{result.files_checked} file(s) checked{scope}: "
         f"{len(result.new)} new finding(s), "
-        f"{len(result.baselined)} baselined"
+        f"{len(result.baselined)} baselined "
+        f"in {result.total_ms:.0f} ms{slowest}"
     )
     print(summary)
     return 0 if result.ok else 1
@@ -1418,7 +1458,21 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--json", action="store_true",
-        help="machine-readable findings on stdout",
+        help="machine-readable findings on stdout (includes per-"
+             "checker timingsMs)",
+    )
+    p.add_argument(
+        "--changed", nargs="?", const="HEAD", default=None,
+        metavar="REF",
+        help="only report findings in files changed vs REF (default "
+             "HEAD, staged+unstaged+untracked); the full tree is still "
+             "analyzed so project-wide rules keep context. Falls back "
+             "to the full tree when git is unavailable",
+    )
+    p.add_argument(
+        "--format", choices=("text", "github"), default="text",
+        help="finding output format: 'github' emits GitHub Actions "
+             "::error workflow annotations (inline on the PR diff)",
     )
     p.set_defaults(func=cmd_lint)
 

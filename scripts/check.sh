@@ -15,15 +15,32 @@ cd "$(dirname "$0")/.."
 rc=0
 
 echo "== pio-tpu lint (static analysis gate, docs/static_analysis.md) =="
-# AST-based concurrency/device-discipline analyzer: lock-order cycles,
-# blocking-under-lock, wall-clock misuse, device syncs on the dispatch
-# path, thread lifecycle, telemetry hygiene. Pure stdlib (no jax), so
-# it runs first and fails fast; findings outside
-# scripts/lint_baseline.txt are NEW and block the gate.
+# AST-based concurrency/compilation-discipline analyzer: lock-order
+# cycles, blocking-under-lock, wall-clock misuse, device syncs on the
+# dispatch path, jit retrace hazards, mesh/PartitionSpec hygiene,
+# donated-buffer reuse, thread lifecycle, telemetry hygiene. Pure
+# stdlib (no jax), so it runs first and fails fast; findings outside
+# scripts/lint_baseline.txt are NEW and block the gate. On GitHub
+# Actions the findings double as ::error workflow annotations inline
+# on the PR diff (--format github).
+lint_fmt=()
+if [ -n "${GITHUB_ACTIONS:-}" ]; then
+    lint_fmt=(--format github)
+fi
+lint_start=$SECONDS
 if ! timeout -k 10 120 python -m predictionio_tpu.cli.main lint \
-    predictionio_tpu scripts; then
+    predictionio_tpu scripts ${lint_fmt[@]+"${lint_fmt[@]}"}; then
     echo "pio-tpu lint FAILED (new findings — fix, suppress with a"
     echo "reason, or accept via: pio-tpu lint --write-baseline)"
+    rc=1
+fi
+lint_dur=$((SECONDS - lint_start))
+# the rule set keeps growing; a lint gate that creeps past 30 s stops
+# being the "fails fast" first step (per-checker timingsMs is in
+# `pio-tpu lint --json` — find the regressing checker there)
+if [ "$lint_dur" -gt 30 ]; then
+    echo "pio-tpu lint exceeded the 30 s CI budget (${lint_dur}s) —"
+    echo "check timingsMs in: pio-tpu lint --json"
     rc=1
 fi
 

@@ -1,0 +1,319 @@
+"""Scoping edge cases in the lint analyzer's AST utilities
+(`analysis/astutil.py` + the shared `analysis/jaxast.py` machinery):
+walrus targets, lambda parameters, comprehension variables, and
+nested-class qualnames. These feed every checker's taint and identity
+logic — a wrong qualname misroutes a lock identity, a missed walrus
+target under-taints a jit body.
+
+Pure stdlib — no jax import anywhere on this path.
+"""
+
+from __future__ import annotations
+
+import ast
+import textwrap
+
+from predictionio_tpu.analysis import astutil, jaxast
+
+
+def build_index(src: str) -> tuple[ast.Module, astutil.FunctionIndex]:
+    tree = ast.parse(textwrap.dedent(src))
+    astutil.attach_parents(tree)
+    return tree, astutil.FunctionIndex(tree)
+
+
+def find_fn(index: astutil.FunctionIndex, qual: str):
+    assert qual in index.funcs, sorted(index.funcs)
+    return index.funcs[qual]
+
+
+# -- qualnames -------------------------------------------------------------
+
+
+class TestNestedQualnames:
+    SRC = """
+    class Outer:
+        class Inner:
+            def method(self):
+                pass
+
+        def outer_method(self):
+            def helper():
+                pass
+            return helper
+
+    def free():
+        def nested():
+            pass
+    """
+
+    def test_nested_class_method_qualname(self):
+        _, index = build_index(self.SRC)
+        assert "Outer.Inner.method" in index.funcs
+        assert index.owner_class["Outer.Inner.method"] == "Outer.Inner"
+
+    def test_nested_class_method_registry(self):
+        _, index = build_index(self.SRC)
+        assert "method" in index.class_methods["Outer.Inner"]
+        # the inner class's methods never leak onto the outer class
+        assert "method" not in index.class_methods["Outer"]
+
+    def test_function_nested_in_method(self):
+        _, index = build_index(self.SRC)
+        assert "Outer.outer_method.helper" in index.funcs
+        # a helper nested in a method closes over the method's `self`,
+        # so its owning class is still Outer — `self._lock` inside it
+        # must resolve to Outer's lock identity
+        assert index.owner_class["Outer.outer_method.helper"] == "Outer"
+        # but it is not a *method* of Outer (no bare-name dispatch)
+        assert "helper" not in index.class_methods["Outer"]
+
+    def test_function_nested_in_function(self):
+        _, index = build_index(self.SRC)
+        assert "free.nested" in index.funcs
+
+    def test_context_of_statement_in_nested_class_method(self):
+        tree, index = build_index(self.SRC)
+        method = find_fn(index, "Outer.Inner.method")
+        assert index.context_of(method.body[0]) == "Outer.Inner.method"
+
+
+class TestLambdaScoping:
+    def test_lambda_body_maps_to_enclosing_function(self):
+        """Lambdas are not indexed scopes: a node inside one belongs to
+        the enclosing def (the `put = lambda a: device_put(a, ...)`
+        pattern in ops/als.py must attribute findings to the def)."""
+        tree, index = build_index(
+            """
+            def stage(ctx):
+                put = lambda a: transfer(a, ctx)
+                return put
+            """
+        )
+        calls = [
+            n for n in ast.walk(tree) if isinstance(n, ast.Call)
+        ]
+        assert len(calls) == 1
+        assert index.context_of(calls[0]) == "stage"
+
+    def test_lambda_param_names(self):
+        tree, _ = build_index("f = lambda x, y, *rest, k=1: x")
+        lam = next(
+            n for n in ast.walk(tree) if isinstance(n, ast.Lambda)
+        )
+        assert jaxast.param_names(lam) == ("x", "y")
+        assert jaxast.all_param_names(lam) == {"x", "y", "rest", "k"}
+
+    def test_posonly_params_included_in_order(self):
+        tree, _ = build_index(
+            """
+            def f(a, b, /, c, *, d):
+                pass
+            """
+        )
+        fn = next(
+            n for n in ast.walk(tree)
+            if isinstance(n, ast.FunctionDef)
+        )
+        assert jaxast.param_names(fn) == ("a", "b", "c")
+        assert "d" in jaxast.all_param_names(fn)
+
+
+# -- statement walking -----------------------------------------------------
+
+
+class TestWalkStatements:
+    def test_does_not_descend_into_nested_defs(self):
+        tree, index = build_index(
+            """
+            def outer():
+                a = 1
+                def inner():
+                    b = 2
+                class K:
+                    c = 3
+                return a
+            """
+        )
+        outer = find_fn(index, "outer")
+        stmts = list(astutil.walk_statements(outer.body))
+        assigned = [
+            t.id
+            for s in stmts
+            if isinstance(s, ast.Assign)
+            for t in s.targets
+            if isinstance(t, ast.Name)
+        ]
+        assert assigned == ["a"]
+
+    def test_descends_into_try_handlers_once(self):
+        tree, index = build_index(
+            """
+            def f():
+                try:
+                    x = 1
+                except ValueError:
+                    y = 2
+                finally:
+                    z = 3
+            """
+        )
+        stmts = list(astutil.walk_statements(find_fn(index, "f").body))
+        assigns = [s for s in stmts if isinstance(s, ast.Assign)]
+        assert len(assigns) == 3
+
+
+# -- value taint (jaxast) --------------------------------------------------
+
+
+def taint_of(src: str, static: set[str] | None = None) -> set[str]:
+    tree = ast.parse(textwrap.dedent(src))
+    fn = next(
+        n for n in ast.walk(tree) if isinstance(n, ast.FunctionDef)
+    )
+    return jaxast.value_tainted_names(fn, static or set())
+
+
+class TestValueTaint:
+    def test_walrus_target_tainted(self):
+        tainted = taint_of(
+            """
+            def f(x):
+                out = compute(y := x * 2)
+                return out, y
+            """
+        )
+        assert "y" in tainted
+
+    def test_walrus_from_clean_value_not_tainted(self):
+        tainted = taint_of(
+            """
+            def f(x):
+                out = compute(n := 10)
+                return out, n
+            """
+        )
+        assert "n" not in tainted
+
+    def test_comprehension_variable_tainted_from_tainted_iter(self):
+        tainted = taint_of(
+            """
+            def f(xs):
+                out = [t * 2 for t in xs]
+                return out
+            """
+        )
+        assert "t" in tainted
+        assert "out" in tainted
+
+    def test_comprehension_over_clean_iter_not_tainted(self):
+        tainted = taint_of(
+            """
+            def f(x):
+                names = [s for s in ("a", "b")]
+                return names
+            """
+        )
+        assert "s" not in tainted
+        assert "names" not in tainted
+
+    def test_for_target_tainted(self):
+        tainted = taint_of(
+            """
+            def f(batches):
+                for item in batches:
+                    use(item)
+            """
+        )
+        assert "item" in tainted
+
+    def test_shape_read_kills_taint(self):
+        """x.shape / len(x) are trace-time constants even on tracers —
+        names derived from them must stay clean (fused_top_k_dot's
+        `b, k = queries.shape` block planning)."""
+        tainted = taint_of(
+            """
+            def f(x):
+                b, k = x.shape
+                n = len(x)
+                blocks = n // 128
+                return b, k, blocks
+            """
+        )
+        assert {"b", "k", "n", "blocks"} & tainted == set()
+
+    def test_static_params_excluded(self):
+        tainted = taint_of(
+            """
+            def f(x, n):
+                m = n + 1
+                return x, m
+            """,
+            static={"n"},
+        )
+        assert "x" in tainted
+        assert "n" not in tainted
+        assert "m" not in tainted
+
+    def test_fixpoint_converges_out_of_order(self):
+        """Taint flows through a name assigned before its source is
+        (re)assigned from a param — the fixpoint must converge."""
+        tainted = taint_of(
+            """
+            def f(x):
+                b = a if True else 0
+                a = x * 2
+                return b
+            """
+        )
+        assert "a" in tainted
+        assert "b" in tainted
+
+    def test_method_call_receiver_carries_taint(self):
+        tainted = taint_of(
+            """
+            def f(x):
+                total = x.sum()
+                return total
+            """
+        )
+        assert "total" in tainted
+
+
+class TestScalarShapeDerived:
+    def parse_expr(self, src: str) -> ast.expr:
+        return ast.parse(src, mode="eval").body
+
+    def test_shape_subscript_and_len(self):
+        assert jaxast.scalar_shape_derived(self.parse_expr("x.shape[0]"))
+        assert jaxast.scalar_shape_derived(self.parse_expr("len(xs)"))
+        assert jaxast.scalar_shape_derived(
+            self.parse_expr("min(num, items.shape[0])")
+        )
+        assert jaxast.scalar_shape_derived(
+            self.parse_expr("x.shape[0] // 2 + 1")
+        )
+
+    def test_array_expressions_are_not_scalar(self):
+        """Arrays that merely mention .shape are not scalar-derived —
+        `x.reshape(x.shape[0], -1)` is a traced array, flagging it
+        would be a false positive."""
+        assert not jaxast.scalar_shape_derived(
+            self.parse_expr("x.reshape(x.shape[0], -1)")
+        )
+        assert not jaxast.scalar_shape_derived(self.parse_expr("x + y"))
+        assert not jaxast.scalar_shape_derived(self.parse_expr("n"))
+
+
+class TestScopeChain:
+    def test_chain_order(self):
+        assert jaxast.scope_chain("a.b.c") == ["a.b.c", "a.b", "a", ""]
+        assert jaxast.scope_chain("") == [""]
+
+    def test_lookup_prefers_innermost(self):
+        table = {("", "f"): "module", ("outer", "f"): "local"}
+        assert jaxast.lookup_scope_chain(table, "outer.inner", "f") == (
+            "local"
+        )
+        assert jaxast.lookup_scope_chain(table, "other", "f") == "module"
+        assert jaxast.lookup_scope_chain(table, "outer", "g") is None
