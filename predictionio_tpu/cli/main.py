@@ -202,6 +202,68 @@ def _model_summary_line(data: dict) -> str | None:
     return " ".join(parts)
 
 
+def _fleet_summary_line(status: dict) -> str:
+    """One-line fleet summary from a router's GET / status payload:
+    replica count + health bands, serving generation, in-flight swap
+    phase, and autoscaler target vs actual — the scale-out companion
+    of the model-lifecycle line."""
+    replicas = status.get("replicas") or []
+    bands: dict[str, int] = {}
+    for r in replicas:
+        state = str(r.get("state", "?"))
+        bands[state] = bands.get(state, 0) + 1
+    band_str = " ".join(f"{k}={v}" for k, v in sorted(bands.items()))
+    parts = [
+        f"fleet: replicas={len(replicas)}"
+        + (f" ({band_str})" if band_str else "")
+    ]
+    generation = status.get("servingGeneration")
+    if generation:
+        parts.append(f"generation={generation}")
+    swaps = status.get("swaps") or {}
+    active = swaps.get("active") or []
+    if active:
+        parts.append(
+            "swap="
+            + ",".join(
+                f"{s.get('generation') or s.get('id')}:{s.get('phase')}"
+                for s in active
+            )
+        )
+    else:
+        parts.append("swap=none")
+    if isinstance(swaps.get("completedTotal"), int):
+        parts.append(f"swapsCompleted={swaps['completedTotal']}")
+    autoscaler = status.get("autoscaler")
+    if isinstance(autoscaler, dict):
+        healthy = bands.get("healthy", 0)
+        parts.append(
+            f"autoscaler={healthy}/{autoscaler.get('target')}"
+            f" [{autoscaler.get('min')}..{autoscaler.get('max')}]"
+        )
+    if status.get("stateFile"):
+        parts.append(f"stateFile=({status['stateFile']})")
+    return " ".join(parts)
+
+
+def _print_router_status(url: str, access_key: str = "") -> int:
+    """``status --router-url``: the fleet summary line from the
+    router's own status route, then its metrics scrape (which carries
+    the model-lifecycle line when the router exports those gauges)."""
+    status = _fetch_json(url.rstrip("/") + "/", access_key=access_key)
+    if status is None:
+        return 1
+    if not isinstance(status, dict) or status.get("service") != "router":
+        print(
+            f"[ERROR] {redact_keys(url)} is not a pio router "
+            "(GET / did not answer a router status payload)",
+            file=sys.stderr,
+        )
+        return 1
+    print(_fleet_summary_line(status))
+    return _print_metrics(url, access_key=access_key)
+
+
 def _print_metrics(url: str, access_key: str = "") -> int:
     """Scrape a live server's ``/metrics.json`` and print a per-metric
     one-liner (histograms with derived p50/p95/p99), led by a model-
@@ -244,6 +306,11 @@ def cmd_status(args) -> int:
     """Reference Console.status:1035-1107: verify storage + compute.
     With ``--metrics-url`` it instead scrapes a running server's
     telemetry registry (any server: engine, event, store, dashboard)."""
+    if getattr(args, "router_url", ""):
+        # fleet summary + metrics; pure HTTP like --metrics-url
+        return _print_router_status(
+            args.router_url, getattr(args, "access_key", "")
+        )
     if getattr(args, "metrics_url", ""):
         # pure HTTP — return before the storage/mesh imports below pull
         # in jax (seconds of startup, and a crash if the local
@@ -820,6 +887,9 @@ def cmd_trainer(args) -> int:
         full_every_s=args.full_every_s,
         checkpoint_dir=base_dir,
         checkpoint_every=args.checkpoint_every,
+        router_url=args.router_url,
+        router_key=args.router_key,
+        promote_timeout_s=args.promote_timeout,
     )
     os.makedirs(base_dir, exist_ok=True)
     # pid marker: what a supervisor-external chaos driver (or operator)
@@ -877,8 +947,13 @@ def cmd_router(args) -> int:
     """Scale-out front tier: least-inflight + consistent-hash dispatch
     across N engine replicas, health-probed via their /healthz +
     warmup gauges, with breaker-guarded single-retry failover and
-    rolling generation swaps (docs/scale_out.md). Pure HTTP — never
-    imports jax; the replicas own the devices."""
+    rolling generation swaps (docs/scale_out.md). With --state-file
+    the replica set and in-flight swaps survive a router crash; with
+    --fleet-gate swaps shadow-score live traffic before promoting; with
+    --spawn-replica an autoscaler grows/shrinks the pool from overload
+    signals. Pure HTTP — never imports jax; the replicas own the
+    devices."""
+    from predictionio_tpu.serving import canary as canary_mod
     from predictionio_tpu.serving.config import ServerConfig
     from predictionio_tpu.serving.router import create_router
 
@@ -903,11 +978,59 @@ def cmd_router(args) -> int:
         failover_retries=args.failover_retries,
         proxy_timeout_s=args.proxy_timeout,
         server_config=config,
+        state_path=args.state_file,
+        state_max_age_s=args.state_max_age,
+        gate_config=(
+            canary_mod.CanaryConfig.from_env()
+            if args.fleet_gate
+            else None
+        ),
     )
+    autoscaler = None
+    if args.spawn_replica:
+        import shlex
+
+        from predictionio_tpu.serving.autoscaler import (
+            AutoscalerConfig,
+            ReplicaAutoscaler,
+            ReplicaSpawner,
+        )
+
+        scale_cfg = AutoscalerConfig.from_env()
+        if args.min_replicas:
+            scale_cfg = dataclasses.replace(
+                scale_cfg, min_replicas=args.min_replicas
+            )
+        if args.max_replicas:
+            scale_cfg = dataclasses.replace(
+                scale_cfg, max_replicas=args.max_replicas
+            )
+        if scale_cfg.max_replicas < scale_cfg.min_replicas:
+            # a floor above the ceiling (e.g. --min-replicas over the
+            # env/default max) silently pins the pool below the floor
+            scale_cfg = dataclasses.replace(
+                scale_cfg, max_replicas=scale_cfg.min_replicas
+            )
+        autoscaler = ReplicaAutoscaler(
+            _router,
+            ReplicaSpawner(shlex.split(args.spawn_replica)),
+            config=scale_cfg,
+        ).start()
+        print(
+            f"Autoscaler reconciling {scale_cfg.min_replicas}.."
+            f"{scale_cfg.max_replicas} replicas via: "
+            f"{args.spawn_replica}"
+        )
     print(f"Router is listening on {args.ip}:{http.port}")
     if args.replica:
         print(f"Routing across {len(args.replica)} replica(s)")
-    return _serve_foreground(http)
+    if args.state_file:
+        print(f"Fleet state persisted to {args.state_file}")
+    try:
+        return _serve_foreground(http)
+    finally:
+        if autoscaler is not None:
+            autoscaler.close()
 
 
 def cmd_undeploy(args) -> int:
@@ -1411,6 +1534,12 @@ def build_parser() -> argparse.ArgumentParser:
              "checking local storage/compute",
     )
     p.add_argument(
+        "--router-url", dest="router_url", default="",
+        help="summarize a running router's fleet (replica health "
+             "bands, serving generation, in-flight swap phase, "
+             "autoscaler target vs actual) and scrape its metrics",
+    )
+    p.add_argument(
         "--access-key", dest="access_key", default="",
         help="server access key for key-authed scrape targets "
              "(sent as the X-PIO-Server-Key header)",
@@ -1662,6 +1791,24 @@ def build_parser() -> argparse.ArgumentParser:
     )
     _checkpoint_args(p)
     p.add_argument(
+        "--router-url", dest="router_url", default="",
+        help="drive this router's POST /admin/swap after every "
+             "published generation: publish → canary → fleet promotion "
+             "as one pipeline with one fleet-level shadow gate "
+             "(docs/scale_out.md); the swap token is the generation id, "
+             "so a respawned trainer never double-drives a swap",
+    )
+    p.add_argument(
+        "--router-key", dest="router_key", default="",
+        help="X-PIO-Server-Key for the router's /admin/* routes",
+    )
+    p.add_argument(
+        "--promote-timeout", dest="promote_timeout", type=float,
+        default=600.0,
+        help="seconds to wait for one fleet promotion (warm + shadow "
+             "gate + roll + regression watch) before giving up polling",
+    )
+    p.add_argument(
         "--metrics-port", dest="metrics_port", type=int, default=0,
         help="serve /metrics + /metrics.json + /healthz on this port "
              "(0 = no metrics server)",
@@ -1703,6 +1850,42 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--admin-key", dest="admin_key", default="",
         help="require this key on /admin/* (register/retire/swap)",
+    )
+    p.add_argument(
+        "--state-file", dest="state_file", default="",
+        help="persist the replica set + in-flight swap state here "
+             "(atomic write + checksum); re-adopted on restart so a "
+             "router killed mid-swap resumes or safely aborts",
+    )
+    p.add_argument(
+        "--state-max-age", dest="state_max_age", type=float,
+        default=300.0,
+        help="discard (loudly) a state file older than this many "
+             "seconds instead of trusting a stale fleet picture",
+    )
+    p.add_argument(
+        "--fleet-gate", dest="fleet_gate", action="store_true",
+        help="gate every swap behind fleet-level shadow scoring: "
+             "mirror sampled live traffic to the staged replica, "
+             "promote only on a clean divergence/NaN gate, watch for "
+             "post-promotion regressions and auto-roll the fleet back "
+             "(PIO_CANARY_* env tunes the gate; docs/scale_out.md)",
+    )
+    p.add_argument(
+        "--spawn-replica", dest="spawn_replica", default="",
+        help="replica launch command template with {port} and "
+             "{generation} placeholders; enables the autoscaler and "
+             "lets trainer-driven swaps stage candidates without a "
+             "url (e.g. 'pio-tpu deploy --variant e.json --port "
+             "{port}')",
+    )
+    p.add_argument(
+        "--min-replicas", dest="min_replicas", type=int, default=0,
+        help="autoscaler floor (default PIO_AUTOSCALE_MIN or 1)",
+    )
+    p.add_argument(
+        "--max-replicas", dest="max_replicas", type=int, default=0,
+        help="autoscaler ceiling (default PIO_AUTOSCALE_MAX or 4)",
     )
     p.set_defaults(func=cmd_router)
 

@@ -177,6 +177,25 @@ def divergence(old: Any, new: Any) -> float:
     return sum(diffs) / len(diffs) if diffs else 0.0
 
 
+#: keys that identify WHICH process/generation answered, not WHAT the
+#: model predicted — the fleet gate compares predictions from two
+#: different replica processes, so these must not score as divergence
+#: (the per-replica canary's ``prId`` strip is the same idea: only
+#: model-comparable content enters the gate)
+VOLATILE_PREDICTION_KEYS = frozenset({"prId", "pid", "generation"})
+
+
+def strip_volatile(
+    prediction: Any, keys: frozenset[str] = VOLATILE_PREDICTION_KEYS
+) -> Any:
+    """Drop provenance keys from a dict-shaped prediction before it
+    enters a divergence comparison. Non-dict predictions pass through
+    untouched — the gate scores them whole."""
+    if isinstance(prediction, dict):
+        return {k: v for k, v in prediction.items() if k not in keys}
+    return prediction
+
+
 # --------------------------------------------------------------------------
 # The canary state machine
 # --------------------------------------------------------------------------
@@ -282,7 +301,14 @@ class ShadowCanary:
                     )
                 self._seen_requests += 1
                 n, s = self._seen_requests, self._config.shadow_sample
-                sampled = ok and int(n * s) > int((n - 1) * s)
+                # divergence needs BOTH sides: a served request with no
+                # comparable prediction (e.g. a 4xx answered upstream of
+                # the model) may feed the baseline but never the sampler
+                sampled = (
+                    ok
+                    and prediction is not None
+                    and int(n * s) > int((n - 1) * s)
+                )
             elif state == WATCHING:
                 self._watch_requests += 1
                 self._watch_latency_sum += elapsed_s

@@ -39,6 +39,19 @@ requests finish, and locally-supervised replicas (registered with a
 requests are dropped; ``scripts/router_smoke.py`` proves it under
 replica SIGKILL chaos.
 
+Fleet control plane (docs/scale_out.md "Fleet promotion"): with a
+``state_path`` the replica set and every in-flight swap live in a
+checksummed, atomically-written state file, re-adopted on restart — a
+router killed -9 mid-swap resumes the roll (or safely aborts to the
+old generation) instead of forgetting its fleet. Swaps are idempotent
+when keyed with a ``token``: re-driving the same token (a respawned
+trainer) returns the existing record, so the fleet-level shadow gate
+fires exactly once per generation. With a ``gate_config`` the swap
+mirrors a deterministic sample of live traffic to the staged replica
+and applies the PR 9 divergence/NaN gate FLEET-wide before any old
+replica drains; after promotion one old replica is parked as a standby
+under a regression watch, and a regression rolls the whole fleet back.
+
 Metrics (docs/scale_out.md): ``pio_router_replica_healthy{replica}``,
 ``pio_router_inflight{replica}``, ``pio_router_failovers_total``,
 ``pio_router_requests_total{replica,status}``,
@@ -48,6 +61,7 @@ Metrics (docs/scale_out.md): ``pio_router_replica_healthy{replica}``,
 from __future__ import annotations
 
 import bisect
+import datetime as _dt
 import hashlib
 import json
 import logging
@@ -64,6 +78,7 @@ from predictionio_tpu.obs import MetricRegistry, get_registry
 from predictionio_tpu.obs import tracing
 from predictionio_tpu.obs.context import log_json
 from predictionio_tpu.serving import admission, resilience
+from predictionio_tpu.serving import canary as canary_mod
 from predictionio_tpu.serving.http import (
     HTTPError,
     HTTPServer,
@@ -100,6 +115,93 @@ def _hash64(data: bytes) -> int:
     return int.from_bytes(hashlib.sha1(data).digest()[:8], "big")
 
 
+#: completed (terminal-phase) swap records kept for GET /admin/swap/<id>
+#: — a long-lived router behind a continuous trainer completes a swap
+#: per generation, so the history must be bounded (in-flight swaps are
+#: never garbage-collected)
+_SWAP_HISTORY_KEEP = 20
+
+#: swap phases. Ungated swaps keep the original warming → draining-old
+#: → done | failed sequence; gated (fleet-promotion) swaps run the full
+#: machine below.
+SWAP_TERMINAL_PHASES = ("done", "failed", "rolled_back")
+
+
+class RouterStateStore:
+    """Checksummed, atomically-written router state (docs/scale_out.md
+    "Fleet promotion"). One JSON document: the schema tag, a UTC save
+    stamp, the payload, and a SHA-256 over the payload's canonical
+    encoding. A router restarting re-adopts the payload ONLY when the
+    checksum verifies and the stamp is younger than ``max_age_s`` — a
+    stale or torn file is discarded LOUDLY (warning log + a note the
+    status route serves), never silently trusted: the world it
+    describes may be long gone."""
+
+    SCHEMA = "pio-router-state/v1"
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def save(self, payload: dict) -> None:
+        from predictionio_tpu.data.storage.localfs import (
+            atomic_write_bytes,
+        )
+
+        # serialize ONCE and embed the parsed copy: checksumming one
+        # encoding of the payload while writing a second would let any
+        # concurrent mutation of a shared nested object produce a file
+        # that fails its own checksum — and get discarded as torn on
+        # the restart the file exists to protect
+        body = json.dumps(payload, sort_keys=True)
+        doc = {
+            "schema": self.SCHEMA,
+            "savedAtUtc": _dt.datetime.now(
+                _dt.timezone.utc
+            ).isoformat(timespec="seconds"),
+            "checksum": hashlib.sha256(body.encode()).hexdigest(),
+            "payload": json.loads(body),
+        }
+        atomic_write_bytes(
+            self.path, json.dumps(doc, indent=1).encode()
+        )
+
+    def load(self, max_age_s: float) -> tuple[dict | None, str]:
+        """(payload, discard_reason). A missing file is a quiet cold
+        start (payload None, reason ""); anything unreadable, torn, or
+        stale returns (None, <loud reason>)."""
+        try:
+            with open(self.path) as f:
+                doc = json.load(f)
+        except FileNotFoundError:
+            return None, ""
+        except (OSError, ValueError) as e:
+            return None, f"state file unreadable: {e}"
+        if not isinstance(doc, dict) or doc.get("schema") != self.SCHEMA:
+            return None, "state file has an unknown schema"
+        payload = doc.get("payload")
+        body = json.dumps(payload, sort_keys=True)
+        if (
+            hashlib.sha256(body.encode()).hexdigest()
+            != doc.get("checksum")
+        ):
+            return None, "state file checksum mismatch (torn write?)"
+        try:
+            saved = _dt.datetime.fromisoformat(str(doc.get("savedAtUtc")))
+            age_s = (
+                _dt.datetime.now(_dt.timezone.utc) - saved
+            ).total_seconds()
+        except (TypeError, ValueError):
+            return None, "state file save stamp unreadable"
+        if age_s > max_age_s:
+            return None, (
+                f"state file is {age_s:.0f}s old (> {max_age_s:.0f}s "
+                "adoption window); the fleet it describes may be gone"
+            )
+        if not isinstance(payload, dict):
+            return None, "state payload is not an object"
+        return payload, ""
+
+
 class Replica:
     """One engine-server replica the router knows about."""
 
@@ -119,6 +221,11 @@ class Replica:
         #: during a rolling swap so its own graceful drain runs
         self.pid = pid
         self.state = WARMING
+        #: a fleet-gated swap registers its candidate STAGED: it warms
+        #: and probes like any replica but is excluded from selection
+        #: until the shadow gate promotes it — live traffic must not
+        #: land on an unproven generation
+        self.staged = False
         #: set by an admin retire/swap: the drain is STICKY — probes
         #: must not readmit this replica even while its process still
         #: answers ok (the router, not the replica, decided to drain)
@@ -183,6 +290,7 @@ class Replica:
             "url": self.url,
             "generation": self.generation,
             "state": self.state,
+            "staged": self.staged,
             "inflight": self.inflight,
             "breaker": self.breaker.state,
             "saturated": self.saturated,
@@ -227,6 +335,11 @@ class ServingRouter:
         tracer: tracing.Tracer | None = None,
         server_config=None,
         breaker_config: resilience.BreakerConfig | None = None,
+        state_path: str = "",
+        state_max_age_s: float = 300.0,
+        gate_config: "canary_mod.CanaryConfig | None" = None,
+        gate_timeout_s: float = 120.0,
+        watch_timeout_s: float | None = None,
     ):
         self._registry = registry if registry is not None else get_registry()
         self._tracer = tracer if tracer is not None else tracing.get_tracer()
@@ -242,6 +355,16 @@ class ServingRouter:
         self._failover_retries = max(0, failover_retries)
         self._proxy_timeout_s = proxy_timeout_s
         self._drain_poll_s = drain_poll_s
+        self._gate_config = gate_config
+        self._gate_timeout_s = gate_timeout_s
+        self._watch_timeout_s = (
+            watch_timeout_s
+            if watch_timeout_s is not None
+            else max(
+                30.0,
+                3.0 * (gate_config.watch_s if gate_config else 10.0),
+            )
+        )
 
         self._lock = threading.Lock()
         self._replicas: dict[str, Replica] = {}
@@ -249,6 +372,30 @@ class ServingRouter:
         #: tied-id tuple -> (sorted vnode points, matching replica ids)
         self._ring_cache: dict[tuple, tuple[list, list]] = {}
         self._swaps: dict[str, dict] = {}
+        #: idempotency: token -> swap id; re-driving a token returns
+        #: the existing record instead of starting a second swap
+        self._swap_tokens: dict[str, str] = {}
+        self._swaps_completed_total = 0
+        self._serving_generation = ""
+        #: the active fleet shadow gate (at most one swap holds it)
+        self._fleet_gate: canary_mod.ShadowCanary | None = None
+        #: replica factory registered by the autoscaler:
+        #: ``spawn(generation, staged) -> Replica`` (already
+        #: installed); lets a trainer-driven swap stage a candidate
+        #: without providing a URL
+        self._spawner: Callable[[str, bool], Replica] | None = None
+        #: status callback registered by the autoscaler
+        self._autoscaler_status: Callable[[], dict] | None = None
+        #: plain-int mirror of pio_router_shed_total for the autoscaler
+        #: (reading back one registry counter per tick is noise)
+        self._shed_count = 0
+        self._state_store = (
+            RouterStateStore(state_path) if state_path else None
+        )
+        self._state_max_age_s = state_max_age_s
+        self._state_note = ""
+        self._state_saved_monotonic = time.monotonic()
+        self._resume_swaps: list[dict] = []
         self._closed = threading.Event()
         # startTime is a display epoch; uptime must come from the
         # monotonic clock — an NTP step would otherwise make uptimeSec
@@ -291,6 +438,7 @@ class ServingRouter:
 
         for replica in replicas:
             self._install(replica)
+        self._adopt_state()
 
         self.router = Router()
         self.router.route("GET", "/", self._status)
@@ -312,6 +460,212 @@ class ServingRouter:
             target=self._probe_loop, name="pio-router-probe", daemon=True
         )
         self._prober.start()
+        for record in self._resume_swaps:
+            threading.Thread(
+                target=self._resume_swap,
+                args=(record,),
+                name=f"pio-router-resume-{record['id']}",
+                daemon=True,
+            ).start()
+        self._resume_swaps = []
+
+    # -- durable fleet state -----------------------------------------------
+    def _persist_state(self) -> None:
+        """Snapshot the replica set + swap state under the lock, write
+        outside it (atomic + checksummed). Called after every
+        membership or swap-phase transition; a no-op without a
+        ``state_path``."""
+        if self._state_store is None:
+            return
+        with self._lock:
+            payload = {
+                "servingGeneration": self._serving_generation,
+                "replicas": [
+                    {
+                        "id": r.replica_id,
+                        "url": r.url,
+                        "generation": r.generation,
+                        "pid": r.pid,
+                        "staged": r.staged,
+                        "parked": r.admin_draining,
+                    }
+                    for r in self._replicas.values()
+                    if r.state != RETIRED
+                ],
+                # deep copies: a shallow dict(s) would share nested
+                # objects (record["retired"], record["gate"]) with the
+                # live swap threads, which mutate them after this lock
+                # is released
+                "swaps": [
+                    json.loads(json.dumps(s))
+                    for s in self._swaps.values()
+                ],
+                "swapsCompletedTotal": self._swaps_completed_total,
+            }
+        try:
+            self._state_store.save(payload)
+            self._state_saved_monotonic = time.monotonic()
+        except OSError as e:
+            # persistence must never take the serving path down; the
+            # next transition retries
+            logger.warning("cannot persist router state: %s", e)
+
+    def _adopt_state(self) -> None:
+        """Re-adopt the persisted fleet on restart. Replicas re-enter
+        WARMING and must re-prove themselves through the normal
+        healthz+warmup gate; non-terminal swaps are queued for
+        :meth:`_resume_swap` (which resumes the roll — or safely aborts
+        to the old generation — once the prober is running)."""
+        if self._state_store is None:
+            return
+        payload, reason = self._state_store.load(self._state_max_age_s)
+        if payload is None:
+            if reason:
+                self._state_note = f"discarded: {reason}"
+                log_json(
+                    logger, logging.WARNING, "router_state_discarded",
+                    path=self._state_store.path, reason=reason,
+                )
+            return
+        adopted = 0
+        for entry in payload.get("replicas", ()):
+            if not isinstance(entry, dict) or not entry.get("url"):
+                continue
+            rid = str(entry.get("id") or f"r-{uuid.uuid4().hex[:8]}")
+            if rid in self._replicas:
+                continue
+            replica = Replica(
+                rid,
+                str(entry["url"]),
+                generation=str(entry.get("generation", "")),
+                pid=entry.get("pid"),
+                registry=self._registry,
+                breaker_config=self._breaker_config,
+            )
+            replica.staged = bool(entry.get("staged"))
+            replica.admin_draining = bool(entry.get("parked"))
+            if replica.admin_draining:
+                replica.state = DRAINING
+            self._install(replica)
+            adopted += 1
+        self._serving_generation = str(
+            payload.get("servingGeneration", "")
+        )
+        for record in payload.get("swaps", ()):
+            if not isinstance(record, dict) or not record.get("id"):
+                continue
+            self._swaps[record["id"]] = record
+            if record.get("token"):
+                self._swap_tokens[record["token"]] = record["id"]
+            if record.get("phase") not in SWAP_TERMINAL_PHASES:
+                self._resume_swaps.append(record)
+        # the lifetime counter survives the restart with the records
+        # (older state files without the field: the kept terminal
+        # records are the best lower bound)
+        self._swaps_completed_total = max(
+            int(payload.get("swapsCompletedTotal", 0) or 0),
+            sum(
+                1
+                for s in self._swaps.values()
+                if s.get("phase") in SWAP_TERMINAL_PHASES
+            ),
+        )
+        self._state_note = (
+            f"adopted {adopted} replica(s)"
+            + (
+                f", resuming {len(self._resume_swaps)} swap(s)"
+                if self._resume_swaps
+                else ""
+            )
+        )
+        log_json(
+            logger, logging.INFO, "router_state_adopted",
+            path=self._state_store.path, replicas=adopted,
+            swaps=len(self._resume_swaps),
+            generation=self._serving_generation,
+        )
+
+    def _serving_generation_locked(self) -> str:
+        """Caller holds ``self._lock``."""
+        if self._serving_generation:
+            return self._serving_generation
+        gens = {
+            r.generation
+            for r in self._replicas.values()
+            if r.generation and not r.staged
+        }
+        return gens.pop() if len(gens) == 1 else ""
+
+    @property
+    def serving_generation(self) -> str:
+        """The generation the fleet is serving: explicitly tracked by
+        fleet swaps, else inferred from the active pool."""
+        with self._lock:
+            return self._serving_generation_locked()
+
+    def attach_spawner(
+        self, spawn: Callable[[str, bool], Replica]
+    ) -> None:
+        """Register the autoscaler's replica factory so swaps can stage
+        a candidate generation without an operator-provided URL."""
+        self._spawner = spawn
+
+    def attach_autoscaler_status(self, fn: Callable[[], dict]) -> None:
+        self._autoscaler_status = fn
+
+    def autoscaler_signals(self) -> dict:
+        """The signal bundle the replica autoscaler reconciles on —
+        nothing the stack does not already export."""
+        with self._lock:
+            pool = [
+                r for r in self._replicas.values() if r.state != RETIRED
+            ]
+            healthy = [
+                r
+                for r in pool
+                if r.state == HEALTHY and not r.staged
+            ]
+            swap_active = any(
+                s.get("phase") not in SWAP_TERMINAL_PHASES
+                for s in self._swaps.values()
+            )
+            return {
+                "healthy": len(healthy),
+                "warming": sum(
+                    1 for r in pool if r.state == WARMING and not r.staged
+                ),
+                "draining": sum(
+                    1 for r in pool if r.state == DRAINING
+                ),
+                "unhealthy": sum(
+                    1 for r in pool if r.state == UNHEALTHY
+                ),
+                "inflight": sum(r.inflight for r in healthy),
+                "saturated": sum(1 for r in healthy if r.saturated),
+                "shedTotal": self._shed_count,
+                "swapActive": swap_active,
+                # the INFERRED generation: a fleet that never ran a
+                # gated swap has no explicit one, and the autoscaler
+                # substitutes this into the spawn template — "" would
+                # launch replicas with the wrong/default model
+                "servingGeneration": self._serving_generation_locked(),
+                # mixed-generation pool with no explicit serving
+                # generation (an ungated roll in flight): "" above is
+                # "no single answer", not "no generation" — the
+                # autoscaler must defer growth instead of spawning a
+                # default-model replica into live selection
+                "generationAmbiguous": (
+                    not self._serving_generation
+                    and len(
+                        {
+                            r.generation
+                            for r in pool
+                            if r.generation and not r.staged
+                        }
+                    )
+                    > 1
+                ),
+            }
 
     # -- replica registry --------------------------------------------------
     def _install(self, replica: Replica) -> None:
@@ -337,10 +691,13 @@ class ServingRouter:
         replica_id: str | None = None,
         generation: str = "",
         pid: int | None = None,
+        staged: bool = False,
     ) -> Replica:
         """Register a replica; it enters the pool WARMING and is
         admitted by the probe loop once its ``/healthz`` answers ok and
-        its ``pio_warmup_complete`` gauge (when exported) reads 1."""
+        its ``pio_warmup_complete`` gauge (when exported) reads 1.
+        ``staged=True`` keeps it OUT of selection even once healthy —
+        a fleet-gated swap candidate takes mirrored traffic only."""
         replica = Replica(
             replica_id or f"r-{uuid.uuid4().hex[:8]}",
             url,
@@ -349,8 +706,58 @@ class ServingRouter:
             registry=self._registry,
             breaker_config=self._breaker_config,
         )
+        replica.staged = staged
         self._install(replica)
+        self._persist_state()
         return replica
+
+    def update_replica_pid(self, replica_id: str, pid: int | None) -> bool:
+        """Point an existing entry at a respawned process (the
+        autoscaler respawns a crashed replica on its original port; the
+        registration survives, only the pid changes)."""
+        with self._lock:
+            replica = self._replicas.get(replica_id)
+            if replica is None:
+                return False
+            replica.pid = pid
+        self._persist_state()
+        return True
+
+    def park(self, replica_id: str) -> bool:
+        """Drain a replica out of selection WITHOUT retiring it: the
+        sticky admin drain applies (probes cannot readmit it) but its
+        process is left running. The fleet swap parks one old-generation
+        replica as the rollback standby until the regression watch
+        clears."""
+        with self._lock:
+            replica = self._replicas.get(replica_id)
+            if replica is None:
+                return False
+            replica.admin_draining = True
+            if replica.state != RETIRED:
+                replica.state = DRAINING
+        self._healthy_gauge.labels(replica_id).set(0)
+        log_json(
+            logger, logging.INFO, "router_replica_parked",
+            replica=replica_id,
+        )
+        self._persist_state()
+        return True
+
+    def unpark(self, replica_id: str) -> bool:
+        """Lift a parked replica's sticky drain; the probe loop
+        readmits it through the normal healthz+warmup gate."""
+        with self._lock:
+            replica = self._replicas.get(replica_id)
+            if replica is None:
+                return False
+            replica.admin_draining = False
+        log_json(
+            logger, logging.INFO, "router_replica_unparked",
+            replica=replica_id,
+        )
+        self._persist_state()
+        return True
 
     def retire(
         self,
@@ -406,6 +813,7 @@ class ServingRouter:
                 logger, logging.INFO, "router_replica_retired",
                 replica=replica_id,
             )
+            self._persist_state()
 
         if wait:
             _finish()
@@ -435,6 +843,17 @@ class ServingRouter:
                     logger.exception(
                         "probe crashed for %s", replica.replica_id
                     )
+            # keep the state file's save stamp fresh on a QUIET fleet:
+            # membership/swap transitions are the only other writers,
+            # so hours of steady-state serving would otherwise age the
+            # file past the adoption window and a restart would discard
+            # a perfectly live fleet as stale
+            if (
+                self._state_store is not None
+                and time.monotonic() - self._state_saved_monotonic
+                > min(60.0, self._state_max_age_s / 3.0)
+            ):
+                self._persist_state()
 
     def _fetch_json(self, url: str):
         with urllib.request.urlopen(
@@ -538,7 +957,9 @@ class ServingRouter:
             pool = [
                 r
                 for r in self._replicas.values()
-                if r.state == HEALTHY and r.replica_id not in exclude
+                if r.state == HEALTHY
+                and not r.staged
+                and r.replica_id not in exclude
             ]
         if not pool:
             return []
@@ -632,6 +1053,7 @@ class ServingRouter:
         )
 
     def _proxy(self, request: Request) -> Response:
+        t0 = time.perf_counter()
         deadline = resilience.get_deadline()
         affinity_key = self._affinity_key(request)
         tried: set[str] = set()
@@ -652,10 +1074,11 @@ class ServingRouter:
                 healthy = [
                     r
                     for r in self._replicas.values()
-                    if r.state == HEALTHY
+                    if r.state == HEALTHY and not r.staged
                 ]
             if healthy and all(r.saturated for r in healthy):
                 self._shed_total.inc()
+                self._shed_count += 1
                 return Response(
                     503,
                     {
@@ -712,6 +1135,9 @@ class ServingRouter:
             finally:
                 replica.end()
             if isinstance(outcome, Response):
+                self._fleet_observe(
+                    request, outcome, time.perf_counter() - t0
+                )
                 return outcome
             # failover-eligible: transport error, retryable 5xx, or a
             # saturation shed (kind distinguishes them — a request that
@@ -730,6 +1156,7 @@ class ServingRouter:
                 # hint. Queries are reads — the replicas' sheds did no
                 # work — so the relay is marked replay-safe too.
                 self._shed_total.inc()
+                self._shed_count += 1
                 return Response(
                     503,
                     {
@@ -742,7 +1169,10 @@ class ServingRouter:
                     },
                 )
             # a real failure somewhere — a gateway error the client
-            # may retry (the replicas themselves stayed consistent)
+            # may retry (the replicas themselves stayed consistent).
+            # This is fleet-level evidence: a 502 storm right after a
+            # promotion is exactly what the regression watch exists for
+            self._fleet_observe(request, None, time.perf_counter() - t0)
             raise HTTPError(502, f"all routed replicas failed: {last_failure}")
         states = set(self.replica_states().values())
         if states and states <= {DRAINING, RETIRED}:
@@ -865,114 +1295,651 @@ class ServingRouter:
         replica.breaker.record_success()
         return Response(status, body, content_type=resp_ctype)
 
-    # -- rolling swap ------------------------------------------------------
+    # -- rolling swap / fleet promotion ------------------------------------
     def rolling_swap(
         self,
-        url: str,
-        generation: str,
+        url: str | None = None,
+        generation: str = "",
         replica_id: str | None = None,
         pid: int | None = None,
         retire: str | list[str] = "others",
         warm_timeout_s: float = 120.0,
         wait: bool = False,
+        token: str = "",
     ) -> dict:
         """Roll the pool to a new model generation without dropping a
-        request: register ``url`` WARMING, admit it once healthy AND
-        warm (``pio_warmup_complete=1``), then drain the old replicas
-        (``retire="others"`` = every active replica of a different
-        generation; or an explicit id list). Runs in the background
-        unless ``wait=True``; progress lands in the returned record
-        (also served at ``GET /admin/swap/<id>``)."""
-        new_replica = self.add_replica(
-            url, replica_id=replica_id, generation=generation, pid=pid
-        )
+        request. Register ``url`` WARMING (or, with ``url=None``, spawn
+        a candidate through the attached autoscaler spawner), admit it
+        once healthy AND warm (``pio_warmup_complete=1``), then — with
+        a ``gate_config`` — shadow-score a deterministic sample of live
+        traffic on it and only on a clean fleet gate drain the old
+        replicas one at a time, parking one as the rollback standby for
+        the post-promotion regression watch. Without a gate the
+        original warming → draining-old → done sequence runs.
+
+        ``token`` makes the operation idempotent: a token already seen
+        (a respawned trainer re-driving the same generation) returns
+        the existing record — the gate fires exactly once per
+        generation. Runs in the background unless ``wait=True``;
+        progress lands in the returned record (also served at
+        ``GET /admin/swap/<id>``)."""
         swap_id = f"swap-{uuid.uuid4().hex[:8]}"
+        if token:
+            # check-and-reserve atomically: two concurrent drives of
+            # the same token (trainer respawn racing its old request)
+            # must resolve to ONE swap
+            with self._lock:
+                existing_id = self._swap_tokens.get(token)
+                existing = (
+                    self._swaps.get(existing_id) if existing_id else None
+                )
+                if existing is None and existing_id is not None:
+                    # reserved but the record is still being opened
+                    # (replica spawn in flight on another thread): the
+                    # replay must neither steal the reservation nor
+                    # open a second gate
+                    raise ValueError(
+                        f"a swap for token {token!r} is already being "
+                        "opened; retry shortly"
+                    )
+                if existing is None:
+                    self._swap_tokens[token] = swap_id
+            if existing is not None:
+                log_json(
+                    logger, logging.INFO, "router_swap_token_replay",
+                    token=token, swap=existing["id"],
+                    phase=existing["phase"],
+                )
+                return existing
+        from_generation = self.serving_generation
+        gated = self._gate_config is not None
+        try:
+            if gated:
+                # ONE fleet gate at a time: the gate mirrors live
+                # traffic through the shared self._fleet_gate slot and
+                # the watch phase owns the fleet-wide rollback standby
+                # — a second concurrent gated swap would cross-consume
+                # the first one's verdict. (Same-token replays returned
+                # above; a DIFFERENT generation must wait its turn.)
+                with self._lock:
+                    self._assert_no_gated_swap_locked()
+            if url is None:
+                spawner = self._spawner
+                if spawner is None:
+                    raise ValueError(
+                        "swap without a url needs a replica spawner "
+                        "(run the router with --spawn-replica)"
+                    )
+                new_replica = spawner(generation, gated)
+            else:
+                new_replica = self.add_replica(
+                    url,
+                    replica_id=replica_id,
+                    generation=generation,
+                    pid=pid,
+                    staged=gated,
+                )
+        except BaseException:
+            if token:
+                with self._lock:
+                    if self._swap_tokens.get(token) == swap_id:
+                        self._swap_tokens.pop(token, None)
+            raise
         record = {
             "id": swap_id,
+            "token": token or None,
             "phase": "warming",
             "generation": generation,
-            "url": url,
+            "fromGeneration": from_generation,
+            "url": new_replica.url,
             "replica": new_replica.replica_id,
+            "standby": None,
+            "gated": self._gate_config is not None,
             "retired": [],
+            "retire": retire,
+            "warmTimeoutS": warm_timeout_s,
+            "gate": None,
             "error": None,
         }
-        with self._lock:
-            self._swaps[swap_id] = record
-            while len(self._swaps) > 20:
-                oldest = next(iter(self._swaps))
-                if oldest == swap_id:
-                    break
-                self._swaps.pop(oldest)
+        try:
+            with self._lock:
+                if gated:
+                    # re-checked atomically with registration: a rival
+                    # gated swap may have registered while our replica
+                    # was spawning
+                    self._assert_no_gated_swap_locked()
+                self._swaps[swap_id] = record
+                if token:
+                    self._swap_tokens[token] = swap_id
+        except ValueError:
+            if token:
+                with self._lock:
+                    if self._swap_tokens.get(token) == swap_id:
+                        self._swap_tokens.pop(token, None)
+            self.retire(new_replica.replica_id)
+            raise
+        self._persist_state()
 
-        def _run():
+        if wait:
+            self._run_swap(record)
+        else:
+            threading.Thread(
+                target=self._run_swap,
+                args=(record,),
+                name=f"pio-router-{swap_id}",
+                daemon=True,
+            ).start()
+        return record
+
+    def _assert_no_gated_swap_locked(self) -> None:
+        """Raise if a gated swap is already in flight (caller holds the
+        pool lock). The fleet gate is a fleet-wide singleton."""
+        for sid, s in self._swaps.items():
+            if (
+                s.get("gated")
+                and s.get("phase") not in SWAP_TERMINAL_PHASES
+            ):
+                raise ValueError(
+                    f"gated swap {sid} (generation "
+                    f"{s.get('generation')!r}, phase {s.get('phase')!r})"
+                    " is still in flight; one fleet gate at a time"
+                )
+
+    def _set_swap_phase(self, record: dict, phase: str, **fields) -> None:
+        terminal = phase in SWAP_TERMINAL_PHASES
+        with self._lock:
+            record["phase"] = phase
+            record.update(fields)
+            if terminal:
+                self._swaps_completed_total += 1
+                self._gc_swaps_locked()
+        self._persist_state()
+
+    def _gc_swaps_locked(self) -> None:
+        """Bound the completed-swap history: keep the newest
+        ``_SWAP_HISTORY_KEEP`` terminal records (plus every in-flight
+        one — an active swap is NEVER evicted, the bug the old
+        fixed-size eviction had). Tokens of evicted records go with
+        them; the total-completed count survives in
+        ``swapsCompletedTotal`` on the status route."""
+        terminal = [
+            sid
+            for sid, s in self._swaps.items()
+            if s.get("phase") in SWAP_TERMINAL_PHASES
+        ]
+        for sid in terminal[: max(0, len(terminal) - _SWAP_HISTORY_KEEP)]:
+            evicted = self._swaps.pop(sid)
+            if evicted.get("token"):
+                self._swap_tokens.pop(evicted["token"], None)
+
+    def _swap_replica(self, record: dict) -> Replica | None:
+        with self._lock:
+            return self._replicas.get(record.get("replica") or "")
+
+    def _fail_swap(self, record: dict, error: str) -> None:
+        self._swaps_total.labels("failed").inc()
+        log_json(
+            logger, logging.WARNING, "router_swap_failed",
+            swap=record["id"], generation=record["generation"],
+            error=error,
+        )
+        self._set_swap_phase(record, "failed", error=error)
+        # the old generation keeps serving; pull the dud out
+        self.retire(record["replica"], wait=True)
+
+    def _run_swap(self, record: dict) -> None:
+        """Drive one swap from its CURRENT phase to a terminal one —
+        the same entry point fresh swaps and restart-resumed swaps go
+        through, so a router killed -9 mid-swap continues exactly where
+        the state file says it stopped."""
+        try:
+            self._advance_swap(record)
+        except Exception as e:  # noqa: BLE001 - a swap must terminate
+            logger.exception("swap %s crashed", record["id"])
+            if record.get("phase") not in SWAP_TERMINAL_PHASES:
+                self._fail_swap(record, f"swap crashed: {e}")
+
+    def _advance_swap(self, record: dict) -> None:
+        gated = bool(record.get("gated")) and self._gate_config is not None
+        warm_timeout_s = float(record.get("warmTimeoutS") or 120.0)
+        generation = record["generation"]
+
+        if record["phase"] == "warming":
+            new_replica = self._swap_replica(record)
+            if new_replica is None:
+                self._fail_swap(
+                    record,
+                    "staged replica disappeared before warmup",
+                )
+                return
             deadline = time.monotonic() + warm_timeout_s
             while time.monotonic() < deadline and not self._closed.is_set():
                 if new_replica.state == HEALTHY:
                     break
                 time.sleep(self._drain_poll_s)
             if new_replica.state != HEALTHY:
-                record["phase"] = "failed"
-                record["error"] = (
+                self._fail_swap(
+                    record,
                     f"new replica never became healthy+warm within "
                     f"{warm_timeout_s}s (state={new_replica.state}, "
-                    f"lastProbe={new_replica.last_probe})"
+                    f"lastProbe={new_replica.last_probe})",
                 )
-                self._swaps_total.labels("failed").inc()
-                # the old generation keeps serving; pull the dud out
-                self.retire(new_replica.replica_id, wait=True)
                 return
-            record["phase"] = "draining-old"
-            if retire == "others":
-                with self._lock:
-                    victims = [
-                        rid
-                        for rid, r in self._replicas.items()
-                        if rid != new_replica.replica_id
-                        and r.generation != generation
-                    ]
-            else:
-                victims = list(retire)
-            # drain victims one at a time: capacity never drops by more
-            # than one replica mid-swap
-            for rid in victims:
-                if self.retire(rid, wait=True):
-                    record["retired"].append(rid)
-            record["phase"] = "done"
+            self._set_swap_phase(
+                record, "shadowing" if gated else "draining-old"
+            )
+
+        if record["phase"] == "shadowing":
+            if not self._shadow_phase(record):
+                return
+
+        if record["phase"] in ("rolling", "draining-old"):
+            self._roll_phase(record)
+
+        if record["phase"] == "watching":
+            self._watch_phase(record)
+
+        if record["phase"] == "rolling-back":
+            self._rollback_phase(record)
+
+        if record["phase"] == "done":
             self._swaps_total.labels("ok").inc()
             log_json(
                 logger, logging.INFO, "router_swap_done",
-                swap=swap_id, generation=generation,
+                swap=record["id"], generation=generation,
                 retired=record["retired"],
             )
 
-        if wait:
-            _run()
+    def _swap_victims(self, record: dict) -> list[str]:
+        """Old-generation replicas this swap still has to drain."""
+        retire = record.get("retire", "others")
+        if retire != "others":
+            # the standby was POPPED from the victims and parked, never
+            # appended to record["retired"] — without this filter a
+            # roll resumed after a restart would retire its own
+            # rollback standby (the "others" path below has the same
+            # exclusion)
+            return [
+                rid
+                for rid in retire
+                if rid not in record["retired"]
+                and rid != record.get("standby")
+            ]
+        with self._lock:
+            return [
+                rid
+                for rid, r in self._replicas.items()
+                if rid != record["replica"]
+                and r.generation != record["generation"]
+                and r.state != RETIRED
+                and rid != record.get("standby")
+            ]
+
+    def _shadow_phase(self, record: dict) -> bool:
+        """Mirror sampled live traffic to the staged replica and wait
+        for the fleet gate's verdict. True = promoted (the caller rolls
+        the fleet); False = the swap terminated here."""
+        staged = self._swap_replica(record)
+        if staged is None:
+            self._fail_swap(record, "staged replica disappeared")
+            return False
+        gate = canary_mod.ShadowCanary(
+            staged,
+            config=self._gate_config,
+            registry=self._registry,
+            shadow_fn=lambda body: self._fleet_shadow_score(staged, body),
+        )
+        with self._lock:
+            self._fleet_gate = gate
+        log_json(
+            logger, logging.INFO, "router_fleet_gate_open",
+            swap=record["id"], generation=record["generation"],
+            staged=staged.replica_id,
+        )
+        decision = None
+        deadline = time.monotonic() + self._gate_timeout_s
+        while not self._closed.is_set():
+            decision = gate.take_decision()
+            if decision is not None:
+                break
+            if time.monotonic() >= deadline:
+                if gate.cancel(
+                    "fleet gate timed out before enough shadow samples"
+                ):
+                    decision = "cancelled"
+                    break
+                # a verdict is mid-claim; take it next iteration
+            time.sleep(self._drain_poll_s)
+        with self._lock:
+            self._fleet_gate = None
+            record["gate"] = gate.to_dict()
+        if decision != "promote":
+            gate.finished(canary_mod.REJECTED)
+            reason = gate.reason or f"gate decision: {decision}"
+            self._fail_swap(record, f"fleet gate refused: {reason}")
+            return False
+        # promotion: the staged replica starts taking live traffic and
+        # the fleet's serving generation flips BEFORE any old replica
+        # drains — persisted as one transition, so a crash right here
+        # resumes into the roll, never a half-promoted limbo
+        staged.staged = False
+        with self._lock:
+            self._serving_generation = record["generation"]
+            self._fleet_gate = gate
+        # the regression window opens NOW: the roll itself is part of
+        # the post-promotion period the watch must cover
+        gate.promoted(retained=None)
+        gate_dict = gate.to_dict()
+        log_json(
+            logger, logging.INFO, "router_fleet_gate_promoted",
+            swap=record["id"], generation=record["generation"],
+            samples=gate_dict.get("shadowSamples"),
+            meanDivergence=gate_dict.get("meanDivergence"),
+        )
+        self._set_swap_phase(record, "rolling", gate=gate_dict)
+        return True
+
+    def _roll_phase(self, record: dict) -> None:
+        """Drain the old generation one replica at a time (capacity
+        never drops by more than one). Gated swaps park the first
+        victim as the rollback standby instead of retiring it."""
+        gated = record["phase"] == "rolling"
+        victims = self._swap_victims(record)
+        if gated and not record.get("standby") and victims:
+            standby = victims.pop(0)
+            self.park(standby)
+            with self._lock:
+                record["standby"] = standby
+            self._persist_state()
+        for rid in victims:
+            if self.retire(rid, wait=True):
+                with self._lock:
+                    record["retired"].append(rid)
+        if gated:
+            self._set_swap_phase(record, "watching")
         else:
-            threading.Thread(
-                target=_run, name=f"pio-router-{swap_id}", daemon=True
-            ).start()
-        return record
+            self._set_swap_phase(record, "done")
+
+    def _watch_phase(self, record: dict) -> None:
+        """Post-promotion fleet regression watch: served error rate or
+        latency regressing against the pre-promotion baseline rolls the
+        WHOLE fleet back; a clean window releases the standby."""
+        gate = self._fleet_gate
+        if gate is None:
+            # restart mid-watch: the baseline died with the old
+            # process, so open a fresh watch window (error-rate
+            # regression still rolls back; the latency comparison
+            # needs a baseline and stays disarmed)
+            staged = self._swap_replica(record)
+            gate = canary_mod.ShadowCanary(
+                staged if staged is not None else record["replica"],
+                config=self._gate_config or canary_mod.CanaryConfig(),
+                registry=self._registry,
+                shadow_fn=lambda body: None,
+            )
+            gate.promoted(retained=record.get("standby"))
+            with self._lock:
+                self._fleet_gate = gate
+        decision = None
+        deadline = time.monotonic() + self._watch_timeout_s
+        while not self._closed.is_set():
+            decision = gate.take_decision()
+            if decision is not None:
+                break
+            if time.monotonic() >= deadline:
+                if gate.cancel(
+                    "watch window expired without enough traffic for "
+                    "a verdict; treating the promotion as stable"
+                ):
+                    decision = "stable"
+                    break
+            time.sleep(self._drain_poll_s)
+        with self._lock:
+            self._fleet_gate = None
+        if decision is None and self._closed.is_set():
+            # graceful shutdown mid-watch: leave the record in
+            # "watching" with the standby parked — the restart resumes
+            # the regression watch exactly like a kill -9 does.
+            # Finalizing "done" here would SIGTERM the rollback
+            # standby and destroy the safety net on a routine restart.
+            return
+        with self._lock:
+            record["gate"] = gate.to_dict()
+        if decision == "rollback":
+            gate.finished(canary_mod.ROLLED_BACK)
+            log_json(
+                logger, logging.WARNING, "router_fleet_rollback",
+                swap=record["id"], generation=record["generation"],
+                reason=gate.reason,
+            )
+            self._set_swap_phase(
+                record, "rolling-back", error=gate.reason
+            )
+            return
+        # stable (verdict, or cancelled-at-timeout): the promotion
+        # held through the watch window — release the standby
+        gate.finished(canary_mod.STABLE)
+        standby = record.get("standby")
+        if standby and self.retire(standby, wait=True):
+            with self._lock:
+                record["retired"].append(standby)
+        self._set_swap_phase(record, "done")
+
+    def _rollback_phase(self, record: dict) -> None:
+        """Converge the fleet back onto the pre-promotion generation:
+        revert the serving generation, readmit the parked standby, then
+        drain every replica of the rejected generation."""
+        with self._lock:
+            self._serving_generation = record.get("fromGeneration", "")
+        standby = record.get("standby")
+        if standby:
+            self.unpark(standby)
+            deadline = time.monotonic() + float(
+                record.get("warmTimeoutS") or 120.0
+            )
+            while (
+                time.monotonic() < deadline
+                and not self._closed.is_set()
+            ):
+                with self._lock:
+                    replica = self._replicas.get(standby)
+                if replica is None or replica.state == HEALTHY:
+                    break
+                time.sleep(self._drain_poll_s)
+        with self._lock:
+            rejected = [
+                rid
+                for rid, r in self._replicas.items()
+                if r.generation == record["generation"]
+                and r.state != RETIRED
+            ]
+        for rid in rejected:
+            if self.retire(rid, wait=True):
+                with self._lock:
+                    record["retired"].append(rid)
+        self._swaps_total.labels("rolled_back").inc()
+        log_json(
+            logger, logging.WARNING, "router_swap_rolled_back",
+            swap=record["id"], generation=record["generation"],
+            to=record.get("fromGeneration", ""),
+        )
+        self._set_swap_phase(record, "rolled_back")
+
+    def _resume_swap(self, record: dict) -> None:
+        """Continue (or safely abort) a swap the previous router
+        process left mid-flight. Pre-promotion phases abort to the old
+        generation — the gate's evidence died with the process, and an
+        unproven generation must not be promoted on faith; from
+        ``rolling`` on, the gate already passed, so the roll (or the
+        rollback) completes."""
+        phase = record.get("phase")
+        if phase in ("warming", "shadowing"):
+            self._fail_swap(
+                record,
+                f"router restarted during {phase}; aborted to "
+                "generation "
+                f"{record.get('fromGeneration') or '(previous)'} — the "
+                "fleet gate's evidence did not survive the crash",
+            )
+            return
+        staged = self._swap_replica(record)
+        if staged is not None:
+            staged.staged = False
+        if phase in ("rolling", "draining-old", "watching"):
+            # every re-adopted replica restarts WARMING — including the
+            # promoted generation's. If the crash also took the new
+            # replica down (same-host reboot), finishing the roll would
+            # drain the only replicas still able to serve and converge
+            # the fleet to ZERO capacity. The new generation must
+            # re-prove itself through the probe gate before any more
+            # old capacity is touched.
+            warm_timeout_s = float(record.get("warmTimeoutS") or 120.0)
+            deadline = time.monotonic() + warm_timeout_s
+            healthy = False
+            while not self._closed.is_set():
+                with self._lock:
+                    healthy = any(
+                        r.generation == record["generation"]
+                        and r.state == HEALTHY
+                        for r in self._replicas.values()
+                    )
+                if healthy or time.monotonic() >= deadline:
+                    break
+                time.sleep(self._drain_poll_s)
+            if not healthy:
+                reason = (
+                    f"resumed {phase} but no {record['generation']!r} "
+                    f"replica became healthy within {warm_timeout_s}s"
+                )
+                if record.get("gated"):
+                    # the gate already promoted: converge back through
+                    # the rollback machinery (standby + undrained old
+                    # replicas still exist)
+                    log_json(
+                        logger, logging.WARNING, "router_fleet_rollback",
+                        swap=record["id"],
+                        generation=record["generation"], reason=reason,
+                    )
+                    self._set_swap_phase(
+                        record, "rolling-back", error=reason
+                    )
+                else:
+                    self._fail_swap(record, reason)
+                    return
+        self._run_swap(record)
+
+    def _fleet_shadow_score(self, staged: Replica, body):
+        """Score one mirrored query on the staged replica (fleet-gate
+        shadow worker only). 503/504 are infrastructure sheds
+        (ShadowDropped — never a gate veto); a transport error or any
+        other non-200 is evidence against the candidate and vetoes the
+        swap, exactly like a model exception in the per-replica
+        canary."""
+        config = self._gate_config or canary_mod.CanaryConfig()
+        req = urllib.request.Request(
+            staged.url + "/queries.json",
+            data=body if isinstance(body, bytes) else bytes(body or b""),
+            method="POST",
+        )
+        req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(
+                req, timeout=config.shadow_timeout_s
+            ) as resp:
+                payload = resp.read()
+                status = resp.status
+        except urllib.error.HTTPError as e:
+            if e.code in (429, 503, 504):
+                raise canary_mod.ShadowDropped() from e
+            raise RuntimeError(
+                f"staged replica answered HTTP {e.code}"
+            ) from e
+        if status != 200:
+            raise RuntimeError(f"staged replica answered HTTP {status}")
+        return canary_mod.strip_volatile(json.loads(payload))
+
+    def _fleet_observe(
+        self, request: Request, response: Response | None,
+        elapsed_s: float,
+    ) -> None:
+        """Request-path fleet-gate hook: feed the latency baseline /
+        regression watch, and let the gate mirror a deterministic
+        sample of served queries to the staged replica. Sheds and
+        budget expiries (429/504) indict load, not the model — they
+        never feed the gate."""
+        gate = self._fleet_gate
+        if gate is None:
+            return
+        ok = response is not None and response.status < 500
+        if response is not None and response.status in (429, 504):
+            return
+        prediction = None
+        if (
+            ok
+            and response.status == 200
+            and gate.state == canary_mod.SHADOWING
+            # only single queries are shadow-comparable: a batch body
+            # mirrored onto the staged replica's /queries.json would
+            # 400 (scoring as a bogus model exception), and a batch
+            # result list never matches a single prediction. Batch
+            # traffic still feeds the latency baseline / watch below —
+            # prediction=None is never sampled.
+            and request.path == "/queries.json"
+        ):
+            try:
+                prediction = canary_mod.strip_volatile(
+                    json.loads(response.body)
+                )
+            except (TypeError, ValueError):
+                return  # not shadow-comparable
+        gate.observe(request.body, prediction, elapsed_s, ok=ok)
 
     # -- routes ------------------------------------------------------------
     def _status(self, request: Request) -> Response:
         with self._lock:
             replicas = [r.to_dict() for r in self._replicas.values()]
-        return Response(
-            200,
-            {
-                "status": "alive",
-                "service": "router",
-                "pid": os.getpid(),
-                "startTime": self._start_time,
-                "uptimeSec": round(
-                    time.monotonic() - self._start_monotonic, 3
-                ),
-                "replicas": replicas,
-                "generations": sorted(
-                    {r["generation"] for r in replicas if r["generation"]}
-                ),
+            active_swaps = [
+                {
+                    "id": s["id"],
+                    "phase": s["phase"],
+                    "generation": s.get("generation"),
+                }
+                for s in self._swaps.values()
+                if s.get("phase") not in SWAP_TERMINAL_PHASES
+            ]
+            swaps_kept = len(self._swaps) - len(active_swaps)
+            completed_total = self._swaps_completed_total
+            gate = self._fleet_gate
+        body = {
+            "status": "alive",
+            "service": "router",
+            "pid": os.getpid(),
+            "startTime": self._start_time,
+            "uptimeSec": round(
+                time.monotonic() - self._start_monotonic, 3
+            ),
+            "replicas": replicas,
+            "generations": sorted(
+                {r["generation"] for r in replicas if r["generation"]}
+            ),
+            "servingGeneration": self.serving_generation,
+            "swaps": {
+                "active": active_swaps,
+                "completedKept": swaps_kept,
+                "completedTotal": completed_total,
             },
-        )
+        }
+        if gate is not None:
+            body["fleetGate"] = gate.to_dict()
+        if self._state_note:
+            body["stateFile"] = self._state_note
+        autoscaler = self._autoscaler_status
+        if autoscaler is not None:
+            try:
+                body["autoscaler"] = autoscaler()
+            except Exception:  # noqa: BLE001 - status must not 500
+                logger.exception("autoscaler status callback failed")
+        return Response(200, body)
 
     def _admin_list(self, request: Request) -> Response:
         self._server_config.check_key(request)
@@ -1010,9 +1977,14 @@ class ServingRouter:
     def _admin_swap(self, request: Request) -> Response:
         self._server_config.check_key(request)
         body = request.json()
-        if not isinstance(body, dict) or not body.get("url"):
+        if not isinstance(body, dict) or not (
+            body.get("url") or body.get("generation")
+        ):
             raise HTTPError(
-                400, "body must be {'url': ..., 'generation': ...}"
+                400,
+                "body must be {'url': ..., 'generation': ...} — url "
+                "may be omitted only when the router has a replica "
+                "spawner (it then stages the generation itself)",
             )
         pid = body.get("pid")
         if pid is not None and not isinstance(pid, int):
@@ -1023,18 +1995,35 @@ class ServingRouter:
             and all(isinstance(x, str) for x in retire)
         ):
             raise HTTPError(400, "retire must be 'others' or a list of ids")
+        if not body.get("url") and self._spawner is None:
+            # a misconfiguration, not a transient: 409 would send the
+            # trainer into its retry-shortly loop for the full promote
+            # budget on every generation
+            raise HTTPError(
+                400,
+                "swap without a url needs a replica spawner (run the "
+                "router with --spawn-replica)",
+            )
+        token = str(body.get("token", "") or "")
+        replayed = False
+        if token:
+            with self._lock:
+                replayed = self._swap_tokens.get(token) in self._swaps
         try:
             record = self.rolling_swap(
-                str(body["url"]),
+                str(body["url"]) if body.get("url") else None,
                 generation=str(body.get("generation", "")),
                 replica_id=body.get("id"),
                 pid=pid,
                 retire=retire,
                 warm_timeout_s=float(body.get("warmTimeoutS", 120.0)),
+                token=token,
             )
         except ValueError as e:
             raise HTTPError(409, str(e)) from None
-        return Response(202, record)
+        # an idempotent replay of a known token answers 200 with the
+        # existing record; a fresh swap answers 202
+        return Response(200 if replayed else 202, record)
 
     def _admin_swap_get(self, request: Request) -> Response:
         self._server_config.check_key(request)
@@ -1060,6 +2049,11 @@ class ServingRouter:
 
     def close(self) -> None:
         self._closed.set()
+        with self._lock:
+            gate = self._fleet_gate
+            self._fleet_gate = None
+        if gate is not None:
+            gate.close()
         self._prober.join(timeout=5)
 
 
@@ -1074,5 +2068,14 @@ def create_router(
     router = ServingRouter(**kwargs)
     for i, spec in enumerate(replica_urls):
         url, _, generation = spec.partition("#")
+        with router._lock:
+            adopted = f"r{i}" in router._replicas or any(
+                r.url == url for r in router._replicas.values()
+            )
+        if adopted:
+            # --state-file already re-adopted this replica: a restart
+            # with the same --replica flags must re-join the fleet,
+            # not crash on the duplicate registration
+            continue
         router.add_replica(url, replica_id=f"r{i}", generation=generation)
     return router, router.serve(host=host, port=port)

@@ -91,7 +91,10 @@ class WorkerSlot:
     ``proc`` is None while the slot waits out a backoff delay
     (respawn due at ``respawn_at`` on the supervision clock)."""
 
-    __slots__ = ("proc", "spawn", "spawned_at", "fails", "respawn_at")
+    __slots__ = (
+        "proc", "spawn", "spawned_at", "fails", "respawn_at", "retired",
+        "retired_pid",
+    )
 
     def __init__(self, spawn: Callable[[], subprocess.Popen],
                  clock: Callable[[], float] = time.monotonic,
@@ -106,10 +109,33 @@ class WorkerSlot:
         self.spawned_at = clock()
         self.fails = 0
         self.respawn_at = 0.0
+        #: set by :meth:`retire`: the supervisor drops this slot at its
+        #: next poll and never respawns it again
+        self.retired = False
+        #: pid of the process alive at :meth:`retire` time (None if the
+        #: slot was mid-backoff) — that one is the retirer's to drain;
+        #: any OTHER live pid at removal is a respawn that raced the
+        #: retirement and must be terminated by the supervisor
+        self.retired_pid: int | None = None
 
     @property
     def pid(self) -> int | None:
         return self.proc.pid if self.proc is not None else None
+
+    def retire(self) -> None:
+        """Take this slot out of supervision: a pending respawn (the
+        slot mid-backoff) is cancelled, a future exit of its live
+        process is NOT respawned, and the supervisor removes the slot
+        from its list at the next poll. The process alive NOW is left
+        to the retirer — the autoscaler drains it through the router's
+        sticky admin-drain path, which SIGTERMs it losslessly — but a
+        process the supervisor respawns AFTER this call (a respawn
+        racing the retirement decision) is terminated at removal, never
+        leaked. The pid snapshot happens before the flag is set so the
+        supervisor can tell the two apart."""
+        proc = self.proc
+        self.retired_pid = proc.pid if proc is not None else None
+        self.retired = True
 
 
 def supervise_children(
@@ -132,11 +158,42 @@ def supervise_children(
       credited such a child with the supervisor's own sleep time and
       reset the clock, turning a crash loop into a hot spin.
 
+    The slot list is DYNAMIC: another thread (the replica autoscaler)
+    may append new :class:`WorkerSlot` instances — picked up at the
+    next poll — or :meth:`WorkerSlot.retire` an existing one, which
+    cancels any pending respawn and removes the slot from the list.
+    Each poll iterates a snapshot, so concurrent append/retire never
+    invalidates the iteration, and backoff deadlines stay strictly
+    per-slot — membership churn cannot leak one slot's respawn timing
+    into another's.
+
     Returns when ``stopping`` is set.
     """
     while not stopping.is_set():
         now = clock()
-        for slot in slots:
+        for slot in list(slots):
+            if slot.retired:
+                # cancel a pending respawn and drop the slot; the
+                # process alive at retire() time is the retirer's to
+                # drain, but one respawned AFTER (respawn raced the
+                # retirement) would leak — nothing drains a pid the
+                # retirer never saw, so terminate it here
+                proc = slot.proc
+                if (
+                    proc is not None
+                    and proc.pid != slot.retired_pid
+                    and proc.poll() is None
+                ):
+                    logger.warning(
+                        "terminating pid %s respawned after slot "
+                        "retirement", proc.pid,
+                    )
+                    proc.terminate()
+                try:
+                    slots.remove(slot)
+                except ValueError:
+                    pass  # already removed by a concurrent retire
+                continue
             if slot.proc is None:
                 if now >= slot.respawn_at and not stopping.is_set():
                     slot.proc = slot.spawn()

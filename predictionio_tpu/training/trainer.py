@@ -25,6 +25,15 @@ same backoff loop that keeps SO_REUSEPORT workers alive —
 ``serving/workers.supervise_children``) the trainer re-reads the state
 file and the ALS checkpoint and continues where the dead process
 stopped.
+
+With a ``router_url`` the trainer also closes the loop fleet-wide
+(docs/scale_out.md "Fleet promotion"): after publishing a generation
+it drives the router's ``POST /admin/swap`` directly, so
+publish → canary → fleet promotion is ONE pipeline behind ONE
+fleet-level shadow gate. The swap token is the generation's instance
+id and the "promoting" phase commits to the state file before the
+request leaves, so a trainer killed -9 mid-promotion re-drives the
+same token on respawn and the gate still fires exactly once.
 """
 
 from __future__ import annotations
@@ -35,6 +44,7 @@ import json
 import logging
 import os
 import threading
+import time
 from typing import Any
 
 import numpy as np
@@ -133,6 +143,16 @@ class TrainerConfig:
     #: where the trainer's own progress lives; default
     #: ``<checkpoint_dir>/trainer_state.json``
     state_path: str = ""
+    #: fleet promotion: after publishing a generation, drive the
+    #: router's ``POST /admin/swap`` directly (token = the generation's
+    #: instance id, so a respawned trainer re-driving the same
+    #: promotion is idempotent — the fleet gate fires exactly once).
+    #: Empty = publish only; each replica gates its own /reload.
+    router_url: str = ""
+    router_key: str = ""
+    #: how long one promotion may take end to end (warm + shadow gate
+    #: + roll + regression watch) before the trainer stops polling
+    promote_timeout_s: float = 600.0
 
     def resolved_state_path(self) -> str:
         if self.state_path:
@@ -192,6 +212,12 @@ class ContinuousTrainer:
             "pio_train_last_timestamp_seconds",
             "Unix time of the last successfully published generation "
             "(display epoch; freshness = now - this)",
+        )
+        self._promotions = self._registry.counter(
+            "pio_trainer_promotions_total",
+            "Trainer-driven fleet promotions, by terminal outcome "
+            "(done | failed | rolled_back | timeout | unreachable)",
+            ("outcome",),
         )
         self._state = self._load_state()
         self._recover_interrupted_publish()
@@ -258,12 +284,24 @@ class ContinuousTrainer:
                 pass
         wm = self._state.get("pendingWatermark")
         now_iso = _now().isoformat()
+        # a publish that completed right before the crash still owes
+        # the fleet its promotion: mark it pending so the first poll
+        # re-drives it (idempotent — the router keys the swap on the
+        # generation id)
+        next_phase = (
+            "promoting"
+            if self._config.router_url
+            and self._state.get("lastInstanceId")
+            else "idle"
+        )
         self._state.update(
-            phase="idle",
+            phase=next_phase,
             lastFullTrainAt=now_iso,
             lastTrainAt=now_iso,
             fullTrains=int(self._state.get("fullTrains", 0)) + 1,
         )
+        if next_phase == "promoting":
+            self._state["promoteToken"] = self._state["lastInstanceId"]
         if wm is not None:
             self._state["trainedWatermark"] = wm
             self._state["fullTrainedCount"] = int(wm.get("count", 0))
@@ -312,10 +350,12 @@ class ContinuousTrainer:
         return "idle"
 
     def poll_once(self) -> str:
-        """One supervision tick: read the watermark, maybe train.
-        Returns the action taken ("idle" | "full" | "fold_in" —
-        "fold_in" may escalate to "full" when the model shape does not
-        support incremental updates)."""
+        """One supervision tick: resume an interrupted promotion, read
+        the watermark, maybe train, drive the fleet promotion of what
+        was published. Returns the action taken ("idle" | "full" |
+        "fold_in" — "fold_in" may escalate to "full" when the model
+        shape does not support incremental updates)."""
+        self._resume_promotion()
         events = self._storage.get_events()
         wm = read_watermark(events, self._app_id, self._channel_id)
         self._watermark_gauge.set(wm.count)
@@ -326,11 +366,171 @@ class ContinuousTrainer:
         if action == "idle":
             return action
         if action == "fold_in":
-            if self.fold_in(wm):
+            instance_id = self.fold_in(wm)
+            if instance_id:
+                self.promote(instance_id)
                 return "fold_in"
             action = "full"  # not fold-innable: escalate
-        self.full_train(wm)
+        instance_id = self.full_train(wm)
+        self.promote(instance_id)
         return action
+
+    # -- fleet promotion --------------------------------------------------
+    def _resume_promotion(self) -> None:
+        """A trainer respawned mid-promotion re-drives the SAME token:
+        the router's idempotent swap returns the in-flight (or already
+        terminal) record instead of opening a second gate."""
+        if self._state.get("phase") != "promoting":
+            return
+        token = str(
+            self._state.get("promoteToken")
+            or self._state.get("lastInstanceId")
+            or ""
+        )
+        if token and self._config.router_url:
+            logger.info(
+                "resuming interrupted fleet promotion of %s", token
+            )
+            self.promote(token)
+        else:
+            self._state["phase"] = "idle"
+            self._state.pop("promoteToken", None)
+            self._save_state()
+
+    def _post_train_phase(self, instance_id: str) -> str:
+        """Phase a just-completed train finalizes into. With a router
+        configured the generation OWES a fleet promotion, and that debt
+        must be durable in the same state save that records completion:
+        phase="promoting" + the token, so a kill -9 in the gap before
+        promote() is re-driven by _resume_promotion on respawn."""
+        if not self._config.router_url:
+            return "idle"
+        self._state["promoteToken"] = instance_id
+        return "promoting"
+
+    def promote(self, instance_id: str) -> str | None:
+        """Drive publish → canary → fleet promotion as ONE pipeline:
+        ask the router to stage ``instance_id`` fleet-wide behind its
+        shadow gate and poll the swap to a terminal phase. The
+        "promoting" phase + token are committed to the state file
+        BEFORE the request, so a kill -9 anywhere in here resumes by
+        re-driving the same token. Returns the terminal outcome, or
+        None when no router is configured."""
+        if not self._config.router_url:
+            return None
+        self._state["phase"] = "promoting"
+        self._state["promoteToken"] = instance_id
+        self._save_state()
+        outcome, swap = self._drive_promotion(instance_id)
+        self._state.update(
+            phase="idle",
+            lastPromotion={
+                "generation": instance_id,
+                "outcome": outcome,
+                "swap": swap,
+            },
+        )
+        self._state.pop("promoteToken", None)
+        self._save_state()
+        self._promotions.labels(outcome).inc()
+        level = (
+            logging.INFO if outcome == "done" else logging.WARNING
+        )
+        logger.log(
+            level, "fleet promotion of %s: %s", instance_id, outcome
+        )
+        return outcome
+
+    def _router_request(self, path: str, body: dict | None = None):
+        import urllib.request
+
+        req = urllib.request.Request(
+            self._config.router_url.rstrip("/") + path,
+            data=json.dumps(body).encode() if body is not None else None,
+            method="POST" if body is not None else "GET",
+        )
+        req.add_header("Content-Type", "application/json")
+        if self._config.router_key:
+            req.add_header("X-PIO-Server-Key", self._config.router_key)
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read() or b"null")
+
+    def _drive_promotion(self, token: str) -> tuple[str, str | None]:
+        """(terminal outcome, swap id). ``unreachable`` / ``timeout`` /
+        ``refused`` are trainer-side outcomes — the router may still
+        converge on its own; the next generation's promotion (or a
+        respawn's resume) re-synchronizes."""
+        import urllib.error
+
+        deadline = time.monotonic() + self._config.promote_timeout_s
+        record = None
+        while record is None:
+            try:
+                record = self._router_request(
+                    "/admin/swap",
+                    {"token": token, "generation": token},
+                )
+            except urllib.error.HTTPError as e:
+                # HTTPError IS an OSError — split it out: the router
+                # ANSWERED. 409 = "retry shortly" by design (this
+                # token's swap record is mid-open, or another gated
+                # swap holds the fleet gate); anything else (401 bad
+                # key, 400 bad body) is a misconfiguration that a
+                # retry or an "unreachable" diagnosis would only hide.
+                detail = e.read().decode("utf-8", "replace")[:200]
+                if e.code == 409 and time.monotonic() < deadline:
+                    logger.info(
+                        "router busy for promotion of %s (409 %s); "
+                        "retrying", token, detail,
+                    )
+                    time.sleep(min(1.0, self._config.poll_interval_s))
+                    continue
+                logger.error(
+                    "router refused promotion of %s: HTTP %s %s",
+                    token, e.code, detail,
+                )
+                return "refused", None
+            except OSError as e:
+                logger.warning(
+                    "router unreachable for promotion of %s: %s",
+                    token, e,
+                )
+                return "unreachable", None
+        if not isinstance(record, dict) or not record.get("id"):
+            logger.warning(
+                "router answered a non-swap record for %s: %r",
+                token, record,
+            )
+            return "unreachable", None
+        swap_id = record["id"]
+        terminal = ("done", "failed", "rolled_back")
+        phase = record.get("phase")
+        while phase not in terminal and time.monotonic() < deadline:
+            time.sleep(
+                min(1.0, max(0.1, self._config.poll_interval_s / 10.0))
+            )
+            try:
+                record = self._router_request(f"/admin/swap/{swap_id}")
+                phase = (record or {}).get("phase")
+            except urllib.error.HTTPError as e:
+                # HTTPError IS an OSError — split it out here too: a
+                # 4xx is the router DEFINITIVELY not knowing this swap
+                # (restarted without/with a stale state file), and
+                # spinning on it until promote_timeout would block
+                # training ticks for minutes to mislabel it "timeout"
+                if e.code >= 500:
+                    continue  # router hiccup: poll again in budget
+                logger.warning(
+                    "router lost swap %s for %s (HTTP %s); its state "
+                    "file was discarded or absent",
+                    swap_id, token, e.code,
+                )
+                return "lost", swap_id
+            except OSError:
+                continue  # router mid-restart: it resumes from ITS state
+        if phase not in terminal:
+            return "timeout", swap_id
+        return str(phase), swap_id
 
     # -- full retrain ------------------------------------------------------
     def full_train(self, wm: Watermark) -> str:
@@ -389,7 +589,12 @@ class ContinuousTrainer:
                 pass
         now_iso = _now().isoformat()
         self._state.update(
-            phase="idle",
+            # with a router configured, the promotion debt is committed
+            # in the SAME save that records completion — a kill -9
+            # between this save and promote() resumes via
+            # _resume_promotion instead of silently orphaning the
+            # generation behind phase="idle"
+            phase=self._post_train_phase(instance_id),
             lastFullTrainAt=now_iso,
             lastTrainAt=now_iso,
             lastInstanceId=instance_id,
@@ -529,7 +734,7 @@ class ContinuousTrainer:
             )
             raise
         self._state.update(
-            phase="idle",
+            phase=self._post_train_phase(instance_id),
             lastTrainAt=_now().isoformat(),
             lastInstanceId=instance_id,
             trainedWatermark=wm.to_json(),

@@ -115,6 +115,30 @@ if ! timeout -k 10 420 env JAX_PLATFORMS=cpu \
     rc=1
 fi
 
+echo "== fleet smoke test (crash-safe fleet control plane, docs/scale_out.md) =="
+# kill -9 matrix: router mid-gate (abort to old generation) and
+# mid-watch (resume to new), promotion driver mid-promotion (token
+# idempotency: ONE fleet gate per generation), staged replica
+# mid-canary (gate veto) — all under continuous traffic with zero
+# non-200 final outcomes and convergence to exactly one serving
+# generation
+if ! timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    python scripts/fleet_smoke.py; then
+    echo "fleet smoke test FAILED"
+    rc=1
+fi
+
+echo "== fleet autoscaling ramp bench (docs/scale_out.md) =="
+# open-loop offered QPS doubles mid-run against the real router +
+# autoscaler: replicas scale 2->4, goodput follows, QPS-per-replica
+# stays within 25% across phases ($/QPS flat) — recorded to
+# SERVING_BENCH.json as serving_fleet_ramp
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu \
+    python scripts/serving_bench.py --ramp --smoke; then
+    echo "fleet ramp bench FAILED"
+    rc=1
+fi
+
 echo "== trainer smoke test (crash-safe continuous training, docs/training.md) =="
 # supervised trainer killed -9 mid-epoch resumes from checkpoint;
 # fold-in freshness recorded to SERVING_BENCH.json; corrupt artifact

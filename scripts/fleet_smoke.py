@@ -1,0 +1,513 @@
+"""Fleet control-plane smoke: every actor is kill-9-able mid-flight.
+
+The crash matrix docs/scale_out.md "Fleet promotion" promises, proven
+against real processes (all jax-free — the whole matrix runs in well
+under a CI minute of compute):
+
+1. **Router killed -9 during the fleet shadow gate** → the respawned
+   router re-adopts the replica set from its ``--state-file`` and
+   ABORTS the unproven swap to the old generation (the gate's evidence
+   died with the process); the staged candidate is retired via its
+   persisted pid.
+2. **Router killed -9 after promotion (regression watch)** → the
+   respawned router resumes the swap from the state file and completes
+   it: the fleet converges to the NEW generation and the standby
+   retires.
+3. **Promotion driver (the trainer's role) killed -9 mid-promotion**
+   → a respawned driver re-drives the SAME token; the router's
+   idempotent swap answers the existing record — exactly ONE swap,
+   ONE fleet gate firing, per generation.
+4. **Staged replica killed -9 mid-canary (while shadow-scored)** →
+   the gate vetoes the candidate; the old generation never stops
+   serving.
+
+Throughout every scenario, closed-loop traffic runs against the router
+with the stack's own cooperative-backpressure discipline (transport
+errors and 503+Retry-After are retried inside a per-request budget —
+exactly what ``client.py`` does) and must end every request in a 200:
+zero non-200 final outcomes, and the fleet converges to exactly one
+serving generation.
+
+Run by ``scripts/check.sh`` next to router_smoke.py / trainer_smoke.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+ROUTER_CHILD = os.path.join(REPO, "tests", "fleet_router_child.py")
+ADMIN_KEY = "fleet-smoke-key"
+
+failures: list[str] = []
+
+
+def check(cond: bool, label: str) -> None:
+    print(("ok   " if cond else "FAIL ") + label, flush=True)
+    if not cond:
+        failures.append(label)
+
+
+def http_json(url, body=None, headers=None, timeout=10, method=None):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(body).encode() if body is not None else None,
+        method=method or ("POST" if body is not None else "GET"),
+        headers=headers or {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read() or b"null"), resp.headers
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null"), e.headers
+
+
+class RouterProc:
+    """One router-child incarnation; respawn() keeps the port."""
+
+    def __init__(self, state_file: str, env: dict, port: int = 0,
+                 gate: bool = True):
+        self.state_file = state_file
+        self.env = env
+        self.gate = gate
+        self.proc: subprocess.Popen | None = None
+        self.port = port
+        self.spawn(port)
+
+    def spawn(self, port: int) -> None:
+        argv = [
+            sys.executable, ROUTER_CHILD,
+            "--port", str(port),
+            "--state-file", self.state_file,
+            "--admin-key", ADMIN_KEY,
+            "--min-replicas", "2",
+            "--max-replicas", "4",
+            "--replica-service-ms", "2",
+        ]
+        if self.gate:
+            argv.append("--gate")
+        proc = subprocess.Popen(
+            argv, env=self.env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        bound: list[int] = []
+
+        def _scan():
+            for line in proc.stdout:
+                if "router listening on" in line and not bound:
+                    bound.append(
+                        int(line.split("pid=")[0].rsplit(":", 1)[1])
+                    )
+
+        threading.Thread(target=_scan, daemon=True).start()
+        deadline = time.monotonic() + 60
+        while not bound and time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError("router child died at startup")
+            time.sleep(0.05)
+        if not bound:
+            proc.kill()
+            raise RuntimeError("router never printed its port")
+        self.proc = proc
+        self.port = bound[0]
+
+    @property
+    def base(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def sigkill(self) -> None:
+        os.kill(self.proc.pid, signal.SIGKILL)
+        self.proc.wait(timeout=10)
+
+    def respawn(self) -> None:
+        self.spawn(self.port)
+
+    def replica_pids(self) -> list[int]:
+        try:
+            _, status, _ = http_json(self.base + "/", timeout=5)
+            return [
+                r["pid"] for r in status.get("replicas", []) if r.get("pid")
+            ]
+        except OSError:
+            return []
+
+    def teardown(self) -> None:
+        pids = self.replica_pids()
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+        # adopted (slot-less) replicas survive a clean router exit;
+        # reap anything left
+        for pid in pids:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+
+class Traffic:
+    """Closed-loop drivers speaking the stack's retry discipline:
+    transport errors and 503+Retry-After (the router restarting, the
+    pool warming, backpressure) are retried inside a per-request
+    budget; everything else — and budget exhaustion — is a FINAL
+    outcome. Zero non-200 finals is the pass bar."""
+
+    def __init__(self, base: str, threads: int = 3,
+                 budget_s: float = 30.0):
+        self.base = base
+        self.budget_s = budget_s
+        self.stop = threading.Event()
+        self.outcomes: list[tuple[int, dict | None]] = []
+        self._lock = threading.Lock()
+        self._threads = [
+            threading.Thread(target=self._run, args=(i,), daemon=True)
+            for i in range(threads)
+        ]
+
+    def _one(self, x: int) -> tuple[int, dict | None]:
+        deadline = time.monotonic() + self.budget_s
+        while True:
+            try:
+                status, body, headers = http_json(
+                    f"{self.base}/queries.json", {"x": x}, timeout=10
+                )
+            except OSError as e:
+                if time.monotonic() > deadline:
+                    return -1, {"error": str(e)}
+                time.sleep(0.1)
+                continue
+            if status == 503 and headers.get("Retry-After") and (
+                time.monotonic() < deadline
+            ):
+                time.sleep(
+                    min(1.0, float(headers.get("Retry-After") or 0.2))
+                )
+                continue
+            return status, body
+
+    def _run(self, seed: int) -> None:
+        i = seed
+        while not self.stop.is_set():
+            i += 1
+            outcome = self._one(i % 100)
+            with self._lock:
+                self.outcomes.append(outcome)
+
+    def start(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def finish(self) -> list:
+        self.stop.set()
+        for t in self._threads:
+            t.join(timeout=60)
+        with self._lock:
+            return list(self.outcomes)
+
+
+def swap_record(base: str, token: str) -> dict:
+    """The swap record a token resolves to (idempotent re-drive)."""
+    _, record, _ = http_json(
+        f"{base}/admin/swap",
+        {"token": token, "generation": token},
+        headers={"X-PIO-Server-Key": ADMIN_KEY},
+    )
+    return record if isinstance(record, dict) else {}
+
+
+def wait_phase(base, token, phases, timeout_s=60.0) -> dict:
+    deadline = time.monotonic() + timeout_s
+    record: dict = {}
+    while time.monotonic() < deadline:
+        try:
+            record = swap_record(base, token)
+        except OSError:
+            time.sleep(0.2)
+            continue
+        if record.get("phase") in phases:
+            return record
+        time.sleep(0.1)
+    return record
+
+
+def wait_fleet(base, n, generation, timeout_s=60.0) -> dict:
+    """Wait for n healthy unstaged replicas, all of ``generation``."""
+    deadline = time.monotonic() + timeout_s
+    status: dict = {}
+    while time.monotonic() < deadline:
+        try:
+            _, status, _ = http_json(f"{base}/", timeout=5)
+        except OSError:
+            time.sleep(0.2)
+            continue
+        healthy = [
+            r for r in status.get("replicas", [])
+            if r["state"] == "healthy" and not r.get("staged")
+        ]
+        if len(healthy) >= n and all(
+            r["generation"] == generation for r in healthy
+        ):
+            return status
+        time.sleep(0.2)
+    return status
+
+
+def serving_generations(base) -> set:
+    _, status, _ = http_json(f"{base}/", timeout=5)
+    return {
+        r["generation"]
+        for r in status.get("replicas", [])
+        if r["state"] == "healthy" and not r.get("staged")
+    }
+
+
+def traffic_ok(outcomes, label) -> None:
+    non200 = [o for o in outcomes if o[0] != 200]
+    check(len(outcomes) > 20, f"{label}: traffic flowed ({len(outcomes)})")
+    check(
+        not non200,
+        f"{label}: zero non-200 final outcomes "
+        f"({len(outcomes)} requests, bad={non200[:3]})",
+    )
+
+
+def gate_env(**overrides) -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYTHONUNBUFFERED"] = "1"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    defaults = {
+        "PIO_CANARY_SAMPLE": "1.0",
+        "PIO_CANARY_MIN_SHADOW": "5",
+        "PIO_CANARY_MAX_DIVERGENCE": "0.05",
+        "PIO_CANARY_WATCH_MIN_REQUESTS": "5",
+        "PIO_CANARY_WATCH_S": "2.0",
+        "PIO_CANARY_SHADOW_TIMEOUT_S": "5.0",
+    }
+    defaults.update({k: str(v) for k, v in overrides.items()})
+    env.update(defaults)
+    return env
+
+
+def scenario(fn):
+    """Run one isolated scenario block with its own state dir."""
+    name = fn.__name__
+    print(f"\n== {name} ==", flush=True)
+    workdir = tempfile.mkdtemp(prefix=f"fleet-{name}-")
+    router = None
+    try:
+        router = fn(os.path.join(workdir, "fleet-state.json"))
+    except Exception as e:  # noqa: BLE001 - record, keep going
+        check(False, f"{name}: crashed: {e!r}")
+    finally:
+        if router is not None:
+            router.teardown()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def s1_router_killed_mid_gate(state_file) -> RouterProc:
+    # a gate that cannot promote inside the scenario window: the kill
+    # provably lands while the swap is still shadowing
+    router = RouterProc(
+        state_file, gate_env(PIO_CANARY_MIN_SHADOW=100000)
+    )
+    wait_fleet(router.base, 2, "g1")
+    traffic = Traffic(router.base).start()
+    record = swap_record(router.base, "g2")
+    check(bool(record.get("id")), "s1: swap driven (spawner-staged)")
+    record = wait_phase(router.base, "g2", ("shadowing",))
+    check(record.get("phase") == "shadowing", "s1: gate is shadowing")
+    time.sleep(1.0)  # mirrored samples flowing
+    print("s1: SIGKILL router mid-gate", flush=True)
+    router.sigkill()
+    time.sleep(0.5)
+    router.respawn()
+    record = wait_phase(router.base, "g2", ("failed",))
+    check(
+        record.get("phase") == "failed"
+        and "aborted" in (record.get("error") or ""),
+        f"s1: respawned router aborted the unproven swap "
+        f"({record.get('phase')}: {record.get('error')})",
+    )
+    status = wait_fleet(router.base, 2, "g1")
+    check(
+        serving_generations(router.base) == {"g1"},
+        f"s1: fleet converged to exactly generation g1 "
+        f"({[r['id'] for r in status.get('replicas', [])]})",
+    )
+    traffic_ok(traffic.finish(), "s1")
+    return router
+
+
+def s2_router_killed_mid_watch(state_file) -> RouterProc:
+    # a long regression watch: the kill provably lands after the gate
+    # promoted but before the swap is terminal
+    router = RouterProc(
+        state_file,
+        gate_env(PIO_CANARY_WATCH_S=8.0, PIO_CANARY_MIN_SHADOW=5),
+    )
+    wait_fleet(router.base, 2, "g1")
+    traffic = Traffic(router.base).start()
+    swap_record(router.base, "g2")
+    record = wait_phase(router.base, "g2", ("watching",))
+    check(
+        record.get("phase") == "watching",
+        f"s2: gate promoted, regression watch running "
+        f"({record.get('phase')})",
+    )
+    print("s2: SIGKILL router mid-watch", flush=True)
+    router.sigkill()
+    time.sleep(0.5)
+    router.respawn()
+    record = wait_phase(router.base, "g2", ("done",), timeout_s=90)
+    check(
+        record.get("phase") == "done",
+        f"s2: respawned router resumed and completed the swap "
+        f"({record.get('phase')}: {record.get('error')})",
+    )
+    wait_fleet(router.base, 2, "g2")
+    check(
+        serving_generations(router.base) == {"g2"},
+        "s2: fleet converged to exactly generation g2",
+    )
+    status, body, _ = http_json(
+        f"{router.base}/queries.json", {"x": 41}, timeout=10
+    )
+    check(
+        status == 200 and body.get("generation") == "g2",
+        f"s2: live prediction served by g2 ({status}, {body})",
+    )
+    traffic_ok(traffic.finish(), "s2")
+    return router
+
+
+_DRIVER = """
+import json, sys, time, urllib.request
+base, key, token = sys.argv[1], sys.argv[2], sys.argv[3]
+def call(path, body=None):
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(body).encode() if body is not None else None,
+        method="POST" if body is not None else "GET",
+    )
+    req.add_header("Content-Type", "application/json")
+    req.add_header("X-PIO-Server-Key", key)
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+rec = call("/admin/swap", {"token": token, "generation": token})
+print("driven " + rec["id"], flush=True)
+while rec["phase"] not in ("done", "failed", "rolled_back"):
+    time.sleep(0.2)
+    rec = call("/admin/swap/" + rec["id"])
+print("terminal " + rec["phase"], flush=True)
+"""
+
+
+def s3_trainer_killed_mid_promotion(state_file) -> RouterProc:
+    router = RouterProc(state_file, gate_env())
+    wait_fleet(router.base, 2, "g1")
+    traffic = Traffic(router.base).start()
+
+    def run_driver():
+        return subprocess.Popen(
+            [sys.executable, "-c", _DRIVER, router.base, ADMIN_KEY, "g2"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+
+    driver = run_driver()
+    driven = driver.stdout.readline()
+    check(driven.startswith("driven "), f"s3: driver opened the swap ({driven!r})")
+    print("s3: SIGKILL promotion driver", flush=True)
+    os.kill(driver.pid, signal.SIGKILL)
+    driver.wait(timeout=10)
+    # the respawned "trainer" re-drives the SAME token to completion
+    driver2 = run_driver()
+    out, _ = driver2.communicate(timeout=120)
+    check(
+        "terminal done" in out,
+        f"s3: respawned driver completed the promotion ({out.strip()!r})",
+    )
+    first_id = driven.split()[1]
+    second_id = [
+        line.split()[1] for line in out.splitlines()
+        if line.startswith("driven ")
+    ][0]
+    check(
+        first_id == second_id,
+        f"s3: both drives resolved to ONE swap ({first_id} == {second_id})"
+        " — the fleet gate fired exactly once for the generation",
+    )
+    _, status, _ = http_json(router.base + "/", timeout=5)
+    check(
+        status["swaps"]["completedTotal"] == 1,
+        f"s3: exactly one completed swap ({status['swaps']})",
+    )
+    wait_fleet(router.base, 2, "g2")
+    check(
+        serving_generations(router.base) == {"g2"},
+        "s3: fleet converged to exactly generation g2",
+    )
+    traffic_ok(traffic.finish(), "s3")
+    return router
+
+
+def s4_replica_killed_mid_canary(state_file) -> RouterProc:
+    router = RouterProc(
+        state_file, gate_env(PIO_CANARY_MIN_SHADOW=100000)
+    )
+    wait_fleet(router.base, 2, "g1")
+    traffic = Traffic(router.base).start()
+    swap_record(router.base, "g2")
+    wait_phase(router.base, "g2", ("shadowing",))
+    _, status, _ = http_json(router.base + "/", timeout=5)
+    staged = [r for r in status["replicas"] if r.get("staged")]
+    check(len(staged) == 1, f"s4: one staged candidate ({staged})")
+    print(f"s4: SIGKILL staged replica pid={staged[0]['pid']}", flush=True)
+    os.kill(staged[0]["pid"], signal.SIGKILL)
+    record = wait_phase(router.base, "g2", ("failed",), timeout_s=60)
+    check(
+        record.get("phase") == "failed",
+        f"s4: gate vetoed the dead candidate "
+        f"({record.get('phase')}: {record.get('error')})",
+    )
+    wait_fleet(router.base, 2, "g1")
+    check(
+        serving_generations(router.base) == {"g1"},
+        "s4: old generation never stopped serving (exactly g1)",
+    )
+    traffic_ok(traffic.finish(), "s4")
+    return router
+
+
+def main() -> int:
+    scenario(s1_router_killed_mid_gate)
+    scenario(s2_router_killed_mid_watch)
+    scenario(s3_trainer_killed_mid_promotion)
+    scenario(s4_replica_killed_mid_canary)
+    if failures:
+        print(f"\nfleet smoke: {len(failures)} check(s) FAILED")
+        for f in failures:
+            print(f"  FAIL {f}")
+        return 1
+    print("\nfleet smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,170 @@
+"""A jax-free replica process for fleet-control-plane smokes/benches.
+
+Where ``router_replica_child.py`` runs the full DASE pipeline behind a
+real :class:`EngineServer` (seconds of jax import per process), this
+child is the *fleet-shaped* minimum: the framework's own HTTP layer
+(``/healthz``, ``/metrics.json`` with a ``pio_warmup_complete`` gauge,
+SIGTERM lossless drain), a ``POST /queries.json`` route whose
+predictions carry the replica's ``generation`` and ``pid``, and a
+bounded-capacity service model — ``--capacity`` concurrent requests,
+``--service-ms`` each; excess load sheds 503 + ``Retry-After`` exactly
+like the admission controller, which is the saturation signal the
+router and the autoscaler scale on. It spawns in well under a second,
+so ``scripts/fleet_smoke.py`` can kill -9 and respawn whole fleets and
+``scripts/serving_bench.py --ramp`` can scale 2→4 replicas inside a CI
+budget.
+
+Behavior knobs for gate tests: ``--offset N`` shifts every result by N
+(a diverging candidate generation the fleet gate must reject);
+``--nan`` answers NaN predictions (immediate gate veto);
+``--fail-after-s S`` starts answering 500 S seconds after boot (a
+post-promotion regression the watch must roll back);
+``--warm-after-s S`` delays the warmup gauge.
+
+Prints ``replica listening on 127.0.0.1:<port> pid=<pid>`` once bound.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from predictionio_tpu.obs import MetricRegistry, tracing  # noqa: E402
+from predictionio_tpu.serving import admission, resilience  # noqa: E402
+from predictionio_tpu.serving.config import ServerConfig  # noqa: E402
+from predictionio_tpu.serving.http import (  # noqa: E402
+    HTTPServer,
+    Response,
+    Router,
+    install_metrics_routes,
+)
+
+
+def build_server(
+    generation: str,
+    *,
+    capacity: int = 8,
+    service_ms: float = 5.0,
+    offset: int = 0,
+    nan: bool = False,
+    warm_after_s: float = 0.0,
+    fail_after_s: float = 0.0,
+    registry: MetricRegistry | None = None,
+    port: int = 0,
+) -> HTTPServer:
+    registry = registry if registry is not None else MetricRegistry()
+    warm_gauge = registry.gauge(
+        "pio_warmup_complete",
+        "1 once every compile bucket warmed (fleet child: timed)",
+    )
+    started = time.monotonic()
+    if warm_after_s > 0:
+        warm_gauge.set_function(
+            lambda: 1.0
+            if time.monotonic() - started >= warm_after_s
+            else 0.0
+        )
+    else:
+        warm_gauge.set(1)
+    state = {"inflight": 0}
+    lock = threading.Lock()
+
+    def queries(request):
+        # bounded capacity: the replica's own backpressure, shaped
+        # exactly like the admission controller's shed (503 + hint +
+        # replay-safe marker) so the router marks it saturated
+        with lock:
+            if state["inflight"] >= capacity:
+                return Response(
+                    503,
+                    {"message": "replica at capacity"},
+                    headers={
+                        "Retry-After": admission.format_retry_after(
+                            max(0.05, service_ms / 1000.0)
+                        ),
+                        admission.SHED_HEADER: "overload",
+                    },
+                )
+            state["inflight"] += 1
+        try:
+            if service_ms:
+                time.sleep(service_ms / 1000.0)
+            if fail_after_s and (
+                time.monotonic() - started >= fail_after_s
+            ):
+                return Response(
+                    500, {"message": "injected post-warm regression"}
+                )
+            body = request.json()
+            x = body.get("x", 0) if isinstance(body, dict) else 0
+            result = float("nan") if nan else x + offset
+            return Response(
+                200,
+                {
+                    "result": result,
+                    "generation": generation,
+                    "pid": os.getpid(),
+                },
+            )
+        finally:
+            with lock:
+                state["inflight"] -= 1
+
+    router = Router()
+    router.route("POST", "/queries.json", queries)
+    router.route("POST", "/batch/queries.json", queries)
+    install_metrics_routes(
+        router, registry, tracing.get_tracer(),
+        server_config=ServerConfig.from_env(),
+    )
+    return HTTPServer(
+        router,
+        host="127.0.0.1",
+        port=port,
+        service=f"fleet-replica-{generation}",
+        registry=registry,
+    )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--generation", default="g1")
+    ap.add_argument("--capacity", type=int, default=8)
+    ap.add_argument("--service-ms", type=float, default=5.0)
+    ap.add_argument("--offset", type=int, default=0)
+    ap.add_argument("--nan", action="store_true")
+    ap.add_argument("--warm-after-s", type=float, default=0.0)
+    ap.add_argument("--fail-after-s", type=float, default=0.0)
+    args = ap.parse_args()
+
+    http = build_server(
+        args.generation,
+        capacity=args.capacity,
+        service_ms=args.service_ms,
+        offset=args.offset,
+        nan=args.nan,
+        warm_after_s=args.warm_after_s,
+        fail_after_s=args.fail_after_s,
+        port=args.port,
+    )
+    print(
+        f"replica listening on 127.0.0.1:{http.port} pid={os.getpid()}",
+        flush=True,
+    )
+    resilience.install_signal_drain(http)
+    try:
+        http.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,121 @@
+"""A killable fleet-control-plane router process for smoke tests.
+
+Runs a real :class:`~predictionio_tpu.serving.router.ServingRouter`
+with everything the fleet smoke needs to SIGKILL and respawn it:
+
+* ``--state-file`` — crash-safe replica-set + swap persistence, so a
+  respawned incarnation re-adopts the fleet and resumes (or safely
+  aborts) a mid-flight swap;
+* a :class:`~predictionio_tpu.serving.autoscaler.ReplicaAutoscaler`
+  spawning ``tests/fleet_replica_child.py`` processes (jax-free, sub-
+  second boot) through the shared worker supervisor;
+* the fleet shadow gate (``--gate``), tuned via the ``PIO_CANARY_*``
+  env the smoke sets before spawning this child.
+
+Prints ``router listening on 127.0.0.1:<port> pid=<pid>`` once bound.
+Killed -9, it leaves its replica processes orphaned-but-serving — the
+point: the next incarnation adopts them from the state file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from predictionio_tpu.serving import canary as canary_mod  # noqa: E402
+from predictionio_tpu.serving import resilience  # noqa: E402
+from predictionio_tpu.serving.autoscaler import (  # noqa: E402
+    AutoscalerConfig,
+    ReplicaAutoscaler,
+    ReplicaSpawner,
+)
+from predictionio_tpu.serving.config import ServerConfig  # noqa: E402
+from predictionio_tpu.serving.router import ServingRouter  # noqa: E402
+
+_CHILD = os.path.join(_REPO, "tests", "fleet_replica_child.py")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--state-file", required=True)
+    ap.add_argument("--admin-key", default="fleet-smoke-key")
+    ap.add_argument("--min-replicas", type=int, default=2)
+    ap.add_argument("--max-replicas", type=int, default=4)
+    ap.add_argument("--probe-interval", type=float, default=0.2)
+    ap.add_argument("--gate", action="store_true")
+    ap.add_argument("--gate-timeout", type=float, default=60.0)
+    ap.add_argument("--watch-timeout", type=float, default=30.0)
+    ap.add_argument("--initial-generation", default="g1")
+    ap.add_argument("--replica-capacity", type=int, default=8)
+    ap.add_argument("--replica-service-ms", type=float, default=5.0)
+    args = ap.parse_args()
+
+    import dataclasses
+
+    config = dataclasses.replace(
+        ServerConfig.from_env(),
+        key_auth_enforced=True,
+        access_key=args.admin_key,
+    )
+    router = ServingRouter(
+        probe_interval_s=args.probe_interval,
+        unhealthy_after=2,
+        failover_retries=1,
+        proxy_timeout_s=20.0,
+        server_config=config,
+        state_path=args.state_file,
+        state_max_age_s=300.0,
+        gate_config=(
+            canary_mod.CanaryConfig.from_env() if args.gate else None
+        ),
+        gate_timeout_s=args.gate_timeout,
+        watch_timeout_s=args.watch_timeout,
+    )
+    if not router.serving_generation:
+        # a cold fleet starts at the configured generation; a state
+        # adoption carries the real one
+        router._serving_generation = args.initial_generation
+    spawner = ReplicaSpawner(
+        [
+            sys.executable, _CHILD,
+            "--port", "{port}",
+            "--generation", "{generation}",
+            "--capacity", str(args.replica_capacity),
+            "--service-ms", str(args.replica_service_ms),
+        ],
+    )
+    autoscaler = ReplicaAutoscaler(
+        router,
+        spawner,
+        config=AutoscalerConfig(
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+            interval_s=0.3,
+            shrink_after_ticks=1000,  # smokes never scale down by idleness
+        ),
+    ).start()
+    http = router.serve(host="127.0.0.1", port=args.port)
+    print(
+        f"router listening on 127.0.0.1:{http.port} pid={os.getpid()}",
+        flush=True,
+    )
+    resilience.install_signal_drain(http)
+    try:
+        http.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        router.close()
+        # clean exits tear the owned replicas down; kill -9 (the smoke)
+        # skips this on purpose so the next incarnation adopts them
+        autoscaler.close(terminate=True, grace_s=10.0)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,353 @@
+"""Replica autoscaler (serving/autoscaler.py): reconciliation policy
+against a scripted router, spawner argv/banner mechanics, and the
+slot-ownership discipline that keeps the shared supervisor and the
+router's sticky drain from fighting over one process."""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from predictionio_tpu.obs import MetricRegistry
+from predictionio_tpu.serving.autoscaler import (
+    AutoscalerConfig,
+    ReplicaAutoscaler,
+    ReplicaSpawner,
+    SpawnError,
+)
+
+
+class FakeProc:
+    _pid = 5000
+
+    def __init__(self):
+        FakeProc._pid += 1
+        self.pid = FakeProc._pid
+        self.terminated = False
+
+    def poll(self):
+        return None
+
+    def terminate(self):
+        self.terminated = True
+
+
+class FakeLaunchSpawner:
+    """Duck-typed ReplicaSpawner: records launches, never forks."""
+
+    def __init__(self):
+        self.launches: list[tuple[str, int]] = []
+        self._port = 9000
+
+    def launch(self, generation, port=0):
+        self.launches.append((generation, port))
+        if port == 0:
+            self._port += 1
+            port = self._port
+        return FakeProc(), port
+
+
+class FakeReplicaEntry:
+    def __init__(self, replica_id, generation, staged):
+        self.replica_id = replica_id
+        self.generation = generation
+        self.staged = staged
+
+
+class ScriptedRouter:
+    """The router surface the autoscaler reconciles against."""
+
+    def __init__(self):
+        self.signals = {
+            "healthy": 0,
+            "warming": 0,
+            "draining": 0,
+            "unhealthy": 0,
+            "inflight": 0,
+            "saturated": 0,
+            "shedTotal": 0,
+            "swapActive": False,
+            "servingGeneration": "g1",
+        }
+        self.states: dict[str, str] = {}
+        self.added: list[FakeReplicaEntry] = []
+        self.retired: list[str] = []
+        self.spawner = None
+        self.status_fn = None
+
+    def attach_spawner(self, fn):
+        self.spawner = fn
+
+    def attach_autoscaler_status(self, fn):
+        self.status_fn = fn
+
+    def autoscaler_signals(self):
+        return dict(self.signals)
+
+    def replica_states(self):
+        return dict(self.states)
+
+    def add_replica(self, url, replica_id=None, generation="",
+                    pid=None, staged=False):
+        entry = FakeReplicaEntry(replica_id, generation, staged)
+        self.added.append(entry)
+        self.states[replica_id] = "healthy"
+        return entry
+
+    def retire(self, replica_id, wait=False):
+        if replica_id not in self.states:
+            return False
+        self.states.pop(replica_id)
+        self.retired.append(replica_id)
+        return True
+
+    def update_replica_pid(self, replica_id, pid):
+        return replica_id in self.states
+
+
+def make_scaler(router=None, **config_kw):
+    router = router or ScriptedRouter()
+    config_kw.setdefault("min_replicas", 1)
+    config_kw.setdefault("max_replicas", 4)
+    config_kw.setdefault("shrink_after_ticks", 2)
+    scaler = ReplicaAutoscaler(
+        router,
+        FakeLaunchSpawner(),
+        config=AutoscalerConfig(**config_kw),
+        registry=MetricRegistry(),
+    )
+    return router, scaler
+
+
+class TestReconcilePolicy:
+    def test_shed_grows_the_pool(self):
+        router, scaler = make_scaler()
+        router.signals.update(healthy=1, shedTotal=3)
+        assert scaler.reconcile_once() == "grow"
+        assert scaler.target == 2
+        assert [e.generation for e in router.added] == ["g1"]
+        assert not router.added[0].staged
+
+    def test_saturation_majority_grows_before_sheds(self):
+        router, scaler = make_scaler(saturation_fraction=0.5)
+        router.signals.update(healthy=2, saturated=1)
+        assert scaler.reconcile_once() == "grow"
+        assert scaler.target == 3  # max(target, actual=2) + 1
+
+    def test_growth_gates_on_current_warmup(self):
+        """One replica at a time: while a spawn is still warming, the
+        loop holds even under continued pressure."""
+        router, scaler = make_scaler()
+        router.signals.update(healthy=1, shedTotal=1)
+        assert scaler.reconcile_once() == "grow"
+        router.signals.update(healthy=1, warming=1, shedTotal=2)
+        scaler.target = 4
+        assert scaler.reconcile_once() == "idle"
+        assert len(router.added) == 1
+
+    def test_grow_deferred_while_generation_ambiguous(self):
+        """A mixed-generation pool with no explicit serving generation
+        (an ungated roll in flight) gives the spawn template an empty
+        generation — growing then would launch a wrong/default-model
+        replica into live selection. The loop defers instead."""
+        router, scaler = make_scaler()
+        router.signals.update(
+            healthy=1, shedTotal=3,
+            servingGeneration="", generationAmbiguous=True,
+        )
+        assert scaler.reconcile_once() == "idle"
+        assert router.added == []
+        # the roll converges: growth resumes at the settled generation
+        router.signals.update(
+            shedTotal=4, servingGeneration="g2",
+            generationAmbiguous=False,
+        )
+        assert scaler.reconcile_once() == "grow"
+        assert [e.generation for e in router.added] == ["g2"]
+
+    def test_shed_delta_not_absolute(self):
+        """A historical shed total must not grow the pool forever —
+        only NEW sheds since the last tick count."""
+        router, scaler = make_scaler()
+        router.signals.update(healthy=1, shedTotal=5)
+        assert scaler.reconcile_once() == "grow"
+        router.signals.update(healthy=2, warming=0, shedTotal=5)
+        assert scaler.reconcile_once() == "idle"
+        assert scaler.target == 2
+
+    def test_sustained_low_utilization_shrinks_losslessly(self):
+        router, scaler = make_scaler(
+            shrink_after_ticks=2, low_inflight_per_replica=0.5
+        )
+        # grow to 2 owned replicas first
+        router.signals.update(healthy=1, shedTotal=1)
+        scaler.reconcile_once()
+        router.signals.update(healthy=2, shedTotal=1, inflight=0)
+        assert scaler.reconcile_once() == "idle"  # low tick 1
+        action = scaler.reconcile_once()          # low tick 2 -> shrink
+        assert action == "shrink"
+        assert scaler.target == 1
+        # the newest owned replica retired through the router's sticky
+        # drain, and its slot stopped being supervised FIRST
+        assert router.retired == ["as-1"]
+        assert all(s.retired for s in scaler._slots)
+
+    def test_one_low_tick_is_not_enough(self):
+        router, scaler = make_scaler(shrink_after_ticks=3)
+        router.signals.update(healthy=1, shedTotal=1)
+        scaler.reconcile_once()
+        router.signals.update(healthy=2, inflight=0, shedTotal=1)
+        assert scaler.reconcile_once() == "idle"
+        # load returns: the shrink counter resets
+        router.signals.update(inflight=4)
+        scaler.reconcile_once()
+        assert scaler._low_ticks == 0
+
+    def test_swap_active_pauses_scaling_but_tops_up(self):
+        router, scaler = make_scaler()
+        scaler.target = 2
+        router.signals.update(
+            healthy=1, swapActive=True, shedTotal=9, inflight=0
+        )
+        assert scaler.reconcile_once() == "grow"  # top-up only
+        assert scaler.target == 2  # sheds did NOT raise the target
+        router.signals.update(healthy=2, swapActive=True)
+        assert scaler.reconcile_once() == "idle"  # and never shrinks
+
+    def test_prune_releases_externally_retired_replicas(self):
+        """A fleet swap rolling the old generation retires replicas
+        the autoscaler owns: their slots must stop respawning the
+        drained processes."""
+        router, scaler = make_scaler()
+        router.signals.update(healthy=1, shedTotal=1)
+        scaler.reconcile_once()
+        slot1 = scaler._owned["as-1"]
+        router.states.pop("as-1")  # swap drained it
+        router.signals.update(healthy=1, shedTotal=1, warming=0)
+        scaler.reconcile_once()
+        assert "as-1" not in scaler._owned
+        assert slot1.retired
+
+    def test_spawn_skips_ids_adopted_by_restarted_router(self):
+        """A restarted router re-adopts ``as-N`` replicas from its
+        state file while a FRESH autoscaler's counter restarts at 1:
+        the allocator must skip the adopted ids instead of colliding
+        (add_replica raises on a duplicate id, wasting the launched
+        process)."""
+        router = ScriptedRouter()
+        # the state file brought back two autoscaler-named replicas
+        router.states.update({"as-1": "healthy", "as-2": "healthy"})
+        router, scaler = make_scaler(router)
+        router.signals.update(healthy=2, shedTotal=3)
+        assert scaler.reconcile_once() == "grow"
+        assert [e.replica_id for e in router.added] == ["as-3"]
+        router, scaler = make_scaler()
+        replica = router.spawner("g2", True)
+        assert replica.staged and replica.generation == "g2"
+        assert replica.replica_id in scaler._owned
+
+    def test_target_clamped_to_bounds(self):
+        router, scaler = make_scaler(min_replicas=2, max_replicas=3)
+        assert scaler.target == 2
+        router.signals.update(healthy=3, saturated=3, shedTotal=1)
+        scaler.reconcile_once()
+        scaler.reconcile_once()
+        assert scaler.target == 3
+
+    def test_status_surface(self):
+        router, scaler = make_scaler()
+        status = router.status_fn()
+        assert status["target"] == scaler.target
+        assert status["min"] == 1 and status["max"] == 4
+
+
+class TestReplicaSpawner:
+    def test_argv_substitution(self):
+        spawner = ReplicaSpawner(
+            ["python", "child.py", "--port", "{port}",
+             "--generation", "{generation}"]
+        )
+        assert spawner.argv("g7", 8123) == [
+            "python", "child.py", "--port", "8123",
+            "--generation", "g7",
+        ]
+
+    def test_empty_template_rejected(self):
+        with pytest.raises(ValueError):
+            ReplicaSpawner([])
+
+    def test_launch_parses_banner_port(self):
+        script = textwrap.dedent(
+            """
+            import sys, time
+            print("x listening on 127.0.0.1:4321 pid=9", flush=True)
+            time.sleep(30)
+            """
+        )
+        spawner = ReplicaSpawner(
+            [sys.executable, "-c", script], spawn_timeout_s=30
+        )
+        proc, port = spawner.launch("g1", port=0)
+        try:
+            assert port == 4321
+        finally:
+            proc.kill()
+            proc.wait(timeout=10)
+
+    def test_launch_explicit_port_skips_banner(self):
+        spawner = ReplicaSpawner(
+            [sys.executable, "-c", "import time; time.sleep(30)"]
+        )
+        proc, port = spawner.launch("g1", port=7777)
+        try:
+            assert port == 7777
+        finally:
+            proc.kill()
+            proc.wait(timeout=10)
+
+    def test_dead_child_raises_spawn_error(self):
+        spawner = ReplicaSpawner(
+            [sys.executable, "-c", "import sys; sys.exit(3)"],
+            spawn_timeout_s=30,
+        )
+        with pytest.raises(SpawnError, match="rc=3"):
+            spawner.launch("g1", port=0)
+
+    def test_bannerless_child_times_out(self):
+        spawner = ReplicaSpawner(
+            [sys.executable, "-c",
+             "import time; print('no banner'); time.sleep(30)"],
+            spawn_timeout_s=0.5,
+        )
+        with pytest.raises(SpawnError, match="never printed"):
+            spawner.launch("g1", port=0)
+
+
+class TestConfig:
+    def test_from_env_defaults(self, monkeypatch):
+        for k in list(dict(**__import__("os").environ)):
+            if k.startswith("PIO_AUTOSCALE"):
+                monkeypatch.delenv(k, raising=False)
+        cfg = AutoscalerConfig.from_env()
+        assert cfg.min_replicas == 1 and cfg.max_replicas == 4
+
+    def test_from_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("PIO_AUTOSCALE_MIN", "2")
+        monkeypatch.setenv("PIO_AUTOSCALE_MAX", "8")
+        monkeypatch.setenv("PIO_AUTOSCALE_SHRINK_TICKS", "3")
+        cfg = AutoscalerConfig.from_env()
+        assert cfg.min_replicas == 2
+        assert cfg.max_replicas == 8
+        assert cfg.shrink_after_ticks == 3
+
+
+def test_fake_proc_infra():
+    """The FakeProc pid counter must keep fixtures distinguishable."""
+    assert FakeProc().pid != FakeProc().pid
+
+
+def _unused(*_a):  # keep subprocess import honest for linters
+    return subprocess

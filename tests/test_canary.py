@@ -64,6 +64,21 @@ class TestDivergence:
         assert contains_nan(float("inf"))
         assert not contains_nan({"x": [1.0, "a", None, True]})
 
+    def test_strip_volatile_drops_provenance_keys(self):
+        """The fleet gate compares predictions from two different
+        replica PROCESSES: pid/generation/prId identify who answered,
+        not what the model predicted, and must not score as
+        divergence."""
+        from predictionio_tpu.serving.canary import strip_volatile
+
+        old = {"result": 7, "pid": 111, "generation": "g1", "prId": "a"}
+        new = {"result": 7, "pid": 222, "generation": "g2", "prId": "b"}
+        assert divergence(
+            strip_volatile(old), strip_volatile(new)
+        ) == 0.0
+        # non-dict predictions pass through whole
+        assert strip_volatile([1, 2, 3]) == [1, 2, 3]
+
 
 def _wait_decision(canary, timeout=10.0):
     deadline = time.monotonic() + timeout
@@ -93,6 +108,25 @@ class TestShadowCanaryUnit:
             canary.observe({"q": 1}, {"score": 1.0}, 0.001)
         assert _wait_decision(canary) == "promote"
         assert "gate passed" in canary.reason
+
+    def test_non_comparable_served_prediction_never_sampled(self):
+        """ok=True with prediction=None (e.g. a 4xx answered upstream
+        of the model on the router's fleet-gate path) may feed the
+        latency baseline but must never enter the shadow sampler:
+        divergence needs BOTH sides, and mirroring the query would
+        score the candidate against content nobody predicted."""
+        scored = []
+        canary = self._canary(
+            lambda q: scored.append(q) or {"score": 1.0}
+        )
+        for _ in range(20):
+            canary.observe({"q": 1}, None, 0.001, ok=True)
+        assert canary.take_decision() is None
+        # comparable traffic still drives the gate to its verdict
+        for _ in range(3):
+            canary.observe({"q": 1}, {"score": 1.0}, 0.001)
+        assert _wait_decision(canary) == "promote"
+        assert scored == [{"q": 1}] * 3
 
     def test_nan_rejects_immediately(self):
         canary = self._canary(lambda q: {"score": float("nan")})

@@ -658,3 +658,67 @@ class TestDeployFlags:
             "deploy", "--variant", "nope.json", "--max-wait-ms", "-5"
         )
         assert code != 0 and "max-wait-ms" in err
+
+
+class TestFleetCLI:
+    def test_router_parser_fleet_flags(self):
+        from predictionio_tpu.cli.main import build_parser
+
+        args = build_parser().parse_args([
+            "router", "--state-file", "/tmp/fleet.json", "--fleet-gate",
+            "--spawn-replica",
+            "python tests/fleet_replica_child.py --port {port} "
+            "--generation {generation}",
+            "--min-replicas", "2", "--max-replicas", "5",
+            "--state-max-age", "120",
+        ])
+        assert args.state_file == "/tmp/fleet.json"
+        assert args.fleet_gate
+        assert "{port}" in args.spawn_replica
+        assert args.min_replicas == 2 and args.max_replicas == 5
+        assert args.state_max_age == 120.0
+
+    def test_trainer_parser_router_flags(self):
+        from predictionio_tpu.cli.main import build_parser
+
+        args = build_parser().parse_args([
+            "trainer", "--app", "a",
+            "--router-url", "http://router:8100",
+            "--router-key", "k", "--promote-timeout", "42",
+        ])
+        assert args.router_url == "http://router:8100"
+        assert args.router_key == "k"
+        assert args.promote_timeout == 42.0
+
+    def test_status_router_url_prints_fleet_summary(self, cli):
+        from predictionio_tpu.obs import MetricRegistry
+        from predictionio_tpu.serving.router import ServingRouter
+
+        router = ServingRouter(
+            probe_interval_s=999.0, registry=MetricRegistry()
+        )
+        router.add_replica(
+            "http://127.0.0.1:9001", replica_id="a", generation="g1"
+        )
+        http = router.serve(host="127.0.0.1", port=0)
+        http.start()
+        try:
+            code, out, _ = cli(
+                "status", "--router-url",
+                f"http://127.0.0.1:{http.port}",
+            )
+            assert code == 0
+            assert "fleet: replicas=1" in out
+            assert "generation=g1" in out
+            assert "swap=none" in out
+            # the metrics scrape rides along (router gauges visible)
+            assert "pio_router_replica_healthy" in out
+        finally:
+            router.close()
+            http.shutdown()
+
+    def test_status_router_url_rejects_non_router(self, cli):
+        code, _out, err = cli(
+            "status", "--router-url", "http://127.0.0.1:1"
+        )
+        assert code == 1 and "ERROR" in err

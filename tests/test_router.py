@@ -615,6 +615,30 @@ class TestAdminRoutes:
         )
         assert status == 409
 
+    def test_spawnerless_urlless_swap_is_a_400_misconfiguration(self):
+        """A swap body with no url on a router without --spawn-replica
+        is a permanent misconfiguration: it must answer 400 so the
+        trainer fails fast, not 409 (its retry-shortly signal — which
+        would stall every promotion for the full promote budget)."""
+        a = FakeReplica("a")
+        router = make_router(a)
+        http = router.serve(host="127.0.0.1", port=0)
+        http.start()
+        base = f"http://127.0.0.1:{http.port}"
+        try:
+            status, body, _ = post(
+                base, "/admin/swap",
+                {"generation": "g2", "token": "gen-g2"},
+            )
+            assert status == 400
+            assert "spawn" in body["message"]
+            # the token was never reserved by the refused request
+            assert "gen-g2" not in router._swap_tokens
+        finally:
+            router.close()
+            http.shutdown()
+            a.close()
+
     def test_retire_via_delete(self, gated):
         router, base, rep = gated
         key = {"X-PIO-Server-Key": "sekrit"}
@@ -790,3 +814,876 @@ class TestSaturationBackpressure:
         finally:
             router.close()
             http.shutdown()
+
+
+# -- fleet control plane ----------------------------------------------------
+
+
+class GateReplica(FakeReplica):
+    """FakeReplica whose predictions carry only model-comparable
+    content — the fleet gate compares bodies across replica processes,
+    so the fixture must not leak its own name into the divergence."""
+
+    def __init__(self, name: str, warm: float = 1.0, offset: int = 0):
+        self.offset = offset
+        self.nan_result = False
+        super().__init__(name, warm=warm)
+
+    def _queries(self, request) -> Response:
+        with self._lock:
+            self.calls += 1
+            if self.fail_next > 0:
+                self.fail_next -= 1
+                raise HTTPError(500, "injected replica failure")
+        q = json.loads(request.body)
+        if isinstance(q, list):
+            # the real engine server's shape contract: a batch body on
+            # the single-query route is a 400, /batch answers a list
+            if request.path == "/queries.json":
+                return Response(
+                    400, {"message": "query must be a JSON object"}
+                )
+            return Response(
+                200,
+                [
+                    {"result": item.get("x", 0) + self.offset}
+                    for item in q
+                ],
+            )
+        value = (
+            float("nan")
+            if self.nan_result
+            else q.get("x", 0) + self.offset
+        )
+        return Response(200, {"result": value})
+
+
+def gate_cfg(**kw):
+    from predictionio_tpu.serving import canary as canary_mod
+
+    defaults = dict(
+        shadow_sample=1.0,
+        min_shadow=3,
+        max_divergence=0.05,
+        watch_min_requests=2,
+        watch_s=0.3,
+        shadow_timeout_s=5.0,
+        # fake replicas answer in ~ms, so a single scheduler hiccup on
+        # a loaded CI box breaches the production 3x latency factor;
+        # rollback tests drive the error path instead
+        latency_factor=50.0,
+    )
+    defaults.update(kw)
+    return canary_mod.CanaryConfig(**defaults)
+
+
+def pump_until(base, record, phases, timeout_s=30.0, on_phase=None):
+    """POST queries through the router until the swap record reaches
+    one of ``phases``; ``on_phase(phase)`` fires on every transition."""
+    seen = set()
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        phase = record.get("phase")
+        if phase not in seen:
+            seen.add(phase)
+            if on_phase is not None:
+                on_phase(phase)
+        if phase in phases:
+            return seen
+        post(base, "/queries.json", {"x": 7}, timeout=10)
+        time.sleep(0.01)
+    return seen
+
+
+class TestFleetGate:
+    def _serve(self, router):
+        http = router.serve(host="127.0.0.1", port=0)
+        http.start()
+        return http, f"http://127.0.0.1:{http.port}"
+
+    def test_gated_swap_shadow_promotes_then_stabilizes(self):
+        """The full fleet promotion: staged replica takes NO live
+        traffic while shadowing, the divergence gate promotes, the old
+        replica parks as standby through the watch, and a clean window
+        retires it."""
+        a, b = GateReplica("a"), GateReplica("b")
+        router = make_router(
+            a,
+            failover_retries=0,
+            gate_config=gate_cfg(),
+            gate_timeout_s=30.0,
+            watch_timeout_s=20.0,
+        )
+        http, base = self._serve(router)
+        try:
+            assert wait_for(
+                lambda: router.replica_states().get("a") == HEALTHY
+            )
+            record = router.rolling_swap(
+                b.url, generation="g2", replica_id="b"
+            )
+            seen = pump_until(
+                base, record, ("done", "failed", "rolled_back")
+            )
+            assert record["phase"] == "done", record
+            assert "shadowing" in seen
+            assert record["standby"] == "a"
+            assert "a" in record["retired"]
+            assert router.replica_states() == {"b": HEALTHY}
+            assert router.serving_generation == "g2"
+            # the recorded gate proves real shadow comparisons ran
+            assert record["gate"]["shadowSamples"] >= 3
+            assert record["gate"]["meanDivergence"] <= 0.05
+            status, body, _ = post(base, "/queries.json", {"x": 9})
+            assert status == 200 and body["result"] == 9
+        finally:
+            router.close()
+            http.shutdown()
+            a.close()
+            b.close()
+
+    def test_gated_swap_rejects_divergent_candidate(self):
+        """A candidate whose predictions diverge is refused at the ONE
+        fleet gate: the old generation keeps serving untouched."""
+        a = GateReplica("a")
+        b = GateReplica("b", offset=1000)  # always-diverging model
+        router = make_router(
+            a,
+            failover_retries=0,
+            gate_config=gate_cfg(),
+            gate_timeout_s=30.0,
+        )
+        http, base = self._serve(router)
+        try:
+            assert wait_for(
+                lambda: router.replica_states().get("a") == HEALTHY
+            )
+            record = router.rolling_swap(
+                b.url, generation="g2", replica_id="b"
+            )
+            pump_until(base, record, ("done", "failed", "rolled_back"))
+            assert record["phase"] == "failed", record
+            assert "fleet gate refused" in record["error"]
+            assert wait_for(
+                lambda: router.replica_states() == {"a": HEALTHY}
+            )
+            assert router.serving_generation == ""
+            assert counter_value(
+                router._registry, "pio_router_swaps_total",
+                outcome="failed",
+            ) == 1
+        finally:
+            router.close()
+            http.shutdown()
+            a.close()
+            b.close()
+
+    def test_nan_candidate_vetoed_immediately(self):
+        a = GateReplica("a")
+        b = GateReplica("b")
+        b.nan_result = True
+        router = make_router(
+            a, failover_retries=0, gate_config=gate_cfg(),
+            gate_timeout_s=30.0,
+        )
+        http, base = self._serve(router)
+        try:
+            assert wait_for(
+                lambda: router.replica_states().get("a") == HEALTHY
+            )
+            record = router.rolling_swap(
+                b.url, generation="g2", replica_id="b"
+            )
+            pump_until(base, record, ("done", "failed", "rolled_back"))
+            assert record["phase"] == "failed"
+            assert "NaN" in record["error"]
+        finally:
+            router.close()
+            http.shutdown()
+            a.close()
+            b.close()
+
+    def test_post_promotion_regression_rolls_fleet_back(self):
+        """The new generation passes the gate, then regresses in
+        production: the watch rolls the WHOLE fleet back to the parked
+        standby — users end on the last-good generation."""
+        a, b = GateReplica("a"), GateReplica("b")
+        router = make_router(
+            a,
+            failover_retries=0,
+            gate_config=gate_cfg(watch_s=3.0, watch_min_requests=2),
+            gate_timeout_s=30.0,
+            watch_timeout_s=30.0,
+        )
+        http, base = self._serve(router)
+        try:
+            assert wait_for(
+                lambda: router.replica_states().get("a") == HEALTHY
+            )
+            record = router.rolling_swap(
+                b.url, generation="g2", replica_id="b"
+            )
+
+            def on_phase(phase):
+                if phase == "watching":
+                    # the promoted generation starts failing
+                    b.fail_next = 10**6
+
+            seen = pump_until(
+                base, record, ("done", "failed", "rolled_back"),
+                on_phase=on_phase,
+            )
+            assert record["phase"] == "rolled_back", (record, seen)
+            # standby readmitted, rejected generation drained
+            assert wait_for(
+                lambda: router.replica_states().get("a") == HEALTHY
+            )
+            assert "b" not in router.replica_states()
+            assert router.serving_generation == ""
+            assert wait_for(
+                lambda: post(base, "/queries.json", {"x": 3})[0] == 200
+            )
+            assert counter_value(
+                router._registry, "pio_router_swaps_total",
+                outcome="rolled_back",
+            ) == 1
+        finally:
+            router.close()
+            http.shutdown()
+            a.close()
+            b.close()
+
+
+class TestSwapIdempotency:
+    def test_same_token_drives_one_swap(self):
+        a, b = FakeReplica("a"), FakeReplica("b")
+        router = make_router(a)
+        try:
+            assert wait_for(
+                lambda: router.replica_states().get("a") == HEALTHY
+            )
+            first = router.rolling_swap(
+                b.url, generation="g2", replica_id="b",
+                wait=True, token="gen-2",
+            )
+            assert first["phase"] == "done"
+            # a respawned trainer re-drives the same token: the
+            # existing record answers; no second swap, no second gate
+            replay = router.rolling_swap(
+                b.url, generation="g2", replica_id="b2",
+                wait=True, token="gen-2",
+            )
+            assert replay is first
+            assert counter_value(
+                router._registry, "pio_router_swaps_total", outcome="ok"
+            ) == 1
+        finally:
+            router.close()
+            a.close()
+            b.close()
+
+    def test_http_replay_answers_200_with_same_record(self):
+        a, b = FakeReplica("a"), FakeReplica("b")
+        router = make_router(a)
+        http = router.serve(host="127.0.0.1", port=0)
+        http.start()
+        base = f"http://127.0.0.1:{http.port}"
+        try:
+            assert wait_for(
+                lambda: router.replica_states().get("a") == HEALTHY
+            )
+            status, rec1, _ = post(
+                base, "/admin/swap",
+                {"url": b.url, "generation": "g2", "id": "b",
+                 "token": "gen-2"},
+            )
+            assert status == 202
+            status, rec2, _ = post(
+                base, "/admin/swap",
+                {"url": b.url, "generation": "g2", "token": "gen-2"},
+            )
+            assert status == 200
+            assert rec2["id"] == rec1["id"]
+        finally:
+            router.close()
+            http.shutdown()
+            a.close()
+            b.close()
+
+
+class TestFleetGateTrafficShapes:
+    def test_batch_traffic_never_vetoes_the_fleet_gate(self):
+        """Batch bodies are not shadow-comparable: mirroring one onto
+        the staged replica's single-query route would 400 and score as
+        a bogus model exception. Batch traffic must ride through a
+        gated swap without feeding the sampler — the gate still
+        promotes on the single-query samples."""
+        a, b = GateReplica("a"), GateReplica("b")
+        router = make_router(
+            a,
+            failover_retries=0,
+            gate_config=gate_cfg(),
+            gate_timeout_s=30.0,
+            watch_timeout_s=20.0,
+        )
+        http = router.serve(host="127.0.0.1", port=0)
+        http.start()
+        base = f"http://127.0.0.1:{http.port}"
+        try:
+            assert wait_for(
+                lambda: router.replica_states().get("a") == HEALTHY
+            )
+            record = router.rolling_swap(
+                b.url, generation="g2", replica_id="b"
+            )
+            assert wait_for(lambda: record["phase"] == "shadowing")
+            for i in range(5):
+                status, body, _ = post(
+                    base, "/batch/queries.json", [{"x": i}]
+                )
+                assert status == 200 and body == [{"result": i}]
+            pump_until(base, record, ("done", "failed", "rolled_back"))
+            assert record["phase"] == "done", record
+        finally:
+            router.close()
+            http.shutdown()
+            a.close()
+            b.close()
+
+    def test_graceful_close_mid_watch_leaves_watch_resumable(self):
+        """A clean shutdown mid-regression-watch must be no less safe
+        than a kill -9 there: the swap stays in "watching" with the
+        rollback standby parked (not retired), so the restart resumes
+        the watch instead of inheriting a finalized promotion whose
+        safety net was destroyed."""
+        a, b = GateReplica("a"), GateReplica("b")
+        router = make_router(
+            a,
+            failover_retries=0,
+            gate_config=gate_cfg(
+                watch_min_requests=10_000, watch_s=30.0
+            ),
+            gate_timeout_s=30.0,
+            watch_timeout_s=60.0,
+        )
+        http = router.serve(host="127.0.0.1", port=0)
+        http.start()
+        base = f"http://127.0.0.1:{http.port}"
+        try:
+            assert wait_for(
+                lambda: router.replica_states().get("a") == HEALTHY
+            )
+            record = router.rolling_swap(
+                b.url, generation="g2", replica_id="b"
+            )
+            pump_until(base, record, ("watching",))
+            assert record["phase"] == "watching"
+            router.close()
+            assert wait_for(lambda: router._fleet_gate is None)
+            assert record["phase"] == "watching"
+            assert record["standby"] == "a"
+            assert "a" not in record["retired"]
+            with router._lock:
+                assert router._replicas["a"].state != RETIRED
+        finally:
+            router.close()
+            http.shutdown()
+            a.close()
+            b.close()
+
+
+class TestGatedSwapExclusivity:
+    def test_second_gated_swap_refused_while_first_in_flight(self):
+        """The fleet gate is a singleton: while one gated swap is
+        non-terminal, a DIFFERENT generation's swap is refused (409 on
+        the wire) instead of cross-consuming the live gate's verdict —
+        only the same token replays to the in-flight record."""
+        a, b, c = GateReplica("a"), GateReplica("b"), GateReplica("c")
+        router = make_router(
+            a,
+            failover_retries=0,
+            gate_config=gate_cfg(min_shadow=10_000),
+            gate_timeout_s=30.0,
+        )
+        try:
+            assert wait_for(
+                lambda: router.replica_states().get("a") == HEALTHY
+            )
+            record = router.rolling_swap(
+                b.url, generation="g2", replica_id="b", token="gen-2"
+            )
+            assert wait_for(lambda: record["phase"] == "shadowing")
+            with pytest.raises(ValueError, match="one fleet gate"):
+                router.rolling_swap(
+                    c.url, generation="g3", replica_id="c",
+                    token="gen-3",
+                )
+            # the refused candidate never joined the pool, and its
+            # token reservation was released with it
+            assert "c" not in router.replica_states()
+            assert "gen-3" not in router._swap_tokens
+            # the same token still replays to the in-flight record
+            replay = router.rolling_swap(
+                b.url, generation="g2", token="gen-2"
+            )
+            assert replay is record
+        finally:
+            router.close()
+            a.close()
+            b.close()
+            c.close()
+
+
+class TestAutoscalerSignals:
+    def test_serving_generation_inferred_without_fleet_swap(self):
+        """A fleet that never ran a gated swap has no explicitly
+        tracked generation; the signal bundle must carry the INFERRED
+        one — the autoscaler substitutes it into the spawn template,
+        and "" would launch replicas with the wrong/default model."""
+        router = make_router(probe_interval_s=999.0)
+        try:
+            router.add_replica(
+                "http://127.0.0.1:9001", replica_id="a", generation="g1"
+            )
+            router.add_replica(
+                "http://127.0.0.1:9002", replica_id="b", generation="g1"
+            )
+            assert (
+                router.autoscaler_signals()["servingGeneration"] == "g1"
+            )
+            # mixed pool: no single answer — stays empty, never a guess
+            router.add_replica(
+                "http://127.0.0.1:9003", replica_id="c", generation="g9"
+            )
+            assert (
+                router.autoscaler_signals()["servingGeneration"] == ""
+            )
+        finally:
+            router.close()
+
+    def test_resumed_roll_never_retires_its_standby(self):
+        """The standby is POPPED from the victims when parked, never
+        appended to record["retired"]: a roll resumed after a restart
+        must still exclude it on the explicit-retire-list path, or the
+        rollback standby itself gets retired."""
+        router = make_router(probe_interval_s=999.0)
+        try:
+            record = {
+                "id": "s1", "phase": "rolling", "generation": "g2",
+                "replica": "staged", "retire": ["a", "b"],
+                "retired": [], "standby": "a",
+            }
+            assert router._swap_victims(record) == ["b"]
+        finally:
+            router.close()
+
+
+class TestSwapHistoryBound:
+    def test_completed_swaps_garbage_collected_active_kept(self):
+        """Terminal swap records are bounded (keep last K) while
+        in-flight ones are NEVER evicted — the old fixed-size eviction
+        could drop an active swap's record mid-roll."""
+        from predictionio_tpu.serving.router import (
+            _SWAP_HISTORY_KEEP,
+            SWAP_TERMINAL_PHASES,
+        )
+
+        router = make_router(probe_interval_s=999.0)
+        try:
+            active = {"id": "live", "phase": "warming", "token": "tl"}
+            router._swaps["live"] = active
+            router._swap_tokens["tl"] = "live"
+            for i in range(_SWAP_HISTORY_KEEP + 10):
+                rec = {"id": f"s{i}", "phase": "done", "token": f"t{i}"}
+                router._swaps[f"s{i}"] = rec
+                router._swap_tokens[f"t{i}"] = f"s{i}"
+            closer = {"id": "closer", "phase": "watching", "token": None}
+            router._swaps["closer"] = closer
+            router._set_swap_phase(closer, "done")
+            terminal = [
+                s for s in router._swaps.values()
+                if s["phase"] in SWAP_TERMINAL_PHASES
+            ]
+            assert len(terminal) == _SWAP_HISTORY_KEEP
+            assert "live" in router._swaps           # active survived
+            assert router._swap_tokens["tl"] == "live"
+            # evicted records dropped their token mappings
+            assert "t0" not in router._swap_tokens
+            assert router._swaps_completed_total == 1
+        finally:
+            router.close()
+
+
+class TestStatePersistence:
+    def test_replica_set_readopted_on_restart(self, tmp_path):
+        path = str(tmp_path / "fleet.json")
+        r1 = make_router(probe_interval_s=999.0, state_path=path)
+        r1.add_replica(
+            "http://127.0.0.1:9001", replica_id="a",
+            generation="g1", pid=4242,
+        )
+        r1.add_replica(
+            "http://127.0.0.1:9002", replica_id="b", generation="g1"
+        )
+        r1.park("b")
+        r1.close()
+        r2 = make_router(probe_interval_s=999.0, state_path=path)
+        try:
+            assert set(r2.replica_states()) == {"a", "b"}
+            with r2._lock:
+                assert r2._replicas["a"].pid == 4242
+                assert r2._replicas["a"].generation == "g1"
+                # the parked standby stays parked: sticky drains
+                # survive the restart too
+                assert r2._replicas["b"].admin_draining
+                assert r2._replicas["b"].state == DRAINING
+            assert "adopted 2 replica" in r2._state_note
+        finally:
+            r2.close()
+
+    def test_stale_state_discarded_loudly(self, tmp_path):
+        path = str(tmp_path / "fleet.json")
+        r1 = make_router(probe_interval_s=999.0, state_path=path)
+        r1.add_replica("http://127.0.0.1:9001", replica_id="a")
+        r1.close()
+        # age the save stamp far past any adoption window
+        with open(path) as f:
+            doc = json.load(f)
+        doc["savedAtUtc"] = "2020-01-01T00:00:00+00:00"
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        r2 = make_router(
+            probe_interval_s=999.0, state_path=path,
+            state_max_age_s=60.0,
+        )
+        try:
+            assert r2.replica_states() == {}
+            assert "discarded" in r2._state_note
+            assert "old" in r2._state_note
+        finally:
+            r2.close()
+
+    def test_torn_state_discarded_loudly(self, tmp_path):
+        path = str(tmp_path / "fleet.json")
+        r1 = make_router(probe_interval_s=999.0, state_path=path)
+        r1.add_replica("http://127.0.0.1:9001", replica_id="a")
+        r1.close()
+        with open(path) as f:
+            doc = json.load(f)
+        doc["payload"]["servingGeneration"] = "tampered"
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        r2 = make_router(probe_interval_s=999.0, state_path=path)
+        try:
+            assert r2.replica_states() == {}
+            assert "checksum" in r2._state_note
+        finally:
+            r2.close()
+
+    def test_quiet_fleet_restamps_state_from_probe_loop(self, tmp_path):
+        """Membership/swap transitions are the only event-driven state
+        writers: a fleet that serves steadily for longer than the
+        adoption window would age its state file into "stale" and a
+        restart would discard a live fleet. The probe loop must
+        re-stamp the save periodically."""
+        path = str(tmp_path / "fleet.json")
+        r = make_router(
+            probe_interval_s=0.05, state_path=path,
+            state_max_age_s=0.3,  # re-stamp threshold = 0.1s
+        )
+        try:
+            r.add_replica("http://127.0.0.1:9001", replica_id="a")
+            with open(path) as f:
+                first = json.load(f)["savedAtUtc"]
+            # no transitions happen, only probes
+            assert wait_for(
+                lambda: json.load(open(path))["savedAtUtc"] != first,
+                timeout_s=5.0,
+            ), "probe loop never refreshed the state stamp"
+        finally:
+            r.close()
+
+    def test_missing_state_file_is_a_quiet_cold_start(self, tmp_path):
+        r = make_router(
+            probe_interval_s=999.0,
+            state_path=str(tmp_path / "never-written.json"),
+        )
+        try:
+            assert r._state_note == ""
+        finally:
+            r.close()
+
+    def test_cli_replica_flags_rejoin_adopted_fleet(self, tmp_path):
+        """`pio-tpu router --replica ... --state-file ...` restarted
+        within the adoption window: the CLI replica ids were already
+        adopted from the state file — create_router must skip them,
+        not crash the restart on a duplicate registration."""
+        from predictionio_tpu.serving.router import create_router
+
+        path = str(tmp_path / "fleet.json")
+        r1 = make_router(probe_interval_s=999.0, state_path=path)
+        r1.add_replica(
+            "http://127.0.0.1:9001", replica_id="r0", generation="g1"
+        )
+        r1.close()
+        router, http = create_router(
+            ["http://127.0.0.1:9001#g1"],
+            host="127.0.0.1",
+            port=0,
+            probe_interval_s=999.0,
+            state_path=path,
+            registry=MetricRegistry(),
+        )
+        http.start()
+        try:
+            assert set(router.replica_states()) == {"r0"}
+        finally:
+            router.close()
+            http.shutdown()
+
+    def test_completed_total_survives_restart(self, tmp_path):
+        """The lifetime completed-swap counter is persisted with the
+        records: after a restart the status route must not report
+        completedTotal=0 under completedKept>0 (a monitor diffing the
+        counter would see it go backwards)."""
+        path = str(tmp_path / "fleet.json")
+        a, b = FakeReplica("a"), FakeReplica("b")
+        r1 = make_router(a, state_path=path)
+        try:
+            assert wait_for(
+                lambda: r1.replica_states().get("a") == HEALTHY
+            )
+            done = r1.rolling_swap(
+                b.url, generation="g2", replica_id="b", wait=True
+            )
+            assert done["phase"] == "done"
+            assert r1._swaps_completed_total == 1
+        finally:
+            r1.close()
+        r2 = make_router(probe_interval_s=999.0, state_path=path)
+        try:
+            assert r2._swaps_completed_total == 1
+        finally:
+            r2.close()
+            a.close()
+            b.close()
+
+    def test_swap_resumed_from_rolling_after_restart(self, tmp_path):
+        """A router killed AFTER the gate passed (phase rolling /
+        draining-old) finishes the roll on restart: the fleet converges
+        to the new generation."""
+        path = str(tmp_path / "fleet.json")
+        a = FakeReplica("a")
+        b = FakeReplica("b")
+        r1 = make_router(probe_interval_s=999.0, state_path=path)
+        r1.add_replica(a.url, replica_id="a", generation="g1")
+        r1.add_replica(b.url, replica_id="b", generation="g2")
+        rec = {
+            "id": "s1", "token": "gen-2", "phase": "draining-old",
+            "generation": "g2", "fromGeneration": "g1",
+            "url": b.url, "replica": "b", "standby": None,
+            "gated": False, "retired": [], "retire": "others",
+            "warmTimeoutS": 10.0, "gate": None, "error": None,
+        }
+        r1._swaps["s1"] = rec
+        r1._swap_tokens["gen-2"] = "s1"
+        r1._persist_state()
+        r1.close()  # "kill": the swap thread never ran
+        r2 = make_router(state_path=path)
+        try:
+            assert wait_for(
+                lambda: r2._swaps["s1"]["phase"] == "done", timeout_s=15
+            ), r2._swaps["s1"]
+            assert r2._swaps["s1"]["retired"] == ["a"]
+            assert wait_for(
+                lambda: r2.replica_states() == {"b": HEALTHY}
+            )
+        finally:
+            r2.close()
+            a.close()
+            b.close()
+
+    def test_resumed_roll_with_dead_new_generation_rolls_back(
+        self, tmp_path
+    ):
+        """A crash that also took the NEW replica down (same-host
+        reboot) must not finish the roll — draining the old generation
+        would converge the fleet to zero capacity. The resume waits for
+        the promoted generation to re-prove itself; when it never does,
+        a gated swap rolls the fleet back to the old generation."""
+        path = str(tmp_path / "fleet.json")
+        a = FakeReplica("a")
+        r1 = make_router(probe_interval_s=999.0, state_path=path)
+        r1.add_replica(a.url, replica_id="a", generation="g1")
+        # the new-generation replica: registered, but nothing listens
+        r1.add_replica(
+            "http://127.0.0.1:9", replica_id="b", generation="g2"
+        )
+        rec = {
+            "id": "s1", "token": "gen-2", "phase": "rolling",
+            "generation": "g2", "fromGeneration": "g1",
+            "url": "http://127.0.0.1:9", "replica": "b",
+            "standby": None, "gated": True, "retired": [],
+            "retire": "others", "warmTimeoutS": 1.0, "gate": None,
+            "error": None,
+        }
+        r1._swaps["s1"] = rec
+        r1._swap_tokens["gen-2"] = "s1"
+        r1._persist_state()
+        r1.close()
+        r2 = make_router(state_path=path)
+        try:
+            assert wait_for(
+                lambda: r2._swaps["s1"]["phase"] == "rolled_back",
+                timeout_s=15,
+            ), r2._swaps["s1"]
+            assert "no 'g2' replica became healthy" in (
+                r2._swaps["s1"]["error"]
+            )
+            # the old generation was never drained and keeps serving
+            assert "a" not in r2._swaps["s1"]["retired"]
+            assert wait_for(
+                lambda: r2.replica_states() == {"a": HEALTHY}
+            )
+            assert r2.serving_generation == "g1"
+        finally:
+            r2.close()
+            a.close()
+
+    def test_resumed_ungated_drain_with_dead_new_generation_fails_safe(
+        self, tmp_path
+    ):
+        """Same crash shape for a plain (ungated) swap: there is no
+        rollback machinery, so the resume fails the swap — the old
+        generation keeps serving untouched."""
+        path = str(tmp_path / "fleet.json")
+        a = FakeReplica("a")
+        r1 = make_router(probe_interval_s=999.0, state_path=path)
+        r1.add_replica(a.url, replica_id="a", generation="g1")
+        r1.add_replica(
+            "http://127.0.0.1:9", replica_id="b", generation="g2"
+        )
+        rec = {
+            "id": "s1", "token": "gen-2", "phase": "draining-old",
+            "generation": "g2", "fromGeneration": "g1",
+            "url": "http://127.0.0.1:9", "replica": "b",
+            "standby": None, "gated": False, "retired": [],
+            "retire": "others", "warmTimeoutS": 1.0, "gate": None,
+            "error": None,
+        }
+        r1._swaps["s1"] = rec
+        r1._swap_tokens["gen-2"] = "s1"
+        r1._persist_state()
+        r1.close()
+        r2 = make_router(state_path=path)
+        try:
+            assert wait_for(
+                lambda: r2._swaps["s1"]["phase"] == "failed",
+                timeout_s=15,
+            ), r2._swaps["s1"]
+            assert wait_for(
+                lambda: r2.replica_states() == {"a": HEALTHY}
+            )
+        finally:
+            r2.close()
+            a.close()
+
+    def test_persisted_swap_snapshot_isolated_from_live_mutation(
+        self, tmp_path
+    ):
+        """The persisted payload must be a point-in-time deep copy: a
+        shallow snapshot would share nested objects (retired list, gate
+        dict) with live swap threads, whose later mutations could tear
+        the file against its own checksum."""
+        path = str(tmp_path / "fleet.json")
+        router = make_router(probe_interval_s=999.0, state_path=path)
+        try:
+            rec = {
+                "id": "s1", "token": None, "phase": "rolling",
+                "generation": "g2", "retired": [], "gate": None,
+            }
+            router._swaps["s1"] = rec
+            router._persist_state()
+            # live mutation AFTER the snapshot was written
+            rec["retired"].append("a")
+            rec["gate"] = {"shadowSamples": 3}
+            from predictionio_tpu.serving.router import RouterStateStore
+
+            payload, reason = RouterStateStore(path).load(
+                max_age_s=3600.0
+            )
+            assert reason == "" and payload is not None
+            (saved,) = payload["swaps"]
+            assert saved["retired"] == []
+            assert saved["gate"] is None
+        finally:
+            router.close()
+
+    def test_swap_aborted_from_shadowing_after_restart(self, tmp_path):
+        """A router killed BEFORE the gate passed aborts to the old
+        generation: the unproven candidate is retired, the fleet keeps
+        serving what it served."""
+        path = str(tmp_path / "fleet.json")
+        a = FakeReplica("a")
+        b = FakeReplica("b")
+        r1 = make_router(probe_interval_s=999.0, state_path=path)
+        r1.add_replica(a.url, replica_id="a", generation="g1")
+        staged = r1.add_replica(
+            b.url, replica_id="b", generation="g2", staged=True
+        )
+        assert staged.staged
+        rec = {
+            "id": "s1", "token": "gen-2", "phase": "shadowing",
+            "generation": "g2", "fromGeneration": "g1",
+            "url": b.url, "replica": "b", "standby": None,
+            "gated": True, "retired": [], "retire": "others",
+            "warmTimeoutS": 10.0, "gate": None, "error": None,
+        }
+        r1._swaps["s1"] = rec
+        r1._swap_tokens["gen-2"] = "s1"
+        r1._persist_state()
+        r1.close()
+        r2 = make_router(state_path=path)
+        try:
+            assert wait_for(
+                lambda: r2._swaps["s1"]["phase"] == "failed",
+                timeout_s=15,
+            ), r2._swaps["s1"]
+            assert "aborted" in r2._swaps["s1"]["error"]
+            assert wait_for(
+                lambda: r2.replica_states() == {"a": HEALTHY}
+            )
+            # the idempotency token still answers the aborted record —
+            # a resumed trainer learns the outcome instead of silently
+            # re-promoting
+            replay = r2.rolling_swap(
+                b.url, generation="g2", token="gen-2"
+            )
+            assert replay["id"] == "s1"
+        finally:
+            r2.close()
+            a.close()
+            b.close()
+
+    def test_staged_replica_takes_no_selection_traffic(self):
+        a = GateReplica("a")
+        b = GateReplica("b")
+        router = make_router(a, failover_retries=0)
+        http = router.serve(host="127.0.0.1", port=0)
+        http.start()
+        base = f"http://127.0.0.1:{http.port}"
+        try:
+            router.add_replica(b.url, replica_id="b", staged=True)
+            assert wait_for(
+                lambda: set(router.replica_states().values())
+                == {HEALTHY}
+            )
+            for i in range(10):
+                status, _, _ = post(base, "/queries.json", {"x": i})
+                assert status == 200
+            assert b.calls == 0  # healthy but staged: zero live traffic
+        finally:
+            router.close()
+            http.shutdown()
+            a.close()
+            b.close()

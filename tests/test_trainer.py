@@ -482,6 +482,322 @@ class TestFoldInEndToEnd:
         assert als_trainer.state["lastInstanceId"] != g1
 
 
+class FakeFleetRouter:
+    """A router-shaped HTTP server recording swap drives: the token
+    keys ONE record per generation (the real router's idempotency
+    contract), and the first status poll flips it to ``final_phase``."""
+
+    def __init__(
+        self, final_phase="done", initial_phase="warming",
+        forget_after_open=False,
+    ):
+        from predictionio_tpu.serving.http import (
+            HTTPServer,
+            Response,
+            Router,
+        )
+
+        self.final_phase = final_phase
+        self.initial_phase = initial_phase
+        #: simulate a router that restarted WITHOUT its state file
+        #: right after opening the swap: status polls answer 404
+        self.forget_after_open = forget_after_open
+        self.tokens: list[str] = []
+        self.keys: list[str | None] = []
+        self.swaps: dict[str, dict] = {}
+        router = Router()
+        router.route("POST", "/admin/swap", self._swap)
+        router.route("GET", "/admin/swap/<sid>", self._get)
+        self._response = Response
+        self.http = HTTPServer(router, host="127.0.0.1", port=0)
+        self.http.start()
+        self.url = f"http://127.0.0.1:{self.http.port}"
+
+    def _swap(self, request):
+        body = request.json()
+        token = body.get("token", "")
+        self.tokens.append(token)
+        self.keys.append(request.headers.get("X-PIO-Server-Key"))
+        record = self.swaps.get(token)
+        if record is None:
+            record = {
+                "id": f"swap-{len(self.swaps) + 1}",
+                "token": token,
+                "phase": self.initial_phase,
+                "generation": body.get("generation"),
+            }
+            self.swaps[token] = record
+            if self.forget_after_open:
+                del self.swaps[token]
+            return self._response(202, record)
+        return self._response(200, record)
+
+    def _get(self, request):
+        sid = request.path_params["sid"]
+        for record in self.swaps.values():
+            if record["id"] == sid:
+                record["phase"] = self.final_phase
+                return self._response(200, record)
+        return self._response(404, {"message": "unknown swap"})
+
+    def close(self):
+        self.http.shutdown()
+
+
+class TestFleetPromotion:
+    def test_publish_drives_router_swap_to_done(
+        self, memory_storage, ctx, tmp_path
+    ):
+        fleet = FakeFleetRouter()
+        try:
+            trainer = _fake_trainer(
+                memory_storage, ctx, tmp_path,
+                router_url=fleet.url, router_key="sekrit",
+            )
+            memory_storage.get_events().insert(_rate("u0", "i0"), 1)
+            assert trainer.poll_once() == "full"
+            generation = trainer.state["lastInstanceId"]
+            # ONE pipeline: the published generation was driven to the
+            # router with its id as the idempotency token
+            assert fleet.tokens == [generation]
+            assert fleet.keys[0] == "sekrit"
+            promo = trainer.state["lastPromotion"]
+            assert promo["generation"] == generation
+            assert promo["outcome"] == "done"
+            assert trainer.state["phase"] == "idle"
+            assert "promoteToken" not in trainer.state
+        finally:
+            fleet.close()
+
+    def test_respawn_mid_promotion_never_double_drives(
+        self, memory_storage, ctx, tmp_path
+    ):
+        """kill -9 between publish and promotion completion: the next
+        incarnation re-drives the SAME token, the router's idempotency
+        answers the existing record, and exactly one swap (one fleet
+        gate) exists for the generation."""
+        fleet = FakeFleetRouter()
+        try:
+            trainer = _fake_trainer(
+                memory_storage, ctx, tmp_path, router_url=fleet.url,
+            )
+            memory_storage.get_events().insert(_rate("u0", "i0"), 1)
+            trainer.poll_once()
+            generation = trainer.state["lastInstanceId"]
+            assert len(fleet.swaps) == 1
+            # simulate dying mid-promotion AFTER the swap was driven:
+            # the state file says "promoting" with the token committed
+            trainer._state.update(
+                phase="promoting", promoteToken=generation
+            )
+            trainer._save_state()
+            reborn = ContinuousTrainer(
+                _fake_engine(),
+                _fake_engine_params(),
+                engine_id="tr",
+                config=trainer._config,
+                storage=memory_storage,
+                ctx=ctx,
+            )
+            assert reborn.poll_once() == "idle"
+            # the token was re-driven (twice total) but resolves to the
+            # SAME swap — the fleet gate fired exactly once
+            assert fleet.tokens == [generation, generation]
+            assert len(fleet.swaps) == 1
+            assert reborn.state["phase"] == "idle"
+            assert reborn.state["lastPromotion"]["outcome"] == "done"
+        finally:
+            fleet.close()
+
+    def test_kill_between_completion_and_promote_is_resumed(
+        self, memory_storage, ctx, tmp_path
+    ):
+        """kill -9 in the gap AFTER full_train finalizes its state but
+        BEFORE promote() runs: the completion save itself must carry
+        phase="promoting" + the token (never a transient "idle"), so
+        the respawned trainer re-drives the promotion instead of
+        orphaning the published generation."""
+        fleet = FakeFleetRouter()
+        try:
+            trainer = _fake_trainer(
+                memory_storage, ctx, tmp_path, router_url=fleet.url,
+            )
+            events = memory_storage.get_events()
+            events.insert(_rate("u0", "i0"), 1)
+            wm = read_watermark(
+                events, trainer._app_id, trainer._channel_id
+            )
+            generation = trainer.full_train(wm)  # dies before promote()
+            assert fleet.tokens == []            # never driven...
+            # ...but the promotion debt is durable in the SAME save
+            # that recorded completion
+            assert trainer.state["phase"] == "promoting"
+            assert trainer.state["promoteToken"] == generation
+            reborn = ContinuousTrainer(
+                _fake_engine(),
+                _fake_engine_params(),
+                engine_id="tr",
+                config=trainer._config,
+                storage=memory_storage,
+                ctx=ctx,
+            )
+            assert reborn.poll_once() == "idle"
+            assert fleet.tokens == [generation]
+            assert reborn.state["phase"] == "idle"
+            assert reborn.state["lastPromotion"]["outcome"] == "done"
+        finally:
+            fleet.close()
+
+    def test_interrupted_publish_recovery_marks_promotion_pending(
+        self, memory_storage, ctx, tmp_path
+    ):
+        """A crash between run_train COMPLETING and promotion must not
+        orphan the generation: recovery re-queues the promotion."""
+        fleet = FakeFleetRouter()
+        try:
+            trainer = _fake_trainer(
+                memory_storage, ctx, tmp_path, router_url=fleet.url,
+            )
+            trainer._state.update(
+                phase="publishing",
+                lastInstanceId="ghost-gen",
+                pendingWatermark={"count": 1, "latestTime": ""},
+            )
+            trainer._save_state()
+            reborn = ContinuousTrainer(
+                _fake_engine(),
+                _fake_engine_params(),
+                engine_id="tr",
+                config=trainer._config,
+                storage=memory_storage,
+                ctx=ctx,
+            )
+            assert reborn.state["phase"] == "promoting"
+            assert reborn.state["promoteToken"] == "ghost-gen"
+            reborn.poll_once()
+            assert fleet.tokens == ["ghost-gen"]
+            assert reborn.state["phase"] == "idle"
+        finally:
+            fleet.close()
+
+    def test_rolled_back_outcome_recorded(
+        self, memory_storage, ctx, tmp_path
+    ):
+        fleet = FakeFleetRouter(final_phase="rolled_back")
+        try:
+            trainer = _fake_trainer(
+                memory_storage, ctx, tmp_path, router_url=fleet.url,
+            )
+            memory_storage.get_events().insert(_rate("u0", "i0"), 1)
+            trainer.poll_once()
+            assert (
+                trainer.state["lastPromotion"]["outcome"] == "rolled_back"
+            )
+            assert trainer.state["phase"] == "idle"
+        finally:
+            fleet.close()
+
+    def test_unreachable_router_does_not_wedge_training(
+        self, memory_storage, ctx, tmp_path
+    ):
+        trainer = _fake_trainer(
+            memory_storage, ctx, tmp_path,
+            router_url="http://127.0.0.1:1",  # nothing listens here
+        )
+        memory_storage.get_events().insert(_rate("u0", "i0"), 1)
+        assert trainer.poll_once() == "full"
+        assert trainer.state["lastPromotion"]["outcome"] == "unreachable"
+        assert trainer.state["phase"] == "idle"
+        # the NEXT generation still trains and re-attempts promotion
+        memory_storage.get_events().insert(_rate("u1", "i1"), 1)
+        assert trainer.poll_once() == "full"
+
+    def test_auth_refusal_reports_refused_not_unreachable(
+        self, memory_storage, ctx, tmp_path
+    ):
+        """HTTPError IS an OSError: a 401 from a misconfigured
+        --router-key must surface as 'refused' with the real status —
+        not be retried, and not masquerade as an unreachable router."""
+        from predictionio_tpu.serving.http import (
+            HTTPServer,
+            Response,
+            Router,
+        )
+
+        calls = []
+        router = Router()
+        router.route(
+            "POST", "/admin/swap",
+            lambda request: calls.append(1)
+            or Response(401, {"message": "server key required"}),
+        )
+        http = HTTPServer(router, host="127.0.0.1", port=0)
+        http.start()
+        try:
+            trainer = _fake_trainer(
+                memory_storage, ctx, tmp_path,
+                router_url=f"http://127.0.0.1:{http.port}",
+            )
+            assert trainer.promote("gen-1") == "refused"
+            assert len(calls) == 1
+            assert trainer.state["phase"] == "idle"
+        finally:
+            http.shutdown()
+
+    def test_busy_409_retried_until_the_gate_frees(
+        self, memory_storage, ctx, tmp_path
+    ):
+        """A 409 is the router's designed 'retry shortly' answer (a
+        rival gated swap holds the fleet gate, or this token's record
+        is mid-open): the trainer retries inside its promote budget
+        instead of dropping the promotion."""
+        from predictionio_tpu.serving.http import (
+            HTTPServer,
+            Response,
+            Router,
+        )
+
+        calls = []
+
+        def swap(request):
+            calls.append(1)
+            if len(calls) == 1:
+                return Response(
+                    409, {"message": "one fleet gate at a time"}
+                )
+            return Response(
+                202,
+                {
+                    "id": "swap-9",
+                    "phase": "done",
+                    "generation": request.json().get("generation"),
+                },
+            )
+
+        router = Router()
+        router.route("POST", "/admin/swap", swap)
+        http = HTTPServer(router, host="127.0.0.1", port=0)
+        http.start()
+        try:
+            trainer = _fake_trainer(
+                memory_storage, ctx, tmp_path,
+                router_url=f"http://127.0.0.1:{http.port}",
+            )
+            assert trainer.promote("gen-2") == "done"
+            assert len(calls) == 2
+        finally:
+            http.shutdown()
+
+    def test_no_router_configured_skips_promotion(
+        self, memory_storage, ctx, tmp_path
+    ):
+        trainer = _fake_trainer(memory_storage, ctx, tmp_path)
+        memory_storage.get_events().insert(_rate("u0", "i0"), 1)
+        assert trainer.poll_once() == "full"
+        assert trainer.promote("whatever") is None
+        assert "lastPromotion" not in trainer.state
+
+
 class TestCLIWiring:
     def test_trainer_parser(self):
         from predictionio_tpu.cli.main import build_parser

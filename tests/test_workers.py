@@ -102,11 +102,17 @@ class _FakeProc:
         self._clock = clock
         self.dies_at = dies_at
         self.rc = rc
+        self.terminated = False
 
     def poll(self):
         if self.dies_at is not None and self._clock() >= self.dies_at:
             return self.rc
         return None
+
+    def terminate(self):
+        self.terminated = True
+        self.dies_at = self._clock()
+        self.rc = -15
 
 
 class _Clock:
@@ -231,6 +237,136 @@ class TestRespawnBackoff:
             lambda: _FakeProc(clock), clock=clock, proc=existing
         )
         assert slot.proc is existing
+
+
+class TestDynamicSlots:
+    """The autoscaler grows/shrinks the slot list while the loop runs:
+    appended slots are picked up, retired slots drop out with their
+    pending respawns cancelled, and no slot's backoff deadline leaks
+    into a sibling's."""
+
+    def test_retire_mid_backoff_cancels_pending_respawn(self):
+        clock = _Clock()
+        spawned = []
+
+        def spawn():
+            proc = _FakeProc(clock, dies_at=clock.t + 1.0)
+            spawned.append(proc)
+            return proc
+
+        slot = WorkerSlot(spawn, clock=clock)
+        slots = [slot]
+        clock.t = slot.spawned_at + 1.0
+        _run_supervisor_step(slots, clock)
+        assert slot.proc is None and slot.respawn_at > clock.t
+        slot.retire()
+        clock.t = slot.respawn_at + 5.0
+        _run_supervisor_step(slots, clock)
+        assert slots == []               # dropped from supervision
+        assert slot.proc is None         # and NEVER respawned
+        assert len(spawned) == 1
+
+    def test_retired_slot_with_live_proc_is_released_not_killed(self):
+        """Retiring a slot whose child is alive (the drain path owns
+        that process now) only releases supervision: the process object
+        is untouched and a later exit is not respawned."""
+        clock = _Clock()
+        spawned = []
+
+        def spawn():
+            proc = _FakeProc(clock, dies_at=clock.t + 100.0)
+            spawned.append(proc)
+            return proc
+
+        slot = WorkerSlot(spawn, clock=clock)
+        live = slot.proc
+        slots = [slot]
+        slot.retire()
+        _run_supervisor_step(slots, clock)
+        assert slots == [] and slot.proc is live
+        assert not live.terminated  # the drain path owns this process
+        # the process dies later (SIGTERM drain finished): no respawn
+        clock.t = 200.0
+        _run_supervisor_step(slots, clock)
+        assert spawned == [live]
+
+    def test_respawn_racing_retirement_is_terminated_at_removal(self):
+        """retire() lands while the supervisor is respawning the slot
+        (mid-backoff, deadline due): the freshly spawned process was
+        never seen by the retirer — nothing will ever drain it — so the
+        supervisor must terminate it when it drops the slot, instead of
+        leaking a live orphan."""
+        clock = _Clock()
+        slot = WorkerSlot(
+            lambda: _FakeProc(clock, dies_at=clock.t + 100.0),
+            clock=clock,
+        )
+        slot.proc = None           # mid-backoff: no live process
+        slot.respawn_at = 5.0
+        slots = [slot]
+        slot.retire()              # retirer saw NO process to drain
+        assert slot.retired_pid is None
+        # the race: a respawn that was already past the retired-check
+        # assigns a new process after the flag was set
+        raced = _FakeProc(clock, dies_at=clock.t + 100.0)
+        slot.proc = raced
+        _run_supervisor_step(slots, clock)
+        assert slots == []
+        assert raced.terminated    # leak closed, orphan reaped
+
+    def test_appended_slot_supervised_next_poll(self):
+        clock = _Clock()
+        slot_a = WorkerSlot(
+            lambda: _FakeProc(clock, dies_at=clock.t + 100.0),
+            clock=clock,
+        )
+        slots = [slot_a]
+        _run_supervisor_step(slots, clock)
+        # the autoscaler appends a new slot mid-run; its child dies
+        slot_b = WorkerSlot(
+            lambda: _FakeProc(clock, dies_at=clock.t + 1.0),
+            clock=clock,
+        )
+        slots.append(slot_b)
+        clock.t = slot_b.spawned_at + 1.0
+        _run_supervisor_step(slots, clock)
+        assert slot_b.proc is None          # exit noticed
+        assert slot_b.respawn_at > clock.t  # backoff scheduled
+        assert slot_a.proc is not None      # sibling untouched
+
+    def test_retire_does_not_disturb_sibling_backoff(self):
+        """No respawn-deadline cross-talk: slot A retiring mid-backoff
+        neither advances nor delays slot B's own respawn deadline."""
+        clock = _Clock()
+        slot_a = WorkerSlot(lambda: _FakeProc(clock), clock=clock)
+        slot_b = WorkerSlot(lambda: _FakeProc(clock), clock=clock)
+        slot_a.proc = None
+        slot_a.fails = 3
+        slot_a.respawn_at = 4.0
+        slot_b.proc = None
+        slot_b.fails = 1
+        slot_b.respawn_at = 10.0
+        slots = [slot_a, slot_b]
+        slot_a.retire()
+        clock.t = 5.0  # past A's deadline, before B's
+        _run_supervisor_step(slots, clock)
+        assert slots == [slot_b]
+        assert slot_a.proc is None          # A's respawn cancelled
+        assert slot_b.proc is None          # B still waiting ITS deadline
+        assert slot_b.respawn_at == 10.0
+        clock.t = 10.0
+        _run_supervisor_step(slots, clock)
+        assert slot_b.proc is not None      # B respawned on schedule
+
+    def test_concurrent_retire_of_same_slot_is_safe(self):
+        """Two removals of one slot (reconcile + prune racing) must not
+        crash the loop."""
+        clock = _Clock()
+        slot = WorkerSlot(lambda: _FakeProc(clock), clock=clock)
+        slot.retire()
+        slots = [slot, slot]  # worst case: listed twice
+        _run_supervisor_step(slots, clock)
+        assert slots == []
 
 
 def _get_status(port: int) -> dict:
