@@ -19,6 +19,7 @@ from predictionio_tpu.analysis.engine import (
     run_lint,
 )
 from predictionio_tpu.analysis.model import RULES, Finding, Rule
+from predictionio_tpu.analysis.sarif import render_sarif
 
 __all__ = [
     "RULES",
@@ -30,5 +31,6 @@ __all__ = [
     "analyze_modules",
     "load_baseline",
     "render_baseline",
+    "render_sarif",
     "run_lint",
 ]

@@ -105,6 +105,8 @@ def walk_statements(body: list[ast.stmt]) -> Iterator[ast.stmt]:
                 yield from walk_statements(inner)
         for handler in getattr(stmt, "handlers", ()):
             yield from walk_statements(handler.body)
+        for case in getattr(stmt, "cases", ()):  # ast.Match
+            yield from walk_statements(case.body)
 
 
 def calls_in(node: ast.AST) -> Iterator[ast.Call]:

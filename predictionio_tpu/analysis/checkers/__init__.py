@@ -3,6 +3,13 @@
 Each checker is ``check(modules: list[SourceModule]) -> list[Finding]``
 over the whole file set at once, so project-wide rules (lock-order
 cycles, metric-label consistency) see everything.
+
+A checker whose module sets ``PER_FILE = True`` promises that each
+module's findings are a pure function of that module's text alone —
+the findings cache (``analysis/cache.py``) replays those from disk for
+unchanged files and only re-runs them on misses. Cross-file checkers
+(lock graphs, imported-jit call sites, the mesh-axis and metric-name
+registries) must NOT set it.
 """
 
 from __future__ import annotations
@@ -13,18 +20,31 @@ from predictionio_tpu.analysis.checkers import (
     donation,
     jit_retrace,
     locks,
+    races,
     sharding_spec,
     telemetry,
     threads,
 )
 
-ALL_CHECKERS = (
-    locks.check,
-    clock.check,
-    device_sync.check,
-    jit_retrace.check,
-    sharding_spec.check,
-    donation.check,
-    threads.check,
-    telemetry.check,
+_CHECKER_MODULES = (
+    locks,
+    clock,
+    device_sync,
+    jit_retrace,
+    sharding_spec,
+    donation,
+    threads,
+    races,
+    telemetry,
+)
+
+ALL_CHECKERS = tuple(mod.check for mod in _CHECKER_MODULES)
+
+#: module names whose findings are cacheable per file (see docstring);
+#: derived from each checker's own PER_FILE attribute so there is one
+#: source of truth — a new per-file checker only sets the flag
+PER_FILE_CHECKERS = frozenset(
+    mod.__name__.rsplit(".", 1)[-1]
+    for mod in _CHECKER_MODULES
+    if getattr(mod, "PER_FILE", False)
 )

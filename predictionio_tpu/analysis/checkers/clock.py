@@ -43,6 +43,10 @@ _PATH_FUNC = re.compile(
 def _is_time_time(call: ast.Call) -> bool:
     return astutil.dotted_name(call.func) in ("time.time",)
 
+#: each module's findings depend only on that module's text --
+#: cacheable per file (see analysis/cache.py)
+PER_FILE = True
+
 
 def check(modules: list[SourceModule]) -> list[Finding]:
     findings: list[Finding] = []

@@ -82,6 +82,10 @@ def _sync_desc(
         return f"{call.func.id}() on a traced value"
     return None
 
+#: each module's findings depend only on that module's text --
+#: cacheable per file (see analysis/cache.py)
+PER_FILE = True
+
 
 def check(modules: list[SourceModule]) -> list[Finding]:
     findings: list[Finding] = []

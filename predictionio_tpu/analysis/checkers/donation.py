@@ -24,6 +24,10 @@ from predictionio_tpu.analysis import astutil, jaxast
 from predictionio_tpu.analysis.model import Finding
 from predictionio_tpu.analysis.source import SourceModule
 
+#: each module's findings depend only on that module's text --
+#: cacheable per file (see analysis/cache.py)
+PER_FILE = True
+
 
 def check(modules: list[SourceModule]) -> list[Finding]:
     findings: list[Finding] = []

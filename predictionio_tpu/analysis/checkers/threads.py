@@ -53,6 +53,10 @@ def _join_targets(tree: ast.AST) -> set[tuple[str, str]]:
             out.add(("", recv.id))
     return out
 
+#: each module's findings depend only on that module's text --
+#: cacheable per file (see analysis/cache.py)
+PER_FILE = True
+
 
 def check(modules: list[SourceModule]) -> list[Finding]:
     findings: list[Finding] = []

@@ -28,7 +28,10 @@ import subprocess
 import time
 
 from predictionio_tpu.analysis import baseline as baseline_mod
-from predictionio_tpu.analysis.checkers import ALL_CHECKERS
+from predictionio_tpu.analysis.checkers import (
+    ALL_CHECKERS,
+    PER_FILE_CHECKERS,
+)
 from predictionio_tpu.analysis.model import Finding
 from predictionio_tpu.analysis.source import (
     SourceModule,
@@ -51,6 +54,9 @@ class LintResult:
     #: (None = full-tree run)
     scoped_to: list[str] | None = None
     notes: list[str] = dataclasses.field(default_factory=list)
+    #: {"hits": n, "misses": m, "hitRate": 0.xx} when the parse/index
+    #: cache was enabled (None = cache off)
+    cache: dict | None = None
 
     @property
     def ok(self) -> bool:
@@ -63,17 +69,47 @@ class LintResult:
 def analyze_modules(
     modules: list[SourceModule],
     timings_ms: dict[str, float] | None = None,
+    cache=None,
 ) -> list[Finding]:
     """Run every checker, drop suppressed findings. When ``timings_ms``
     is given, each checker's wall time lands in it keyed by module
-    name (``locks``, ``jit_retrace``, ...)."""
+    name (``locks``, ``jit_retrace``, ...).
+
+    With a :class:`predictionio_tpu.analysis.cache.LintCache`, modules
+    whose content already has an entry skip the per-file checkers
+    (their cached findings are replayed instead — raw, so suppression
+    comments are still applied fresh below); cross-file checkers run
+    on the full module list every time."""
     by_path = {m.rel_path: m for m in modules}
+    cached: dict[str, dict[str, list[Finding]]] = {}
+    fresh: dict[str, dict[str, list[Finding]]] = {}
+    if cache is not None:
+        for m in modules:
+            entry = cache.load(m, PER_FILE_CHECKERS)
+            if entry is not None:
+                cached[m.rel_path] = entry
     findings: list[Finding] = []
     for checker in ALL_CHECKERS:
+        name = checker.__module__.rsplit(".", 1)[-1]
         start = time.monotonic()
-        checker_findings = checker(modules)
+        if cache is not None and name in PER_FILE_CHECKERS:
+            miss_mods = [
+                m for m in modules if m.rel_path not in cached
+            ]
+            checker_findings = checker(miss_mods) if miss_mods else []
+            grouped: dict[str, list[Finding]] = {}
+            for f in checker_findings:
+                grouped.setdefault(f.path, []).append(f)
+            for m in miss_mods:
+                fresh.setdefault(m.rel_path, {})[name] = grouped.get(
+                    m.rel_path, []
+                )
+            checker_findings = list(checker_findings)
+            for entry in cached.values():
+                checker_findings.extend(entry.get(name, []))
+        else:
+            checker_findings = checker(modules)
         if timings_ms is not None:
-            name = checker.__module__.rsplit(".", 1)[-1]
             timings_ms[name] = round(
                 timings_ms.get(name, 0.0)
                 + (time.monotonic() - start) * 1000.0,
@@ -84,6 +120,11 @@ def analyze_modules(
             if mod is not None and mod.suppressed(f.rule, f.line):
                 continue
             findings.append(f)
+    if cache is not None:
+        for rel_path, by_checker in fresh.items():
+            # a module only reaches `fresh` via the miss list, where
+            # every per-file checker ran on it — the entry is complete
+            cache.store(by_path[rel_path], by_checker)
     return sorted(findings, key=Finding.sort_key)
 
 
@@ -119,8 +160,14 @@ def _git_changed_files(root: str, ref: str) -> tuple[set[str] | None, str]:
                 "(note: `--changed <path>` parses the path as the REF "
                 "— put paths before the flag or use `--changed HEAD`)"
             )
+        # --name-status --find-renames, not --name-only: a renamed
+        # file must enter scope under its NEW path (an `R` line), and
+        # the OLD path must stay out of the changed set so it can't
+        # match any report. Plain --name-only leaves rename handling
+        # to the user's diff.renames config — scope would then depend
+        # on local git configuration.
         diff = subprocess.run(
-            ["git", "diff", "--name-only", ref],
+            ["git", "diff", "--name-status", "--find-renames", ref],
             cwd=root, capture_output=True, text=True, timeout=10,
         )
         if diff.returncode != 0:
@@ -132,20 +179,29 @@ def _git_changed_files(root: str, ref: str) -> tuple[set[str] | None, str]:
     except (OSError, subprocess.SubprocessError) as e:
         return None, str(e)
     rel: set[str] = set()
-    # `git diff --name-only` prints repo-root-relative paths; but
-    # `ls-files --others` prints them relative to the cwd it ran in
-    for base, out in (
-        (git_root, diff.stdout),
-        (root, untracked.stdout if untracked.returncode == 0 else ""),
-    ):
-        for ln in out.splitlines():
+
+    def add(base: str, name: str) -> None:
+        abs_path = os.path.join(base, name)
+        rel.add(os.path.relpath(abs_path, root).replace(os.sep, "/"))
+
+    # name-status lines are `M\tpath` / `A\tpath` / `D\tpath` /
+    # `R<score>\told\tnew` / `C<score>\told\tnew`; paths are
+    # repo-root-relative. Deleted files and rename sources are
+    # excluded: nothing at those paths exists to report against.
+    for ln in diff.stdout.splitlines():
+        parts = ln.rstrip("\n").split("\t")
+        if len(parts) < 2 or not parts[0]:
+            continue
+        status = parts[0][0]
+        if status == "D":
+            continue
+        add(git_root, parts[-1])  # R/C: the LAST path is the new one
+    # `ls-files --others` prints paths relative to the cwd it ran in
+    if untracked.returncode == 0:
+        for ln in untracked.stdout.splitlines():
             name = ln.strip()
-            if not name:
-                continue
-            abs_path = os.path.join(base, name)
-            rel.add(
-                os.path.relpath(abs_path, root).replace(os.sep, "/")
-            )
+            if name:
+                add(root, name)
     return rel, ""
 
 
@@ -154,13 +210,21 @@ def run_lint(
     root: str | None = None,
     baseline_path: str | None = None,
     changed_ref: str | None = None,
+    cache_dir: str | None = None,
 ) -> LintResult:
     root = os.path.abspath(root or os.getcwd())
     start = time.monotonic()
+    cache = None
+    if cache_dir is not None:
+        from predictionio_tpu.analysis.cache import LintCache
+
+        cache = LintCache(cache_dir)
     files = iter_python_files(paths)
     modules, errors = load_modules(files, root)
     timings: dict[str, float] = {}
-    findings = analyze_modules(modules, timings_ms=timings)
+    findings = analyze_modules(modules, timings_ms=timings, cache=cache)
+    if cache is not None:
+        cache.prune()
 
     notes: list[str] = []
     scoped_to: list[str] | None = None
@@ -212,4 +276,5 @@ def run_lint(
         total_ms=round((time.monotonic() - start) * 1000.0, 2),
         scoped_to=scoped_to,
         notes=notes,
+        cache=cache.stats() if cache is not None else None,
     )

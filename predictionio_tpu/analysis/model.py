@@ -76,6 +76,31 @@ RULES: dict[str, Rule] = {
             "donation deletes the input buffer on device backends",
         ),
         Rule(
+            "shared-state-race",
+            "field written on one thread root and accessed on another "
+            "with no common lock",
+            "guard every access with one lock, hand the value off "
+            "through a Queue/Event, or publish immutable replacements "
+            "(single store, single-load readers) instead of mutating "
+            "shared state",
+        ),
+        Rule(
+            "lock-consistency",
+            "field guarded by one lock at most sites but bare or under "
+            "a different lock elsewhere",
+            "take the majority lock at the deviating sites (snapshot "
+            "under the lock, then work on the copy) so every dangerous "
+            "access agrees on the guard",
+        ),
+        Rule(
+            "check-then-act",
+            "decision reads a shared field, the update writes it, and "
+            "the lock is released in between",
+            "hold one lock across the check AND the act, or re-check "
+            "the field under the lock at the write (compare-and-set) "
+            "so an interposing thread cannot invalidate the decision",
+        ),
+        Rule(
             "thread-lifecycle",
             "thread neither daemonized nor joined",
             "pass daemon=True (documenting the shutdown contract) or "

@@ -400,7 +400,12 @@ def cmd_lint(args) -> int:
     buffer reuse, thread lifecycle, telemetry hygiene. Pure stdlib —
     never imports jax. Exit 0 = clean (baselined findings allowed),
     1 = new findings or unanalyzable files."""
-    from predictionio_tpu.analysis import render_baseline, run_lint
+    from predictionio_tpu.analysis import (
+        render_baseline,
+        render_sarif,
+        run_lint,
+    )
+    from predictionio_tpu.analysis.cache import default_cache_dir
 
     paths = args.paths or ["predictionio_tpu", "scripts"]
     missing = [p for p in paths if not os.path.exists(p)]
@@ -421,11 +426,15 @@ def cmd_lint(args) -> int:
         )
         return 2
     baseline_path = None if args.no_baseline else args.baseline
+    cache_dir = None
+    if not args.no_cache:
+        cache_dir = args.cache_dir or default_cache_dir()
     result = run_lint(
         paths,
         root=os.getcwd(),
         baseline_path=baseline_path,
         changed_ref=args.changed,
+        cache_dir=cache_dir,
     )
 
     if args.write_baseline:
@@ -466,7 +475,21 @@ def cmd_lint(args) -> int:
             payload["scopedTo"] = result.scoped_to
         if result.notes:
             payload["notes"] = result.notes
+        if result.cache is not None:
+            payload["cache"] = result.cache
         print(json.dumps(payload, indent=2))
+        return 0 if result.ok else 1
+
+    if args.format == "sarif":
+        # SARIF on stdout, diagnostics on stderr; exit code unchanged
+        # so the CI step still fails on findings after the upload
+        from predictionio_tpu.version import __version__
+
+        for note in result.notes:
+            print(f"note: {note}", file=sys.stderr)
+        for err in result.errors:
+            print(f"[ERROR] {err}", file=sys.stderr)
+        print(render_sarif(result, __version__))
         return 0 if result.ok else 1
 
     for note in result.notes:
@@ -507,11 +530,18 @@ def cmd_lint(args) -> int:
     if result.timings_ms:
         name, ms = max(result.timings_ms.items(), key=lambda kv: kv[1])
         slowest = f" (slowest checker: {name} {ms:.0f} ms)"
+    cache_note = ""
+    if result.cache is not None:
+        total = result.cache["hits"] + result.cache["misses"]
+        cache_note = (
+            f", cache {result.cache['hits']}/{total} hits "
+            f"({result.cache['hitRate']:.0%})"
+        )
     summary = (
         f"{result.files_checked} file(s) checked{scope}: "
         f"{len(result.new)} new finding(s), "
         f"{len(result.baselined)} baselined "
-        f"in {result.total_ms:.0f} ms{slowest}"
+        f"in {result.total_ms:.0f} ms{slowest}{cache_note}"
     )
     print(summary)
     return 0 if result.ok else 1
@@ -1599,9 +1629,21 @@ def build_parser() -> argparse.ArgumentParser:
              "to the full tree when git is unavailable",
     )
     p.add_argument(
-        "--format", choices=("text", "github"), default="text",
+        "--format", choices=("text", "github", "sarif"), default="text",
         help="finding output format: 'github' emits GitHub Actions "
-             "::error workflow annotations (inline on the PR diff)",
+             "::error workflow annotations (inline on the PR diff); "
+             "'sarif' emits SARIF 2.1.0 on stdout for "
+             "github/codeql-action/upload-sarif (code-scanning tab)",
+    )
+    p.add_argument(
+        "--cache-dir", dest="cache_dir", default=None, metavar="DIR",
+        help="parse/index cache directory (default: "
+             "$XDG_CACHE_HOME/pio-tpu-lint); keyed by file content + "
+             "analyzer source hash, so it can never serve stale models",
+    )
+    p.add_argument(
+        "--no-cache", dest="no_cache", action="store_true",
+        help="disable the parse/index cache for this run",
     )
     p.set_defaults(func=cmd_lint)
 

@@ -232,9 +232,15 @@ class ReplicaAutoscaler:
 
     Single reconcile thread; the shared ``supervise_children`` loop
     runs beside it over the same (dynamic) slot list. The router calls
-    back into :meth:`spawn_for_swap` from a swap thread — replica
-    bookkeeping is therefore kept to GIL-atomic list/dict operations
-    plus the router's own locked registry."""
+    back into :meth:`spawn_for_swap` from a swap thread, so ownership
+    bookkeeping (``_owned``, ``_slots``) is guarded by ``_lock`` —
+    the reconcile thread iterates ``_owned`` while a swap spawn may be
+    inserting into it, which GIL-atomic single operations do not make
+    safe. The supervisor thread itself stays lock-free: it iterates a
+    ``list(slots)`` snapshot by contract (see ``supervise_children``),
+    and the lock here only orders the autoscaler's own append/pop/scan
+    against each other. Never held across spawning, HTTP, or the
+    router's own locked registry."""
 
     def __init__(
         self,
@@ -251,6 +257,9 @@ class ReplicaAutoscaler:
             registry if registry is not None else get_registry()
         )
         self._clock = clock
+        #: guards _owned and _slots (reconcile thread vs swap-thread
+        #: spawn callbacks vs status/scrape readers)
+        self._lock = threading.Lock()
         self._slots: list[WorkerSlot] = []
         #: replica id -> its supervised slot (autoscaler-owned only;
         #: operator-registered replicas are never shrink victims)
@@ -345,7 +354,8 @@ class ReplicaAutoscaler:
             return new_proc
 
         slot = WorkerSlot(respawn, clock=self._clock, proc=proc)
-        self._slots.append(slot)
+        with self._lock:
+            self._slots.append(slot)
         try:
             replica = self._router.add_replica(
                 url,
@@ -358,7 +368,8 @@ class ReplicaAutoscaler:
             slot.retire()
             proc.terminate()
             raise
-        self._owned[rid] = slot
+        with self._lock:
+            self._owned[rid] = slot
         log_json(
             logger, logging.INFO, "autoscaler_spawned",
             replica=rid, url=url, generation=generation, staged=staged,
@@ -457,19 +468,22 @@ class ReplicaAutoscaler:
 
     def _shrink(self) -> str:
         states = self._router.replica_states()
-        victims = [
-            rid
-            for rid in self._owned
-            if states.get(rid) == "healthy"
-        ]
-        if not victims:
-            return "idle"
-        # newest first: the longest-lived replicas keep the warmest
-        # caches and the densest affinity assignments
-        victim = sorted(
-            victims, key=lambda rid: int(rid.split("-")[-1])
-        )[-1]
-        slot = self._owned.pop(victim)
+        with self._lock:
+            # a swap thread may be inserting into _owned right now —
+            # the scan and the pop agree on the lock
+            victims = [
+                rid
+                for rid in self._owned
+                if states.get(rid) == "healthy"
+            ]
+            if not victims:
+                return "idle"
+            # newest first: the longest-lived replicas keep the warmest
+            # caches and the densest affinity assignments
+            victim = sorted(
+                victims, key=lambda rid: int(rid.split("-")[-1])
+            )[-1]
+            slot = self._owned.pop(victim)
         # retire the SLOT first: the drain below SIGTERMs the process,
         # and a still-supervised slot would respawn it mid-retire
         slot.retire()
@@ -485,23 +499,27 @@ class ReplicaAutoscaler:
         swap rolling the old generation): their slots must stop
         respawning the drained processes."""
         states = self._router.replica_states()
-        for rid in list(self._owned):
-            if rid not in states:
-                slot = self._owned.pop(rid)
-                slot.retire()
-                # the router already drained+SIGTERM'd the process it
-                # knew; a pid still alive here is either that one
-                # finishing its drain (a second SIGTERM is idempotent)
-                # or a respawn that beat this prune — which nobody
-                # else will ever drain, so terminate it here rather
-                # than leak an unregistered replica process
-                proc = slot.proc
-                if proc is not None and proc.poll() is None:
-                    proc.terminate()
-                log_json(
-                    logger, logging.INFO, "autoscaler_released",
-                    replica=rid,
-                )
+        with self._lock:
+            released = [
+                (rid, self._owned.pop(rid))
+                for rid in list(self._owned)
+                if rid not in states
+            ]
+        for rid, slot in released:
+            slot.retire()
+            # the router already drained+SIGTERM'd the process it
+            # knew; a pid still alive here is either that one
+            # finishing its drain (a second SIGTERM is idempotent)
+            # or a respawn that beat this prune — which nobody
+            # else will ever drain, so terminate it here rather
+            # than leak an unregistered replica process
+            proc = slot.proc
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+            log_json(
+                logger, logging.INFO, "autoscaler_released",
+                replica=rid,
+            )
 
     def status(self) -> dict:
         return {

@@ -348,10 +348,11 @@ class MicroBatcher:
         self._cv = threading.Condition()
         self._buf: list[_Slot] = []
         self._closed = threading.Event()
-        #: EWMA of end-to-end batch seconds — feeds retry_after_s();
-        #: written by the settle path, read lock-free by handler threads
-        #: (a float store is atomic in CPython; a slightly stale hint is
-        #: fine)
+        #: EWMA of end-to-end batch seconds — feeds retry_after_s().
+        #: Guarded by the cv: the settle path runs on BOTH worker
+        #: threads (completer normally, collector for dispatch-phase
+        #: failures and the serial fallback), so the read-modify-write
+        #: would otherwise lose updates between them
         self._batch_ewma_s = 0.0
         self._pipeline_depth = max(0, pipeline_depth)
         self._completer: threading.Thread | None = None
@@ -475,8 +476,8 @@ class MicroBatcher:
         (docs/robustness.md)."""
         with self._cv:
             depth = len(self._buf)
+            per_batch = max(self._batch_ewma_s, 0.001)
         batches_ahead = 1.0 + depth / max(1, self._max_batch)
-        per_batch = max(self._batch_ewma_s, 0.001)
         return min(5.0, max(0.05, batches_ahead * per_batch))
 
     def close(self) -> None:
@@ -737,13 +738,16 @@ class MicroBatcher:
 
     # -- shared settlement -------------------------------------------------
     def _observe_batch_time(self, elapsed: float) -> None:
-        # feeds retry_after_s(); single writer (whichever thread
-        # settles), lock-free float store
-        self._batch_ewma_s = (
-            elapsed
-            if self._batch_ewma_s == 0.0
-            else 0.8 * self._batch_ewma_s + 0.2 * elapsed
-        )
+        # feeds retry_after_s(). Settlement runs on the completer OR
+        # the collector (dispatch-phase failure, serial fallback), so
+        # the EWMA fold takes the cv — both writers and the
+        # retry_after_s() reader agree on one guard
+        with self._cv:
+            self._batch_ewma_s = (
+                elapsed
+                if self._batch_ewma_s == 0.0
+                else 0.8 * self._batch_ewma_s + 0.2 * elapsed
+            )
 
     def _settle_success(
         self, live, results, elapsed: float, start_wall: float,

@@ -1078,12 +1078,16 @@ class EngineServer:
                 f"canary rejected: generation {staged.instance.id} "
                 "did not complete warmup",
             )
-        self._canary = canary_mod.ShadowCanary(
+        fresh = canary_mod.ShadowCanary(
             staged,
             config=self._canary_config or canary_mod.CanaryConfig(),
             registry=self._registry,
             shadow_fn=self._shadow_score,
         )
+        with self._lock:
+            # same guard _finish_canary's CAS takes: installs and
+            # clears of the canary slot agree on one lock
+            self._canary = fresh
         logger.info(
             "canary shadowing generation %s beside %s",
             staged.instance.id, serving_id,
@@ -1235,10 +1239,12 @@ class EngineServer:
 
     def _finish_canary(self, canary: canary_mod.ShadowCanary) -> None:
         self._last_canary = canary.to_dict()
-        # CAS, not blind clear: a verdict applier finishing late must
-        # not clobber a newer canary another reload already installed
-        if self._canary is canary:
-            self._canary = None
+        # CAS under the lock, not a blind (or bare-checked) clear: a
+        # verdict applier finishing late must not clobber a newer
+        # canary a reload installed between its check and its write
+        with self._lock:
+            if self._canary is canary:
+                self._canary = None
 
     def _close_batchers_async(self, batchers) -> None:
         # close() drains in-flight dispatches and joins the batcher's
@@ -1307,19 +1313,26 @@ class EngineServer:
         raise last_exc  # type: ignore[misc]
 
     def close(self) -> None:
+        # take the canary and the serving batcher list in one locked
+        # step: a request thread applying a late verdict (or a reload)
+        # may be swapping these exact fields while the drain hook runs.
+        # The batcher list is REPLACED on swap, never mutated in place,
+        # so holding the reference keeps the identity comparison below
+        with self._lock:
+            canary = self._canary
+            self._canary = None
+            batchers = self._batchers
         # an in-flight canary's staged/retained generations hold their
         # own batchers; close them too (skipping whichever set IS the
         # serving one — closed below)
-        canary = self._canary
         if canary is not None:
             canary.close()
             for gen in (canary.staged, canary.retained):
-                if gen is None or gen.batchers is self._batchers:
+                if gen is None or gen.batchers is batchers:
                     continue
                 for b in gen.batchers:
                     b.close()
-            self._canary = None
-        for b in self._batchers:
+        for b in batchers:
             b.close()
         self._plugins.close()
         if self._log_queue is not None:

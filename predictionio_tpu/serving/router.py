@@ -853,6 +853,12 @@ class ServingRouter:
                 and time.monotonic() - self._state_saved_monotonic
                 > min(60.0, self._state_max_age_s / 3.0)
             ):
+                # a swap/membership thread persisting concurrently makes
+                # this refresh redundant, never wrong: saves are atomic
+                # whole-file writes of freshly-snapshotted state, and
+                # the stamp is a staleness hint — an extra save costs
+                # one fsync, an interposed one satisfies the check
+                # pio-lint: disable-next=check-then-act -- idempotent freshness refresh; concurrent persists write identical atomic snapshots
                 self._persist_state()
 
     def _fetch_json(self, url: str):
@@ -1003,6 +1009,10 @@ class ServingRouter:
         replica idle, all tied — costs one key hash + one bisect per
         request, not a ring rebuild."""
         key = tuple(sorted(r.replica_id for r in tied))
+        # the .get is a single (GIL-atomic) load — the hot hit path
+        # stays lock-free; two concurrent misses build the same
+        # deterministic ring, and the store below is ordered under the
+        # lock so a concurrent clear() cannot interleave mid-eviction
         ring = self._ring_cache.get(key)
         if ring is None:
             merged = sorted(
@@ -1011,9 +1021,10 @@ class ServingRouter:
                 for point in r.ring_points
             )
             ring = ([p for p, _ in merged], [rid for _, rid in merged])
-            if len(self._ring_cache) >= 64:
-                self._ring_cache.clear()  # membership churn: start over
-            self._ring_cache[key] = ring
+            with self._lock:
+                if len(self._ring_cache) >= 64:
+                    self._ring_cache.clear()  # membership churn: restart
+                self._ring_cache[key] = ring
         points, ids = ring
         by_id = {r.replica_id: r for r in tied}
         idx = bisect.bisect_left(points, _hash64(affinity_key))
@@ -1078,7 +1089,12 @@ class ServingRouter:
                 ]
             if healthy and all(r.saturated for r in healthy):
                 self._shed_total.inc()
-                self._shed_count += 1
+                with self._lock:
+                    # += on a bare int loses counts when two handler
+                    # threads shed at once; the autoscaler diffs this
+                    # value per tick, so lost updates read as "no
+                    # pressure" exactly when pressure is highest
+                    self._shed_count += 1
                 return Response(
                     503,
                     {
@@ -1156,7 +1172,8 @@ class ServingRouter:
                 # hint. Queries are reads — the replicas' sheds did no
                 # work — so the relay is marked replay-safe too.
                 self._shed_total.inc()
-                self._shed_count += 1
+                with self._lock:
+                    self._shed_count += 1
                 return Response(
                     503,
                     {
@@ -1664,22 +1681,36 @@ class ServingRouter:
         """Post-promotion fleet regression watch: served error rate or
         latency regressing against the pre-promotion baseline rolls the
         WHOLE fleet back; a clean window releases the standby."""
-        gate = self._fleet_gate
+        with self._lock:
+            gate = self._fleet_gate
         if gate is None:
             # restart mid-watch: the baseline died with the old
             # process, so open a fresh watch window (error-rate
             # regression still rolls back; the latency comparison
             # needs a baseline and stays disarmed)
             staged = self._swap_replica(record)
-            gate = canary_mod.ShadowCanary(
+            fresh = canary_mod.ShadowCanary(
                 staged if staged is not None else record["replica"],
                 config=self._gate_config or canary_mod.CanaryConfig(),
                 registry=self._registry,
                 shadow_fn=lambda body: None,
             )
-            gate.promoted(retained=record.get("standby"))
+            fresh.promoted(retained=record.get("standby"))
             with self._lock:
-                self._fleet_gate = gate
+                # re-check under the lock: close() may have run (the
+                # slot stays None forever after shutdown — installing
+                # would revive a live gate close() can never see) or a
+                # racing installer may have won
+                if self._fleet_gate is None and not self._closed.is_set():
+                    self._fleet_gate = fresh
+                installed = self._fleet_gate
+            if installed is not fresh:
+                # not installed (lost the race, or shutting down):
+                # release the abandoned gate's shadow worker; it is
+                # still a safe local fallback for the loop below,
+                # which exits immediately on _closed
+                fresh.close()
+            gate = installed if installed is not None else fresh
         decision = None
         deadline = time.monotonic() + self._watch_timeout_s
         while not self._closed.is_set():

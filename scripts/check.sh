@@ -37,10 +37,20 @@ fi
 lint_dur=$((SECONDS - lint_start))
 # the rule set keeps growing; a lint gate that creeps past 30 s stops
 # being the "fails fast" first step (per-checker timingsMs is in
-# `pio-tpu lint --json` — find the regressing checker there)
+# `pio-tpu lint --json`, alongside the parse/index cache hit rate —
+# find the regressing checker there)
 if [ "$lint_dur" -gt 30 ]; then
     echo "pio-tpu lint exceeded the 30 s CI budget (${lint_dur}s) —"
     echo "check timingsMs in: pio-tpu lint --json"
+    rc=1
+fi
+
+echo "== lint policy gate (empty baseline + reasoned suppressions) =="
+# the empty-baseline policy is a GATE, not a convention: the shipped
+# scripts/lint_baseline.txt must have zero entries, and every inline
+# `# pio-lint: disable...` must carry a `-- <reason>` tail
+if ! timeout -k 10 60 python scripts/lint_policy_gate.py; then
+    echo "lint policy gate FAILED (see docs/static_analysis.md)"
     rc=1
 fi
 

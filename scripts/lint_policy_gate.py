@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Empty-baseline + reasoned-suppressions policy gate for CI.
+
+The repo's contract since PR 7 is that ``scripts/lint_baseline.txt``
+ships EMPTY — every finding is fixed at its site or suppressed inline
+with a written reason — and this script turns that convention into an
+explicit gate:
+
+1. the shipped baseline must contain no entries (comments/blank lines
+   allowed);
+2. every ``# pio-lint: disable...`` marker in the tree must carry a
+   ``-- <reason>`` tail.
+
+Markers are read from real comment tokens (``tokenize``), mirroring
+``analysis/source.py``, so fixture strings inside tests or docs cannot
+trip the gate. Exit 0 = policy holds; 1 = violation (each printed with
+file:line); 2 = usage/environment error.
+
+Run from the repo root: ``python scripts/lint_policy_gate.py``
+(check.sh and ci.yml both do).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import re
+import sys
+import tokenize
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from predictionio_tpu.analysis.source import iter_python_files  # noqa: E402
+
+_MARKER = re.compile(r"#\s*pio-lint:\s*disable")
+_REASONED = re.compile(
+    r"#\s*pio-lint:\s*disable(?:-next|-file)?\s*=\s*"
+    r"[\w\-*,\s]+?\s+--\s+\S"
+)
+
+
+def baseline_entries(path: str) -> list[str]:
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for i, ln in enumerate(f, 1):
+            stripped = ln.strip()
+            if stripped and not stripped.startswith("#"):
+                out.append(f"{path}:{i}: {stripped}")
+    return out
+
+
+def unreasoned_suppressions(paths: list[str], root: str) -> list[str]:
+    out = []
+    for path in iter_python_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        except OSError as e:
+            out.append(f"{path}: unreadable: {e}")
+            continue
+        try:
+            for tok in tokenize.generate_tokens(
+                io.StringIO(text).readline
+            ):
+                if tok.type != tokenize.COMMENT:
+                    continue
+                if _MARKER.search(tok.string) and not _REASONED.search(
+                    tok.string
+                ):
+                    rel = os.path.relpath(path, root)
+                    out.append(
+                        f"{rel}:{tok.start[0]}: {tok.string.strip()}"
+                    )
+        except tokenize.TokenError:
+            continue
+    return out
+
+
+def main() -> int:
+    root = os.getcwd()
+    baseline = os.path.join("scripts", "lint_baseline.txt")
+    rc = 0
+
+    entries = baseline_entries(baseline)
+    if entries:
+        print(
+            f"POLICY: {baseline} must ship EMPTY — fix findings at "
+            "their site or suppress inline with a reason "
+            "(docs/static_analysis.md#baseline):",
+            file=sys.stderr,
+        )
+        for e in entries:
+            print(f"  {e}", file=sys.stderr)
+        rc = 1
+
+    offenders = unreasoned_suppressions(
+        ["predictionio_tpu", "scripts"], root
+    )
+    if offenders:
+        print(
+            "POLICY: every `# pio-lint: disable...` must carry a "
+            "`-- <reason>` tail:",
+            file=sys.stderr,
+        )
+        for o in offenders:
+            print(f"  {o}", file=sys.stderr)
+        rc = 1
+
+    if rc == 0:
+        print(
+            "lint policy OK: baseline empty, all suppressions "
+            "carry reasons"
+        )
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

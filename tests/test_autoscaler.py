@@ -351,3 +351,50 @@ def test_fake_proc_infra():
 
 def _unused(*_a):  # keep subprocess import honest for linters
     return subprocess
+
+
+class TestConcurrentBookkeeping:
+    """PR 12 regression: ``_owned``/``_slots`` are guarded by
+    ``_lock`` — the reconcile thread's shrink/prune scans must not
+    fight the swap thread's spawn insertions (pre-fix, the unlocked
+    dict scan could raise ``RuntimeError: dictionary changed size
+    during iteration`` or pop a slot the scan never saw)."""
+
+    def test_swap_spawn_concurrent_with_shrink_and_prune(self):
+        import threading
+
+        router, scaler = make_scaler(max_replicas=64)
+        errors: list[BaseException] = []
+        stop = threading.Event()
+
+        def swap_spawner():
+            try:
+                while not stop.is_set():
+                    scaler.spawn_for_swap("g2", staged=False)
+            except BaseException as e:  # noqa: BLE001 - fail the test
+                errors.append(e)
+
+        def reconciler():
+            try:
+                while not stop.is_set():
+                    scaler._shrink()
+                    scaler._prune_retired()
+            except BaseException as e:  # noqa: BLE001 - fail the test
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=swap_spawner, daemon=True),
+            threading.Thread(target=reconciler, daemon=True),
+        ]
+        [t.start() for t in threads]
+        import time
+
+        time.sleep(0.4)
+        stop.set()
+        [t.join(timeout=5) for t in threads]
+        assert errors == []
+        # bookkeeping converged: every owned replica is either still
+        # registered with the router or was popped before its retire
+        states = router.replica_states()
+        for rid in list(scaler._owned):
+            assert rid in states or rid in router.retired

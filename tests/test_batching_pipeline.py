@@ -592,3 +592,52 @@ class TestOverloadClassAwareQueue:
             assert 0.05 <= idle_after <= 5.0
         finally:
             b.close()
+
+
+class TestBatchEwmaSettlement:
+    """PR 12 regression: the retry-after EWMA fold runs under the cv —
+    settlement happens on the completer OR the collector (dispatch
+    failure / serial fallback), so the read-modify-write raced with
+    itself and with retry_after_s() readers before the fix."""
+
+    def test_concurrent_settlement_and_hint_reads(self):
+        fn = _TwoPhase()
+        b = MicroBatcher(
+            TwoPhaseBatchFn(fn.dispatch, fn.collect),
+            max_batch=4, max_wait_ms=1,
+        )
+        errors: list[BaseException] = []
+        stop = threading.Event()
+
+        def settle(value):
+            try:
+                while not stop.is_set():
+                    b._observe_batch_time(value)
+            except BaseException as e:  # noqa: BLE001 - fail the test
+                errors.append(e)
+
+        def read_hint():
+            try:
+                while not stop.is_set():
+                    hint = b.retry_after_s()
+                    assert 0.05 <= hint <= 5.0
+            except BaseException as e:  # noqa: BLE001 - fail the test
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=settle, args=(0.2,), daemon=True),
+            threading.Thread(target=settle, args=(0.4,), daemon=True),
+            threading.Thread(target=read_hint, daemon=True),
+        ]
+        try:
+            [t.start() for t in threads]
+            time.sleep(0.3)
+            stop.set()
+            [t.join(timeout=5) for t in threads]
+            assert errors == []
+            # the fold only ever mixes the two sample values, so the
+            # EWMA must land between them — a torn/lost update pattern
+            # that escapes the guard shows up as an out-of-range value
+            assert 0.2 <= b._batch_ewma_s <= 0.4
+        finally:
+            b.close()

@@ -846,3 +846,50 @@ class TestWarmupFailureGauge:
             )
         finally:
             es.close()
+
+
+class TestCanaryCasRegressions:
+    """PR 12 regression: canary-slot installs and clears happen under
+    ``EngineServer._lock`` as a compare-and-set — a verdict applier
+    finishing late must never clobber a newer canary, and close() must
+    snapshot the canary + serving batchers in one locked step."""
+
+    class _StubCanary:
+        def __init__(self):
+            self.closed = False
+            self.staged = None
+            self.retained = None
+
+        def to_dict(self):
+            return {"stub": True}
+
+        def close(self):
+            self.closed = True
+
+    def test_late_verdict_never_clobbers_newer_canary(
+        self, server, ctx, memory_storage
+    ):
+        _, es, _ = server
+        newer, older = self._StubCanary(), self._StubCanary()
+        es._canary = newer
+        es._finish_canary(older)  # late applier from a prior reload
+        assert es._canary is newer
+        es._finish_canary(newer)  # the CURRENT canary clears normally
+        assert es._canary is None
+
+    def test_close_takes_and_clears_the_canary_snapshot(
+        self, ctx, memory_storage
+    ):
+        run_train(
+            _engine(), _params(), engine_id="srv-cas", ctx=ctx,
+            storage=memory_storage,
+        )
+        es = EngineServer(
+            _engine(), _params(), engine_id="srv-cas",
+            storage=memory_storage, ctx=ctx,
+        )
+        canary = self._StubCanary()
+        es._canary = canary
+        es.close()
+        assert canary.closed
+        assert es._canary is None

@@ -1605,7 +1605,7 @@ class TestRunLintAndCli:
         assert payload["totalMs"] >= 0
         # one entry per checker module, all the new rules included
         for name in ("locks", "clock", "device_sync", "jit_retrace",
-                     "sharding_spec", "donation", "threads",
+                     "sharding_spec", "donation", "threads", "races",
                      "telemetry"):
             assert name in payload["timingsMs"], name
 
@@ -1906,3 +1906,1032 @@ class TestRepoIsClean:
             except tokenize.TokenError:
                 continue
         assert offenders == []
+
+
+# -- shared-state race rules (threads.py + checkers/races.py) --------------
+
+
+class TestSharedStateRace:
+    """Eraser-style lockset rule over discovered thread roots."""
+
+    def test_unlocked_container_shared_with_thread_is_flagged(self):
+        findings = lint_source(
+            """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._items = []
+                    t = threading.Thread(target=self._loop, daemon=True)
+                    t.start()
+
+                def _loop(self):
+                    while True:
+                        self._items.append(1)
+
+                def snapshot(self):
+                    return list(self._items)
+            """
+        )
+        races = [f for f in findings if f.rule == "shared-state-race"]
+        assert len(races) == 1
+        assert "W._items" in races[0].message
+
+    def test_common_lock_on_every_dangerous_site_is_clean(self):
+        findings = lint_source(
+            """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []
+                    t = threading.Thread(target=self._loop, daemon=True)
+                    t.start()
+
+                def _loop(self):
+                    while True:
+                        with self._lock:
+                            self._items.append(1)
+
+                def snapshot(self):
+                    with self._lock:
+                        return list(self._items)
+            """
+        )
+        assert "shared-state-race" not in rules_of(findings)
+
+    def test_queue_mediated_handoff_is_exempt(self):
+        findings = lint_source(
+            """
+            import queue
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._q = queue.Queue()
+                    t = threading.Thread(target=self._loop, daemon=True)
+                    t.start()
+
+                def _loop(self):
+                    while True:
+                        self._q.put(1)
+
+                def take(self):
+                    return self._q.get()
+            """
+        )
+        assert "shared-state-race" not in rules_of(findings)
+
+    def test_gil_atomic_publication_is_exempt(self):
+        """Plain stores of a fresh object + single-load readers: the
+        legal lock-free idiom (batch EWMA pre-PR 12, model snapshots)."""
+        findings = lint_source(
+            """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._snap = {}
+                    t = threading.Thread(target=self._loop, daemon=True)
+                    t.start()
+
+                def _loop(self):
+                    while True:
+                        self._snap = {"fresh": 1}
+
+                def lookup(self, k):
+                    return self._snap.get(k)
+            """
+        )
+        assert "shared-state-race" not in rules_of(findings)
+
+    def test_mutating_the_published_object_is_flagged(self):
+        """The publication exemption's negative case: in-place mutation
+        of the shared object (with an iterating reader) re-enters the
+        analysis and IS a race."""
+        findings = lint_source(
+            """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._snap = {}
+                    t = threading.Thread(target=self._loop, daemon=True)
+                    t.start()
+
+                def _loop(self):
+                    while True:
+                        self._snap["k"] = 1
+
+                def dump(self):
+                    return dict(self._snap)
+            """
+        )
+        assert "shared-state-race" in rules_of(findings)
+
+    def test_single_threaded_module_gets_no_race_analysis(self):
+        """No thread roots -> no rent: bare mutable state in
+        single-threaded code is fine."""
+        findings = lint_source(
+            """
+            class W:
+                def __init__(self):
+                    self._items = []
+
+                def add(self, x):
+                    self._items.append(x)
+
+                def snapshot(self):
+                    return list(self._items)
+            """
+        )
+        assert "shared-state-race" not in rules_of(findings)
+
+    def test_pre_start_init_is_exempt(self):
+        """Writes in __init__ happen before any root thread exists."""
+        findings = lint_source(
+            """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []
+                    self._items.append(0)
+                    t = threading.Thread(target=self._loop, daemon=True)
+                    t.start()
+
+                def _loop(self):
+                    while True:
+                        with self._lock:
+                            self._items.append(1)
+
+                def snapshot(self):
+                    with self._lock:
+                        return list(self._items)
+            """
+        )
+        assert "shared-state-race" not in rules_of(findings)
+
+
+class TestLockConsistency:
+    def test_majority_lock_names_the_deviating_site(self):
+        findings = lint_source(
+            """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = {}
+                    t = threading.Thread(target=self._loop, daemon=True)
+                    t.start()
+
+                def _loop(self):
+                    while True:
+                        with self._lock:
+                            self._n["a"] = 1
+
+                def put(self, k):
+                    with self._lock:
+                        self._n[k] = 2
+
+                def bare(self, k):
+                    self._n[k] = 3
+            """
+        )
+        lc = [f for f in findings if f.rule == "lock-consistency"]
+        assert len(lc) == 1
+        assert "W._lock" in lc[0].message
+        assert lc[0].context == "W.bare"
+
+    def test_consistent_guard_is_clean(self):
+        findings = lint_source(
+            """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = {}
+                    t = threading.Thread(target=self._loop, daemon=True)
+                    t.start()
+
+                def _loop(self):
+                    while True:
+                        with self._lock:
+                            self._n["a"] = 1
+
+                def put(self, k):
+                    with self._lock:
+                        self._n[k] = 2
+            """
+        )
+        assert "lock-consistency" not in rules_of(findings)
+        assert "shared-state-race" not in rules_of(findings)
+
+    def test_wrong_lock_at_one_site_is_flagged(self):
+        findings = lint_source(
+            """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._other = threading.Lock()
+                    self._n = {}
+                    t = threading.Thread(target=self._loop, daemon=True)
+                    t.start()
+
+                def _loop(self):
+                    while True:
+                        with self._lock:
+                            self._n["a"] = 1
+
+                def put(self, k):
+                    with self._lock:
+                        self._n[k] = 2
+
+                def wrong(self, k):
+                    with self._other:
+                        self._n[k] = 3
+            """
+        )
+        lc = [f for f in findings if f.rule == "lock-consistency"]
+        assert len(lc) == 1
+        assert "W._other" in lc[0].message
+
+
+class TestCheckThenAct:
+    def test_bare_check_locked_act_is_flagged(self):
+        findings = lint_source(
+            """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cur = None
+                    t = threading.Thread(target=self._loop, daemon=True)
+                    t.start()
+
+                def _loop(self):
+                    with self._lock:
+                        self._cur = object()
+
+                def install(self):
+                    if self._cur is None:
+                        with self._lock:
+                            self._cur = object()
+            """
+        )
+        cta = [f for f in findings if f.rule == "check-then-act"]
+        assert len(cta) == 1
+        assert "read with no lock" in cta[0].message
+        assert cta[0].context == "W.install"
+
+    def test_lock_released_between_check_and_act_is_flagged(self):
+        """Two separate with-blocks on the SAME lock are still a
+        released lock — the PR 11 verdict-CAS bug shape (via a local
+        alias read under the first block)."""
+        findings = lint_source(
+            """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cur = None
+                    t = threading.Thread(target=self._loop, daemon=True)
+                    t.start()
+
+                def _loop(self):
+                    with self._lock:
+                        self._cur = object()
+
+                def clear(self):
+                    with self._lock:
+                        cur = self._cur
+                    if cur is not None:
+                        with self._lock:
+                            self._cur = None
+            """
+        )
+        cta = [f for f in findings if f.rule == "check-then-act"]
+        assert len(cta) == 1
+        assert "released before the update" in cta[0].message
+
+    def test_cas_under_one_lock_is_clean(self):
+        findings = lint_source(
+            """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cur = None
+                    t = threading.Thread(target=self._loop, daemon=True)
+                    t.start()
+
+                def _loop(self):
+                    with self._lock:
+                        self._cur = object()
+
+                def clear(self):
+                    with self._lock:
+                        if self._cur is not None:
+                            self._cur = None
+            """
+        )
+        assert "check-then-act" not in rules_of(findings)
+
+    def test_act_through_same_module_helper_is_flagged(self):
+        findings = lint_source(
+            """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cur = None
+                    t = threading.Thread(target=self._loop, daemon=True)
+                    t.start()
+
+                def _loop(self):
+                    with self._lock:
+                        self._cur = object()
+
+                def ensure(self):
+                    if self._cur is None:
+                        self._install()
+
+                def _install(self):
+                    with self._lock:
+                        self._cur = object()
+            """
+        )
+        cta = [f for f in findings if f.rule == "check-then-act"]
+        assert len(cta) == 1
+        assert "through W._install()" in cta[0].message
+
+    def test_uncontended_field_is_clean(self):
+        """Only one root ever writes the field — no second thread can
+        interpose, so check-then-act does not apply."""
+        findings = lint_source(
+            """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cur = None
+                    t = threading.Thread(target=self._loop, daemon=True)
+                    t.start()
+
+                def _loop(self):
+                    if self._cur is None:
+                        self._cur = object()
+
+                def peek(self):
+                    return self._cur
+            """
+        )
+        assert "check-then-act" not in rules_of(findings)
+
+    def test_lock_inside_match_case_is_seen(self):
+        """`with self._lock:` inside a match-statement case body must
+        enter the lockset model — ast.Match has no body/orelse, its
+        statements live under case.body, a walker blind spot that used
+        to report correctly-locked code as a race."""
+        findings = lint_source(
+            """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = {}
+                    t = threading.Thread(target=self._loop, daemon=True)
+                    t.start()
+
+                def _loop(self):
+                    while True:
+                        with self._lock:
+                            self._n["a"] = 1
+
+                def apply(self, cmd):
+                    match cmd:
+                        case "put":
+                            with self._lock:
+                                self._n["b"] = 2
+                        case _:
+                            with self._lock:
+                                self._n.pop("b", None)
+            """
+        )
+        assert "shared-state-race" not in rules_of(findings)
+        assert "lock-consistency" not in rules_of(findings)
+
+    def test_bare_access_inside_match_case_still_flagged(self):
+        """The match fix must not swallow real findings: a bare write
+        in a case body races the locked loop write."""
+        findings = lint_source(
+            """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = {}
+                    t = threading.Thread(target=self._loop, daemon=True)
+                    t.start()
+
+                def _loop(self):
+                    while True:
+                        with self._lock:
+                            self._n["a"] = 1
+
+                def apply(self, cmd):
+                    match cmd:
+                        case "put":
+                            self._n["b"] = 2
+            """
+        )
+        assert "shared-state-race" in rules_of(findings)
+
+
+class TestThreadRootDiscovery:
+    """Edge cases for analysis/threads.py root discovery."""
+
+    def test_lambda_target_capturing_self(self):
+        findings = lint_source(
+            """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._items = []
+                    t = threading.Thread(
+                        target=lambda: self._work(), daemon=True
+                    )
+                    t.start()
+
+                def _work(self):
+                    self._items.append(1)
+
+                def snapshot(self):
+                    return list(self._items)
+            """
+        )
+        assert "shared-state-race" in rules_of(findings)
+
+    def test_functools_partial_target(self):
+        findings = lint_source(
+            """
+            import functools
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._items = []
+                    t = threading.Thread(
+                        target=functools.partial(self._work, 1),
+                        daemon=True,
+                    )
+                    t.start()
+
+                def _work(self, n):
+                    self._items.append(n)
+
+                def snapshot(self):
+                    return list(self._items)
+            """
+        )
+        assert "shared-state-race" in rules_of(findings)
+
+    def test_conditionally_started_root_still_counts(self):
+        findings = lint_source(
+            """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._items = []
+
+                def maybe_start(self, enabled):
+                    if enabled:
+                        t = threading.Thread(
+                            target=self._work, daemon=True
+                        )
+                        t.start()
+
+                def _work(self):
+                    self._items.append(1)
+
+                def snapshot(self):
+                    return list(self._items)
+            """
+        )
+        assert "shared-state-race" in rules_of(findings)
+
+    def test_helper_reached_from_two_roots_under_different_locks(self):
+        """The entry lockset is the INTERSECTION over call paths: two
+        roots calling the same helper under different locks guarantee
+        no lock at the helper's dangerous access."""
+        findings = lint_source(
+            """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._la = threading.Lock()
+                    self._lb = threading.Lock()
+                    self._shared = []
+                    ta = threading.Thread(target=self._loop_a, daemon=True)
+                    tb = threading.Thread(target=self._loop_b, daemon=True)
+                    ta.start()
+                    tb.start()
+
+                def _loop_a(self):
+                    with self._la:
+                        self._append()
+
+                def _loop_b(self):
+                    with self._lb:
+                        self._append()
+
+                def _append(self):
+                    self._shared.append(1)
+            """
+        )
+        assert "shared-state-race" in rules_of(findings)
+
+    def test_helper_reached_from_two_roots_under_one_lock_is_clean(self):
+        findings = lint_source(
+            """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._shared = []
+                    ta = threading.Thread(target=self._loop_a, daemon=True)
+                    tb = threading.Thread(target=self._loop_b, daemon=True)
+                    ta.start()
+                    tb.start()
+
+                def _loop_a(self):
+                    with self._lock:
+                        self._append()
+
+                def _loop_b(self):
+                    with self._lock:
+                        self._append()
+
+                def _append(self):
+                    self._shared.append(1)
+            """
+        )
+        assert "shared-state-race" not in rules_of(findings)
+
+    def test_worker_slot_respawn_callback_is_a_root(self):
+        """WorkerSlot(respawn) callables run on the supervisor thread."""
+        findings = lint_source(
+            """
+            import threading
+
+            class WorkerSlot:
+                def __init__(self, spawn):
+                    self._spawn = spawn
+
+            class W:
+                def __init__(self):
+                    self._procs = []
+
+                def add(self):
+                    def respawn():
+                        self._procs.append(object())
+                        return self._procs[-1]
+
+                    return WorkerSlot(respawn)
+
+                def alive(self):
+                    return list(self._procs)
+            """
+        )
+        assert "shared-state-race" in rules_of(findings)
+
+    def test_http_handler_registration_races_with_itself(self):
+        """Handlers registered via .route(method, path, fn) run one
+        thread per request — a multi-instance root that races with
+        itself even when it is the only discovered root."""
+        findings = lint_source(
+            """
+            class W:
+                def __init__(self, router):
+                    self._hits = {}
+                    router.route("GET", "/x", self._handle)
+
+                def _handle(self, req):
+                    self._hits["n"] = self._hits.get("n", 0) + 1
+                    return dict(self._hits)
+            """
+        )
+        assert "shared-state-race" in rules_of(findings)
+
+
+# -- per-file findings cache (analysis/cache.py) ---------------------------
+
+
+class TestLintCache:
+    BAD = "import time\ndeadline = time.time() + 5\n"
+
+    RACY = textwrap.dedent(
+        """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._items = []
+                t = threading.Thread(target=self._loop, daemon=True)
+                t.start()
+
+            def _loop(self):
+                while True:
+                    self._items.append(1)
+
+            def snapshot(self):
+                return list(self._items)
+        """
+    )
+
+    def _dicts(self, findings):
+        return [f.to_dict() for f in findings]
+
+    def test_warm_run_replays_identical_findings(self, tmp_path):
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "clock_bad.py").write_text(self.BAD)
+        (src / "race_bad.py").write_text(self.RACY)
+        cache_dir = str(tmp_path / "cache")
+        cold = run_lint([str(src)], root=str(src), cache_dir=cache_dir)
+        warm = run_lint([str(src)], root=str(src), cache_dir=cache_dir)
+        assert cold.cache == {"hits": 0, "misses": 2, "hitRate": 0.0}
+        assert warm.cache == {"hits": 2, "misses": 0, "hitRate": 1.0}
+        assert self._dicts(warm.new) == self._dicts(cold.new)
+        assert {f.rule for f in warm.new} >= {
+            "wall-clock", "shared-state-race",
+        }
+
+    def test_cache_is_keyed_by_content(self, tmp_path):
+        src = tmp_path / "src"
+        src.mkdir()
+        f = src / "mod.py"
+        f.write_text(self.BAD)
+        cache_dir = str(tmp_path / "cache")
+        run_lint([str(src)], root=str(src), cache_dir=cache_dir)
+        f.write_text("x = 1\n")  # finding fixed -> content key changes
+        warm = run_lint([str(src)], root=str(src), cache_dir=cache_dir)
+        assert warm.cache["misses"] == 1
+        assert warm.new == []
+
+    def test_corrupt_entry_is_a_miss_not_a_crash(self, tmp_path):
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "mod.py").write_text(self.BAD)
+        cache_dir = tmp_path / "cache"
+        run_lint([str(src)], root=str(src), cache_dir=str(cache_dir))
+        entries = list(cache_dir.glob("*.json"))
+        assert len(entries) == 1
+        entries[0].write_text("{truncated")
+        warm = run_lint(
+            [str(src)], root=str(src), cache_dir=str(cache_dir)
+        )
+        assert warm.cache["misses"] == 1
+        assert [f.rule for f in warm.new] == ["wall-clock"]
+
+    def test_cross_file_rules_bypass_the_cache(self, tmp_path):
+        """metric-labels depends on OTHER files: editing b.py must
+        re-evaluate the conflict even though a.py is a cache hit."""
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "a.py").write_text(
+            'c = registry.counter("pio_x_total", "x", ("k",))\n'
+        )
+        (src / "b.py").write_text("x = 1\n")
+        cache_dir = str(tmp_path / "cache")
+        first = run_lint([str(src)], root=str(src), cache_dir=cache_dir)
+        assert first.new == []
+        (src / "b.py").write_text(
+            'c = registry.counter("pio_x_total", "x")\n'
+        )
+        warm = run_lint([str(src)], root=str(src), cache_dir=cache_dir)
+        assert warm.cache["hits"] == 1  # a.py unchanged
+        assert "metric-labels" in {f.rule for f in warm.new}
+
+    def test_cached_raw_findings_get_fresh_suppressions(self, tmp_path):
+        """Entries store findings pre-suppression; the engine applies
+        suppression comments on every run (cache hit or not)."""
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "mod.py").write_text(
+            "import time\n"
+            "deadline = time.time() + 5"
+            "  # pio-lint: disable=wall-clock -- fixture\n"
+        )
+        cache_dir = str(tmp_path / "cache")
+        cold = run_lint([str(src)], root=str(src), cache_dir=cache_dir)
+        warm = run_lint([str(src)], root=str(src), cache_dir=cache_dir)
+        assert cold.new == [] and warm.new == []
+        assert warm.cache["hits"] == 1
+
+    def test_cli_summary_and_json_report_hit_rate(
+        self, tmp_path, capsys, monkeypatch
+    ):
+        import json as _json
+
+        from predictionio_tpu.cli.main import main
+
+        (tmp_path / "ok.py").write_text("x = 1\n")
+        cache_dir = str(tmp_path / "cache")
+        monkeypatch.chdir(tmp_path)
+        assert main(["lint", "ok.py", "--no-baseline",
+                     "--cache-dir", cache_dir]) == 0
+        out = capsys.readouterr().out
+        assert "cache 0/1 hits (0%)" in out
+        assert main(["lint", "ok.py", "--no-baseline",
+                     "--cache-dir", cache_dir, "--json"]) == 0
+        payload = _json.loads(capsys.readouterr().out)
+        assert payload["cache"] == {
+            "hits": 1, "misses": 0, "hitRate": 1.0,
+        }
+
+    def test_no_cache_flag_disables_reporting(self, tmp_path, capsys,
+                                              monkeypatch):
+        from predictionio_tpu.cli.main import main
+
+        (tmp_path / "ok.py").write_text("x = 1\n")
+        monkeypatch.chdir(tmp_path)
+        assert main(["lint", "ok.py", "--no-baseline",
+                     "--no-cache"]) == 0
+        assert "cache" not in capsys.readouterr().out
+
+    def test_unwritable_cache_dir_degrades_silently(self, tmp_path):
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "mod.py").write_text(self.BAD)
+        blocked = tmp_path / "blocked"
+        blocked.write_text("not a directory")
+        result = run_lint(
+            [str(src)], root=str(src),
+            cache_dir=str(blocked / "sub"),
+        )
+        assert [f.rule for f in result.new] == ["wall-clock"]
+        assert result.cache["misses"] == 1
+
+
+# -- SARIF output (analysis/sarif.py) --------------------------------------
+
+
+class TestSarifFormat:
+    BAD = "import time\ndeadline = time.time() + 5\n"
+
+    def _run_sarif(self, tmp_path, capsys, monkeypatch, text):
+        import json as _json
+
+        from predictionio_tpu.cli.main import main
+
+        (tmp_path / "mod.py").write_text(text)
+        monkeypatch.chdir(tmp_path)
+        rc = main(["lint", "mod.py", "--no-baseline", "--no-cache",
+                   "--format", "sarif"])
+        return rc, _json.loads(capsys.readouterr().out)
+
+    def test_document_shape_and_rule_catalog(self, tmp_path, capsys,
+                                             monkeypatch):
+        from predictionio_tpu.analysis import RULES
+
+        rc, doc = self._run_sarif(tmp_path, capsys, monkeypatch,
+                                  self.BAD)
+        assert rc == 1  # findings still fail the gate after upload
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        driver = run["tool"]["driver"]
+        assert driver["name"] == "pio-tpu-lint"
+        assert {r["id"] for r in driver["rules"]} == set(RULES)
+        for r in driver["rules"]:
+            assert r["help"]["text"].startswith("fix: ")
+            assert r["defaultConfiguration"]["level"] == "error"
+
+    def test_result_location_and_fingerprint(self, tmp_path, capsys,
+                                             monkeypatch):
+        rc, doc = self._run_sarif(tmp_path, capsys, monkeypatch,
+                                  self.BAD)
+        results = doc["runs"][0]["results"]
+        assert len(results) == 1
+        r = results[0]
+        assert r["ruleId"] == "wall-clock"
+        loc = r["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == "mod.py"
+        assert loc["region"]["startLine"] == 2
+        assert loc["region"]["startColumn"] >= 1  # 1-based
+        # line-number-free identity, same as the baseline fingerprint
+        assert r["partialFingerprints"]["pioLint/v1"] == (
+            "wall-clock|mod.py||deadline = time.time() + 5"
+        )
+
+    def test_clean_tree_is_an_empty_run(self, tmp_path, capsys,
+                                        monkeypatch):
+        rc, doc = self._run_sarif(tmp_path, capsys, monkeypatch,
+                                  "x = 1\n")
+        assert rc == 0
+        run = doc["runs"][0]
+        assert run["results"] == []
+        assert run["invocations"][0]["executionSuccessful"] is True
+
+    def test_unanalyzable_file_is_a_tool_notification(
+        self, tmp_path, capsys, monkeypatch
+    ):
+        import json as _json
+
+        from predictionio_tpu.cli.main import main
+
+        (tmp_path / "broken.py").write_text("def f(:\n")
+        monkeypatch.chdir(tmp_path)
+        rc = main(["lint", "broken.py", "--no-baseline", "--no-cache",
+                   "--format", "sarif"])
+        captured = capsys.readouterr()
+        doc = _json.loads(captured.out)
+        inv = doc["runs"][0]["invocations"][0]
+        assert rc == 1
+        assert inv["executionSuccessful"] is False
+        assert inv["toolExecutionNotifications"]
+
+
+class TestChangedScopeRenames:
+    """Rename handling for --changed: the diff is read with
+    --name-status --find-renames, so scope is config-independent."""
+
+    BAD = TestChangedScope.BAD
+    _git = TestChangedScope._git
+    _init_repo = TestChangedScope._init_repo
+
+    def test_renamed_file_enters_scope_under_new_path(
+        self, tmp_path, capsys, monkeypatch
+    ):
+        import json as _json
+
+        from predictionio_tpu.cli.main import main
+
+        self._init_repo(tmp_path)
+        # pin rename detection OFF: the scope must not depend on the
+        # user's diff.renames config (plain --name-only would then
+        # list the old path too)
+        self._git(tmp_path, "config", "diff.renames", "false")
+        (tmp_path / "old_name.py").write_text(self.BAD)
+        self._git(tmp_path, "add", "old_name.py")
+        self._git(tmp_path, "commit", "-q", "-m", "seed")
+        self._git(tmp_path, "mv", "old_name.py", "new_name.py")
+        monkeypatch.chdir(tmp_path)
+        rc = main(["lint", ".", "--no-baseline", "--changed", "HEAD",
+                   "--json"])
+        payload = _json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert payload["scopedTo"] == ["new_name.py"]
+        assert {f["path"] for f in payload["new"]} == {"new_name.py"}
+
+    def test_rename_with_edit_still_enters_scope(self, tmp_path,
+                                                 capsys, monkeypatch):
+        """R<score> < 100: content changed during the rename — the new
+        path must still be the one in scope."""
+        import json as _json
+
+        from predictionio_tpu.cli.main import main
+
+        self._init_repo(tmp_path)
+        body = self.BAD + "".join(f"x{i} = {i}\n" for i in range(20))
+        (tmp_path / "old_name.py").write_text(body)
+        self._git(tmp_path, "add", "old_name.py")
+        self._git(tmp_path, "commit", "-q", "-m", "seed")
+        self._git(tmp_path, "mv", "old_name.py", "new_name.py")
+        (tmp_path / "new_name.py").write_text(body + "tail = 21\n")
+        self._git(tmp_path, "add", "new_name.py")
+        monkeypatch.chdir(tmp_path)
+        rc = main(["lint", ".", "--no-baseline", "--changed", "HEAD",
+                   "--json"])
+        payload = _json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert payload["scopedTo"] == ["new_name.py"]
+        assert {f["path"] for f in payload["new"]} == {"new_name.py"}
+
+    def test_deleted_file_stays_out_of_scope(self, tmp_path, capsys,
+                                             monkeypatch):
+        from predictionio_tpu.cli.main import main
+
+        self._init_repo(tmp_path)
+        (tmp_path / "gone.py").write_text(self.BAD)
+        (tmp_path / "keep.py").write_text("x = 1\n")
+        self._git(tmp_path, "add", "gone.py", "keep.py")
+        self._git(tmp_path, "commit", "-q", "-m", "seed")
+        self._git(tmp_path, "rm", "-q", "gone.py")
+        monkeypatch.chdir(tmp_path)
+        # the only change is a deletion: nothing in scope, exit 0
+        assert main(["lint", ".", "--no-baseline", "--changed",
+                     "HEAD"]) == 0
+        capsys.readouterr()
+
+
+class TestThreadOwnershipMap:
+    """The docs/robustness.md "Thread ownership map" claims, asserted
+    against the checker's own model — the docs table and this test
+    read the same facts, so the documentation cannot drift from what
+    the analyzer actually proves."""
+
+    DANGEROUS = ("write", "rmw", "mutate", "iter")
+
+    def _model(self, rel):
+        from predictionio_tpu.analysis import threads as threads_mod
+
+        path = os.path.join(REPO_ROOT, rel)
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        return threads_mod.get_model(SourceModule(path, rel, text))
+
+    def _sites(self, model):
+        from predictionio_tpu.analysis.checkers import races
+
+        return races._attributed_sites(model)
+
+    def _assert_guarded(self, model, owner, field, lock):
+        """Every dangerous access of owner.field that runs on a thread
+        root holds `lock` (lexically or via every caller)."""
+        sites = self._sites(model)[(owner, field)]
+        dangerous = [s for s in sites if s.acc.kind in self.DANGEROUS]
+        assert dangerous, f"{owner}.{field}: no dangerous sites?"
+        for s in dangerous:
+            assert lock in s.locks, (
+                f"{owner}.{field} {s.acc.kind} at line {s.acc.line} "
+                f"({s.acc.qual}) holds {sorted(s.locks)}, not {lock}"
+            )
+
+    def _root_entries(self, model):
+        return {r.entry for r in model.roots if r.entry}
+
+    def test_batcher_fields_are_cv_guarded(self):
+        model = self._model("predictionio_tpu/serving/batching.py")
+        entries = self._root_entries(model)
+        assert "MicroBatcher._loop" in entries  # collector
+        assert "MicroBatcher._complete_loop" in entries  # completer
+        self._assert_guarded(
+            model, "MicroBatcher", "_buf", "MicroBatcher._cv"
+        )
+        self._assert_guarded(
+            model, "MicroBatcher", "_batch_ewma_s", "MicroBatcher._cv"
+        )
+
+    def test_router_fields_are_lock_guarded(self):
+        model = self._model("predictionio_tpu/serving/router.py")
+        entries = self._root_entries(model)
+        assert "ServingRouter._probe_loop" in entries
+        assert any(r.kind == "handler" for r in model.roots)
+        assert any(r.kind == "hook" for r in model.roots)  # close
+        for field in ("_replicas", "_swaps", "_shed_count",
+                      "_ring_cache", "_fleet_gate"):
+            self._assert_guarded(
+                model, "ServingRouter", field, "ServingRouter._lock"
+            )
+
+    def test_canary_counters_are_lock_guarded(self):
+        model = self._model("predictionio_tpu/serving/canary.py")
+        assert "ShadowCanary._shadow_worker" in self._root_entries(
+            model
+        )
+        for field in ("_samples", "_seen_requests", "_nan",
+                      "_exceptions", "_state"):
+            self._assert_guarded(
+                model, "ShadowCanary", field, "ShadowCanary._lock"
+            )
+
+    def test_autoscaler_bookkeeping_is_lock_guarded(self):
+        model = self._model("predictionio_tpu/serving/autoscaler.py")
+        entries = self._root_entries(model)
+        assert "ReplicaAutoscaler._run" in entries  # reconcile loop
+        assert "ReplicaAutoscaler.spawn_for_swap" in entries  # swap cb
+        self._assert_guarded(
+            model, "ReplicaAutoscaler", "_owned",
+            "ReplicaAutoscaler._lock",
+        )
+        self._assert_guarded(
+            model, "ReplicaAutoscaler", "_slots",
+            "ReplicaAutoscaler._lock",
+        )
+
+    def test_engine_server_canary_slot_is_lock_guarded(self):
+        model = self._model("predictionio_tpu/serving/engine_server.py")
+        self._assert_guarded(
+            model, "EngineServer", "_canary", "EngineServer._lock"
+        )
+        self._assert_guarded(
+            model, "EngineServer", "_batchers", "EngineServer._lock"
+        )
