@@ -3,15 +3,16 @@
 The rule set splits cleanly in two:
 
 * **per-file checkers** (``clock``, ``device_sync``, ``donation``,
-  ``threads``, ``races`` — each marks itself ``PER_FILE = True``): a
-  module's findings are a pure function of that module's text. These
-  are cacheable — and they carry the expensive per-module models
-  (the thread-root/lockset model alone is ~⅓ of a cold run);
+  ``threads``, ``races``, ``lifecycle`` — each marks itself
+  ``PER_FILE = True``): a module's findings are a pure function of
+  that module's text. These are cacheable — and they carry the
+  expensive per-module models (the thread-root/lockset model alone is
+  ~⅓ of a cold run);
 * **cross-file checkers** (``locks``, ``jit_retrace``,
-  ``sharding_spec``, ``telemetry``): lock-order cycles, imported-jit
-  call sites, the mesh-axis and metric-name registries all depend on
-  *other* files' content. Caching them per file would be unsound, so
-  they run every time.
+  ``sharding_spec``, ``telemetry``, ``wire_contract``): lock-order
+  cycles, imported-jit call sites, the mesh-axis, metric-name and
+  wire-contract registries all depend on *other* files' content.
+  Caching them per file would be unsound, so they run every time.
 
 The engine skips the per-file checkers for every module whose entry is
 present and re-runs them only on the misses. Soundness:
@@ -52,7 +53,23 @@ _SCHEMA = 1
 #: prune entries not read/written for this long (best effort)
 _PRUNE_AGE_S = 30 * 24 * 3600.0
 
-_salt_memo: str | None = None
+#: env vars that may change what the analyzer reports (reserved
+#: PIO_LINT_* namespace for future knobs) — their values are part of
+#: the cache key, so a finding set produced under one configuration
+#: never replays under another
+_LINT_ENV_PREFIX = "PIO_LINT_"
+
+#: memoized per lint-env tuple (the analyzer sources cannot change
+#: within a process, but the env can — tests flip it)
+_salt_memo: dict[tuple, str] = {}
+
+
+def _lint_env() -> tuple:
+    return tuple(sorted(
+        (k, v)
+        for k, v in os.environ.items()
+        if k.startswith(_LINT_ENV_PREFIX)
+    ))
 
 
 def default_cache_dir() -> str:
@@ -64,11 +81,15 @@ def default_cache_dir() -> str:
 
 def analyzer_salt() -> str:
     """Digest of the analyzer itself: every ``.py`` under
-    ``predictionio_tpu/analysis`` plus the Python version and the cache
-    schema. Editing any checker invalidates the whole cache."""
-    global _salt_memo
-    if _salt_memo is not None:
-        return _salt_memo
+    ``predictionio_tpu/analysis`` plus the Python major.minor (an AST
+    produced under 3.11 must not replay under 3.12, where the grammar
+    differs — try/except*, new nodes), the lint-relevant ``PIO_LINT_*``
+    env, and the cache schema. Editing any checker invalidates the
+    whole cache."""
+    env = _lint_env()
+    cached = _salt_memo.get(env)
+    if cached is not None:
+        return cached
     pkg_root = os.path.dirname(os.path.abspath(__file__))
     sources: list[str] = []
     for dirpath, dirnames, filenames in os.walk(pkg_root):
@@ -81,6 +102,9 @@ def analyzer_salt() -> str:
         f"pio-lint-cache/{_SCHEMA}|py{sys.version_info[0]}."
         f"{sys.version_info[1]}|".encode()
     )
+    for key, value in env:
+        h.update(f"{key}={value}".encode())
+        h.update(b"\0")
     for path in sorted(sources):
         h.update(os.path.relpath(path, pkg_root).encode())
         h.update(b"\0")
@@ -92,8 +116,8 @@ def analyzer_salt() -> str:
             # worst case the cache over-invalidates, never under
             h.update(b"<unreadable>")
         h.update(b"\0")
-    _salt_memo = h.hexdigest()
-    return _salt_memo
+    _salt_memo[env] = h.hexdigest()
+    return _salt_memo[env]
 
 
 def _finding_to_entry(f: Finding) -> dict:

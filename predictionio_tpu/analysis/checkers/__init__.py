@@ -19,11 +19,13 @@ from predictionio_tpu.analysis.checkers import (
     device_sync,
     donation,
     jit_retrace,
+    lifecycle,
     locks,
     races,
     sharding_spec,
     telemetry,
     threads,
+    wire_contract,
 )
 
 _CHECKER_MODULES = (
@@ -36,6 +38,8 @@ _CHECKER_MODULES = (
     threads,
     races,
     telemetry,
+    lifecycle,
+    wire_contract,
 )
 
 ALL_CHECKERS = tuple(mod.check for mod in _CHECKER_MODULES)

@@ -12,10 +12,12 @@ can't silently bloat the CI gate — ``scripts/check.sh`` enforces a
 30 s total budget.
 
 ``changed_ref`` scopes *reporting* to files touched vs a git ref
-(``pio-tpu lint --changed``): the full tree is still loaded and
+(``pio-tpu lint --changed``) — more precisely vs ``git merge-base REF
+HEAD``, so a feature branch's ``--changed main`` never pulls in files
+main changed since the branch point. The full tree is still loaded and
 analyzed so project-wide rules (lock cycles, metric-name registry,
-mesh-axis registry) keep their context, but findings are only reported
-in changed files. When git is unavailable the scope silently widens
+mesh-axis registry, the wire-contract registries) keep their context,
+but findings are only reported in changed files. When git is unavailable the scope silently widens
 back to the full tree — the fast path must never be less strict than
 the slow one.
 """
@@ -160,6 +162,21 @@ def _git_changed_files(root: str, ref: str) -> tuple[set[str] | None, str]:
                 "(note: `--changed <path>` parses the path as the REF "
                 "— put paths before the flag or use `--changed HEAD`)"
             )
+        # diff against merge-base(REF, HEAD), not REF itself: on a
+        # feature branch, `--changed main` must scope to what the
+        # BRANCH changed — diffing against main directly would also
+        # pull in every file main changed since the branch point
+        # (files this checkout never touched). When REF is an
+        # ancestor of HEAD the merge-base IS REF, so linear history
+        # behaves exactly as before; no common ancestor (orphan
+        # branches) falls back to REF.
+        base = ref
+        merge_base = subprocess.run(
+            ["git", "merge-base", ref, "HEAD"],
+            cwd=root, capture_output=True, text=True, timeout=10,
+        )
+        if merge_base.returncode == 0 and merge_base.stdout.strip():
+            base = merge_base.stdout.strip()
         # --name-status --find-renames, not --name-only: a renamed
         # file must enter scope under its NEW path (an `R` line), and
         # the OLD path must stay out of the changed set so it can't
@@ -167,11 +184,11 @@ def _git_changed_files(root: str, ref: str) -> tuple[set[str] | None, str]:
         # to the user's diff.renames config — scope would then depend
         # on local git configuration.
         diff = subprocess.run(
-            ["git", "diff", "--name-status", "--find-renames", ref],
+            ["git", "diff", "--name-status", "--find-renames", base],
             cwd=root, capture_output=True, text=True, timeout=10,
         )
         if diff.returncode != 0:
-            return None, diff.stderr.strip() or f"git diff {ref} failed"
+            return None, diff.stderr.strip() or f"git diff {base} failed"
         untracked = subprocess.run(
             ["git", "ls-files", "--others", "--exclude-standard"],
             cwd=root, capture_output=True, text=True, timeout=10,
@@ -211,7 +228,15 @@ def run_lint(
     baseline_path: str | None = None,
     changed_ref: str | None = None,
     cache_dir: str | None = None,
+    scope_paths: list[str] | None = None,
 ) -> LintResult:
+    """``scope_paths`` filters *reporting* to files under those paths
+    while ``paths`` is the full analysis surface — the same
+    load-everything/report-a-slice split ``changed_ref`` uses. The CLI
+    passes it when the user names explicit paths inside a project
+    whose default surface exists: cross-file rules (wire-contract
+    pairing, lock graphs, metric registries) would otherwise see only
+    half the wire and cry wolf about the missing half."""
     root = os.path.abspath(root or os.getcwd())
     start = time.monotonic()
     cache = None
@@ -228,6 +253,18 @@ def run_lint(
 
     notes: list[str] = []
     scoped_to: list[str] | None = None
+    if scope_paths is not None:
+        in_scope = {
+            os.path.relpath(f, root).replace(os.sep, "/")
+            for f in iter_python_files(scope_paths)
+        }
+        scoped_to = sorted(
+            in_scope & {m.rel_path for m in modules}
+        )
+        findings = [f for f in findings if f.path in in_scope]
+        errors = [
+            e for e in errors if e.split(":", 1)[0] in in_scope
+        ]
     if changed_ref is not None:
         try:
             changed, reason = _git_changed_files(root, changed_ref)
@@ -244,9 +281,10 @@ def run_lint(
                     "full tree"
                 )
         else:
-            scoped_to = sorted(
-                changed & {m.rel_path for m in modules}
-            )
+            visible = changed & {m.rel_path for m in modules}
+            if scoped_to is not None:
+                visible &= set(scoped_to)
+            scoped_to = sorted(visible)
             findings = [f for f in findings if f.path in changed]
             errors = [
                 e for e in errors

@@ -101,6 +101,49 @@ RULES: dict[str, Rule] = {
             "so an interposing thread cannot invalidate the decision",
         ),
         Rule(
+            "wire-header",
+            "X-PIO-* header contract broken (unpaired producer/"
+            "consumer, or a near-miss spelling)",
+            "set and read the header through one shared module "
+            "constant (resilience.DEADLINE_HEADER style) so both "
+            "sides of the wire agree on the exact name",
+        ),
+        Rule(
+            "wire-route",
+            "client request path matches no registered route",
+            "register the route on the serving side, or fix the "
+            "client path to match an existing Router.route pattern",
+        ),
+        Rule(
+            "wire-metric",
+            "metric scraped by name but never registered",
+            "register the metric with registry.counter/gauge/"
+            "histogram, or fix the scrape to an exported name — a "
+            "scrape of an unregistered name can only read absent",
+        ),
+        Rule(
+            "wire-env",
+            "PIO_* env var read in code but absent from the docs env "
+            "tables",
+            "add the variable to the relevant docs/*.md env table "
+            "(name, default, semantics) — undocumented knobs cannot "
+            "be discovered by operators",
+        ),
+        Rule(
+            "acquire-release",
+            "paired acquire/release protocol not exception-safe",
+            "pair every try_acquire/begin/inflight-increment with its "
+            "release/end/decrement in a finally block so exception "
+            "paths cannot leak the slot",
+        ),
+        Rule(
+            "resource-leak",
+            "OS resource (file/socket/process/tempdir) without "
+            "guaranteed cleanup",
+            "open resources in a with statement, close them in a "
+            "finally, or hand ownership to a component that does",
+        ),
+        Rule(
             "thread-lifecycle",
             "thread neither daemonized nor joined",
             "pass daemon=True (documenting the shutdown contract) or "

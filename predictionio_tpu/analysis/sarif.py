@@ -47,8 +47,25 @@ def _sarif_rules() -> list[dict]:
     return out
 
 
-def _sarif_result(f: Finding, rule_index: dict[str, int]) -> dict:
+def _sarif_result(
+    f: Finding, rule_index: dict[str, int], context_unique: bool
+) -> dict:
     fp_rule, fp_path, fp_ctx, fp_src = f.fingerprint()
+    fingerprints = {
+        "pioLint/v1": f"{fp_rule}|{fp_path}|{fp_ctx}|{fp_src}",
+    }
+    if context_unique:
+        # path-free identity: survives a file RENAME on top of the
+        # line-number freedom above (code scanning matches alerts on
+        # any shared fingerprint key, so a rename plus edits above the
+        # site keeps the alert instead of closing and reopening it
+        # under a new identity). Omitted when two findings in
+        # DIFFERENT files share the triple (copy-paste twins): a
+        # shared key would conflate two distinct alerts, and fixing
+        # one would silently close the other.
+        fingerprints["pioLint/contextV1"] = (
+            f"{fp_rule}|{fp_ctx}|{fp_src}"
+        )
     return {
         "ruleId": f.rule,
         "ruleIndex": rule_index[f.rule],
@@ -74,9 +91,7 @@ def _sarif_result(f: Finding, rule_index: dict[str, int]) -> dict:
                 ),
             }
         ],
-        "partialFingerprints": {
-            "pioLint/v1": f"{fp_rule}|{fp_path}|{fp_ctx}|{fp_src}",
-        },
+        "partialFingerprints": fingerprints,
     }
 
 
@@ -85,6 +100,11 @@ def render_sarif(result, tool_version: str) -> str:
     the shipped baseline is empty by policy, and a baselined finding is
     accepted debt, not an alert)."""
     rule_index = {rid: i for i, rid in enumerate(_rule_ids())}
+    triple_counts: dict[tuple, int] = {}
+    for f in result.new:
+        fp_rule, _p, fp_ctx, fp_src = f.fingerprint()
+        key = (fp_rule, fp_ctx, fp_src)
+        triple_counts[key] = triple_counts.get(key, 0) + 1
     notifications = [
         {
             "level": "error",
@@ -103,7 +123,16 @@ def render_sarif(result, tool_version: str) -> str:
             }
         },
         "columnKind": "utf16CodeUnits",
-        "results": [_sarif_result(f, rule_index) for f in result.new],
+        "results": [
+            _sarif_result(
+                f,
+                rule_index,
+                context_unique=triple_counts[
+                    (f.fingerprint()[0],) + f.fingerprint()[2:]
+                ] == 1,
+            )
+            for f in result.new
+        ],
         "invocations": [
             {
                 "executionSuccessful": not result.errors,

@@ -118,6 +118,10 @@ def spawn_daemon(
     os.makedirs(_log_dir(), exist_ok=True)
     log = open(logfile(name), "ab", buffering=0)
     try:
+        # deliberately detached: the daemon outlives this process;
+        # ownership is the pidfile, teardown is stop_daemon's
+        # process-group SIGTERM
+        # pio-lint: disable-next=resource-leak -- detached daemon by design
         proc = subprocess.Popen(
             [sys.executable, "-m", "predictionio_tpu.cli.main", *argv],
             stdin=subprocess.DEVNULL,

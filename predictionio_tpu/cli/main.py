@@ -397,9 +397,12 @@ def cmd_lint(args) -> int:
     (docs/static_analysis.md): lock-order cycles, blocking calls under
     locks, wall-clock misuse, implicit device syncs on the dispatch
     path, jit retrace hazards, mesh/PartitionSpec hygiene, donated-
-    buffer reuse, thread lifecycle, telemetry hygiene. Pure stdlib —
-    never imports jax. Exit 0 = clean (baselined findings allowed),
-    1 = new findings or unanalyzable files."""
+    buffer reuse, thread lifecycle, telemetry hygiene, the distributed
+    wire contracts (X-PIO-* header pairing, routes vs request paths,
+    metric registrations vs scrapes, PIO_* env vs docs) and resource
+    lifecycles (acquire/release in finally, OS-resource cleanup on all
+    paths). Pure stdlib — never imports jax. Exit 0 = clean (baselined
+    findings allowed), 1 = new findings or unanalyzable files."""
     from predictionio_tpu.analysis import (
         render_baseline,
         render_sarif,
@@ -407,7 +410,43 @@ def cmd_lint(args) -> int:
     )
     from predictionio_tpu.analysis.cache import default_cache_dir
 
-    paths = args.paths or ["predictionio_tpu", "scripts"]
+    # the default surface: the package, the smoke/bench scripts, and
+    # the test CHILD processes — the *_child.py helpers run as real
+    # separate processes in the smokes, so they participate in the
+    # wire contract (headers, routes, metrics, env) even though the
+    # rest of tests/ stays outside the linted tree
+    import glob as _glob
+
+    default_surface = [
+        p
+        for p in ["predictionio_tpu", "scripts"]
+        if os.path.isdir(p)
+    ] + sorted(_glob.glob(os.path.join("tests", "*_child.py")))
+    paths = args.paths
+    scope_paths = None
+    if not paths:
+        paths = default_surface
+    elif default_surface and not args.write_baseline:
+        # explicit paths inside the project: ANALYZE the whole default
+        # surface (cross-file rules — wire-contract pairing, lock
+        # graphs, metric registries — need both sides of every wire or
+        # they cry wolf about the half that wasn't loaded) and REPORT
+        # only under the requested paths, exactly like --changed.
+        # --write-baseline keeps the old explicit semantics: you
+        # baseline exactly what you name.
+        requested = {os.path.abspath(p) for p in paths}
+
+        def _covered(path: str) -> bool:
+            ap = os.path.abspath(path)
+            return any(
+                ap == r or ap.startswith(r + os.sep)
+                for r in requested
+            )
+
+        extra = [p for p in default_surface if not _covered(p)]
+        if extra:
+            scope_paths = list(paths)
+            paths = paths + extra
     missing = [p for p in paths if not os.path.exists(p)]
     if missing:
         print(
@@ -435,6 +474,7 @@ def cmd_lint(args) -> int:
         baseline_path=baseline_path,
         changed_ref=args.changed,
         cache_dir=cache_dir,
+        scope_paths=scope_paths,
     )
 
     if args.write_baseline:
@@ -525,7 +565,9 @@ def cmd_lint(args) -> int:
             )
     scope = ""
     if result.scoped_to is not None:
-        scope = f", scoped to {len(result.scoped_to)} changed file(s)"
+        scope = (
+            f", reporting scoped to {len(result.scoped_to)} file(s)"
+        )
     slowest = ""
     if result.timings_ms:
         name, ms = max(result.timings_ms.items(), key=lambda kv: kv[1])

@@ -41,6 +41,14 @@ from predictionio_tpu.obs.tracing import PARENT_SPAN_HEADER, current_span
 from predictionio_tpu.serving import admission, resilience
 
 
+#: sticky-routing affinity key — same spelling as
+#: ``serving.router.AFFINITY_HEADER`` (kept local so the client SDK
+#: never imports the router module); the router hashes the value onto
+#: its consistent ring so one affinity key always lands on the same
+#: replica while the pool is stable
+AFFINITY_HEADER = "X-PIO-Affinity"
+
+
 class PIOClientError(RuntimeError):
     def __init__(
         self, status: int, message: str, request_id: str | None = None
@@ -54,11 +62,14 @@ class PIOClientError(RuntimeError):
 
 
 def _send_once(
-    url: str, method: str, data: bytes | None, deadline, timeout: float
+    url: str, method: str, data: bytes | None, deadline, timeout: float,
+    extra_headers: Mapping[str, str] | None = None,
 ) -> Any:
     req = urllib.request.Request(url, data=data, method=method)
     if data is not None:
         req.add_header("Content-Type", "application/json")
+    for name, value in (extra_headers or {}).items():
+        req.add_header(name, value)
     # join the caller's trace: forward the context request ID (even
     # with tracing off — without it every hop mints a fresh ID and
     # cross-server log correlation breaks) and, when a span is open,
@@ -85,7 +96,8 @@ def _send_once(
 
 
 def _request(
-    url: str, method: str = "GET", body: Any = None, timeout: float = 10.0
+    url: str, method: str = "GET", body: Any = None, timeout: float = 10.0,
+    extra_headers: Mapping[str, str] | None = None,
 ) -> Any:
     data = json.dumps(body).encode() if body is not None else None
     target = urllib.parse.urlsplit(url).netloc
@@ -112,7 +124,9 @@ def _request(
         if not breaker.allow():
             raise resilience.CircuitOpenError(target)
         try:
-            out = _send_once(url, method, data, deadline, timeout)
+            out = _send_once(
+                url, method, data, deadline, timeout, extra_headers
+            )
             breaker.record_success()
             return out
         except urllib.error.HTTPError as e:
@@ -293,29 +307,58 @@ class EventClient:
 
 
 class EngineClient:
-    """Talks to the Engine Server (default :8000)."""
+    """Talks to the Engine Server (default :8000) — or to a
+    ``pio-tpu router`` front tier, which speaks the same protocol.
 
-    def __init__(self, url: str = "http://127.0.0.1:8000"):
+    ``tenant`` labels every request for per-tenant fair-share admission
+    (``X-PIO-Tenant``; docs/robustness.md "Overload & backpressure"):
+    under sustained pressure a tenant over its equal share is shed
+    first, so an unlabeled client competes in the anonymous bucket."""
+
+    def __init__(
+        self,
+        url: str = "http://127.0.0.1:8000",
+        tenant: str | None = None,
+    ):
         self._base = url.rstrip("/")
+        self._tenant = tenant
+
+    def _headers(
+        self, affinity: str | None = None
+    ) -> dict[str, str]:
+        headers: dict[str, str] = {}
+        if self._tenant:
+            headers[admission.TENANT_HEADER] = self._tenant
+        if affinity:
+            headers[AFFINITY_HEADER] = affinity
+        return headers
 
     def send_query(
         self,
         data: Mapping[str, Any],
         timeout: float = 30.0,
         criticality: str | None = None,
+        affinity: str | None = None,
     ):
         """``criticality`` labels the request for admission control
         (``critical`` | ``default`` | ``sheddable``; docs/robustness.md
         "Overload & backpressure") — under server overload the lowest
-        class sheds first."""
+        class sheds first. ``affinity`` (docs/scale_out.md) pins the
+        request to a consistent replica when the target is a serving
+        router: pass a stable key (user ID, session) and the router's
+        hash ring keeps sending it to the same replica while the pool
+        is stable — without it affinity falls back to body bytes, so
+        two different queries from one user can land on two replicas."""
+        extra = self._headers(affinity)
         if criticality is not None:
             with admission.criticality(criticality):
                 return _request(
                     f"{self._base}/queries.json", "POST", dict(data),
-                    timeout,
+                    timeout, extra_headers=extra,
                 )
         return _request(
-            f"{self._base}/queries.json", "POST", dict(data), timeout
+            f"{self._base}/queries.json", "POST", dict(data), timeout,
+            extra_headers=extra,
         )
 
     def send_batch_queries(
@@ -334,6 +377,7 @@ class EngineClient:
             "POST",
             [dict(q) for q in queries],
             timeout,
+            extra_headers=self._headers(),
         )
 
     def status(self) -> dict:

@@ -499,134 +499,138 @@ class HTTPServer:
                 # server's capacity (dependency fast-fail, injected
                 # fault): released without feeding the limiter
                 no_verdict = False
-                if early is not None:
-                    response = early
-                else:
-                    # root span: trace ID = request ID; a forwarded
-                    # X-Parent-Span makes this request a child in a
-                    # distributed trace. Scrapes of the telemetry surface
-                    # itself would drown real traffic in the recorder; a
-                    # disabled tracer skips even the name/attribute builds.
-                    span_cm = (
-                        tracing.NOOP
-                        if not tracer_ref.enabled
-                        or parsed.path.startswith(("/metrics", "/debug/"))
-                        else tracer_ref.trace(
-                            f"{service} {self.command}",
-                            trace_id=request.request_id,
-                            parent_id=tracing.sanitize_id(
-                                self.headers.get(tracing.PARENT_SPAN_HEADER)
-                            ),
-                            attributes={
-                                "service": service,
-                                "method": self.command,
-                            },
-                        )
-                    )
-                    try:
-                        with span_cm as root_span:
-                            try:
-                                if (
-                                    chaos_ref is not None
-                                    and not telemetry_path
-                                ):
-                                    chaos_ref.apply(parsed.path)
-                                if config_ref is not None:
-                                    # resolve the route label BEFORE key
-                                    # auth so a 401 counts against the
-                                    # real route, not "(unmatched)"
-                                    # alongside path-scan noise
-                                    request.route = router_ref.match_route(
-                                        request
-                                    )
-                                    config_ref.check_key(request)
-                                response = router_ref.dispatch(request)
-                            except resilience.ChaosReset:
-                                raise  # handled below: slam the socket
-                            except HTTPError as e:
-                                response = Response(
-                                    e.status,
-                                    {"message": e.message},
-                                    headers=dict(e.headers),
-                                )
-                            except resilience.DeadlineExceeded as e:
-                                response = Response(
-                                    504,
-                                    {"message": f"deadline exceeded: {e}"},
-                                )
-                            except resilience.ChaosError as e:
-                                # an injected fault says nothing about
-                                # this server's capacity — it must not
-                                # feed the limiter (a chaos rehearsal
-                                # would drag the limit to the floor on
-                                # an unloaded server)
-                                no_verdict = True
-                                response = Response(
-                                    e.status, {"message": e.message}
-                                )
-                            except resilience.CircuitOpenError as e:
-                                # a dependency's breaker is open: the
-                                # request CAN be retried elsewhere/
-                                # later. A fast-fail says nothing
-                                # about THIS server's capacity, so it
-                                # is flagged out of the limiter's
-                                # latency signal below.
-                                no_verdict = True
-                                response = Response(
-                                    503,
-                                    {"message": str(e)},
-                                    headers={
-                                        "Retry-After": (
-                                            admission_ref
-                                            .retry_after_header()
-                                            if admission_ref is not None
-                                            else "1"
-                                        )
-                                    },
-                                )
-                            except json.JSONDecodeError as e:
-                                response = Response(
-                                    400, {"message": f"bad JSON: {e}"}
-                                )
-                            except Exception as e:  # noqa: BLE001 - server boundary
-                                logger.exception("handler error")
-                                response = Response(
-                                    500, {"message": str(e)}
-                                )
-                            if root_span is not None:
-                                root_span.set(
-                                    "route", request.route or "(unmatched)"
-                                )
-                                root_span.set("status", response.status)
-                    except resilience.ChaosReset:
-                        if admitted:
-                            # a slammed connection produced no verdict
-                            # about capacity — release without a sample
-                            admission_ref.release(
-                                time.perf_counter() - t0,
-                                admission.OUTCOME_IGNORE,
-                                tenant,
-                            )
-                        log_json(
-                            access_logger, logging.INFO, "chaos_reset",
-                            service=service, path=parsed.path,
-                        )
-                        self.close_connection = True
-                        return
-                elapsed = time.perf_counter() - t0
-                if admitted:
-                    # outcome classification feeds the adaptive limit:
-                    # sheds and deadline misses are the AIMD backoff
-                    # signal; a circuit-open fast-fail is NO sample (its
-                    # near-zero latency would inflate the limit); every
-                    # real served request is a latency sample
-                    if no_verdict:
-                        outcome = admission.OUTCOME_IGNORE
-                    elif response.status in (429, 503, 504):
-                        outcome = admission.OUTCOME_DROP
+                response: Response | None = None
+                try:
+                    if early is not None:
+                        response = early
                     else:
-                        outcome = admission.OUTCOME_OK
-                    admission_ref.release(elapsed, outcome, tenant)
+                        # root span: trace ID = request ID; a forwarded
+                        # X-Parent-Span makes this request a child in a
+                        # distributed trace. Scrapes of the telemetry surface
+                        # itself would drown real traffic in the recorder; a
+                        # disabled tracer skips even the name/attribute builds.
+                        span_cm = (
+                            tracing.NOOP
+                            if not tracer_ref.enabled
+                            or parsed.path.startswith(("/metrics", "/debug/"))
+                            else tracer_ref.trace(
+                                f"{service} {self.command}",
+                                trace_id=request.request_id,
+                                parent_id=tracing.sanitize_id(
+                                    self.headers.get(tracing.PARENT_SPAN_HEADER)
+                                ),
+                                attributes={
+                                    "service": service,
+                                    "method": self.command,
+                                },
+                            )
+                        )
+                        try:
+                            with span_cm as root_span:
+                                try:
+                                    if (
+                                        chaos_ref is not None
+                                        and not telemetry_path
+                                    ):
+                                        chaos_ref.apply(parsed.path)
+                                    if config_ref is not None:
+                                        # resolve the route label BEFORE key
+                                        # auth so a 401 counts against the
+                                        # real route, not "(unmatched)"
+                                        # alongside path-scan noise
+                                        request.route = router_ref.match_route(
+                                            request
+                                        )
+                                        config_ref.check_key(request)
+                                    response = router_ref.dispatch(request)
+                                except resilience.ChaosReset:
+                                    raise  # handled below: slam the socket
+                                except HTTPError as e:
+                                    response = Response(
+                                        e.status,
+                                        {"message": e.message},
+                                        headers=dict(e.headers),
+                                    )
+                                except resilience.DeadlineExceeded as e:
+                                    response = Response(
+                                        504,
+                                        {"message": f"deadline exceeded: {e}"},
+                                    )
+                                except resilience.ChaosError as e:
+                                    # an injected fault says nothing about
+                                    # this server's capacity — it must not
+                                    # feed the limiter (a chaos rehearsal
+                                    # would drag the limit to the floor on
+                                    # an unloaded server)
+                                    no_verdict = True
+                                    response = Response(
+                                        e.status, {"message": e.message}
+                                    )
+                                except resilience.CircuitOpenError as e:
+                                    # a dependency's breaker is open: the
+                                    # request CAN be retried elsewhere/
+                                    # later. A fast-fail says nothing
+                                    # about THIS server's capacity, so it
+                                    # is flagged out of the limiter's
+                                    # latency signal below.
+                                    no_verdict = True
+                                    response = Response(
+                                        503,
+                                        {"message": str(e)},
+                                        headers={
+                                            "Retry-After": (
+                                                admission_ref
+                                                .retry_after_header()
+                                                if admission_ref is not None
+                                                else "1"
+                                            )
+                                        },
+                                    )
+                                except json.JSONDecodeError as e:
+                                    response = Response(
+                                        400, {"message": f"bad JSON: {e}"}
+                                    )
+                                except Exception as e:  # noqa: BLE001 - server boundary
+                                    logger.exception("handler error")
+                                    response = Response(
+                                        500, {"message": str(e)}
+                                    )
+                                if root_span is not None:
+                                    root_span.set(
+                                        "route", request.route or "(unmatched)"
+                                    )
+                                    root_span.set("status", response.status)
+                        except resilience.ChaosReset:
+                            # a slammed connection produced no verdict
+                            # about capacity — the finally below
+                            # releases without a latency sample
+                            no_verdict = True
+                            log_json(
+                                access_logger, logging.INFO, "chaos_reset",
+                                service=service, path=parsed.path,
+                            )
+                            self.close_connection = True
+                            return
+                finally:
+                    # EVERY admitted request releases its slot exactly
+                    # once — here, on all paths: normal responses, the
+                    # chaos-reset early return, and anything escaping
+                    # the handler machinery itself (which produced no
+                    # response and therefore no capacity verdict).
+                    # Outcome classification feeds the adaptive limit:
+                    # sheds and deadline misses are the AIMD backoff
+                    # signal; a circuit-open fast-fail is NO sample
+                    # (its near-zero latency would inflate the limit);
+                    # every real served request is a latency sample
+                    elapsed = time.perf_counter() - t0
+                    if admitted:
+                        if no_verdict or response is None:
+                            outcome = admission.OUTCOME_IGNORE
+                        elif response.status in (429, 503, 504):
+                            outcome = admission.OUTCOME_DROP
+                        else:
+                            outcome = admission.OUTCOME_OK
+                        admission_ref.release(elapsed, outcome, tenant)
                 if response.status >= 400 and isinstance(
                     response.body, dict
                 ):

@@ -1246,6 +1246,17 @@ class ServingRouter:
             req.add_header(
                 admission.CRITICALITY_HEADER, request.criticality
             )
+        # the tenant identity propagates too (resolved the same way
+        # the HTTP admission gate resolves it: accessKey first, then
+        # the explicit header) — without this hop the replica's
+        # per-tenant fair share only ever saw anonymous traffic from
+        # the router, so one tenant could starve the rest THROUGH the
+        # router while direct traffic was correctly clamped
+        tenant = request.query.get("accessKey") or request.headers.get(
+            admission.TENANT_HEADER
+        )
+        if tenant:
+            req.add_header(admission.TENANT_HEADER, tenant)
         # nest the replica's root span under the forward span (or the
         # router's root when tracing the forward itself is disabled)
         parent = span if span is not None else tracing.current_span()

@@ -28,8 +28,12 @@ if [ -n "${GITHUB_ACTIONS:-}" ]; then
     lint_fmt=(--format github)
 fi
 lint_start=$SECONDS
+# the linted surface includes the test CHILD processes (tests/*_child.py
+# run as real separate processes in the smokes, so they participate in
+# the wire contract) but not the rest of tests/
 if ! timeout -k 10 120 python -m predictionio_tpu.cli.main lint \
-    predictionio_tpu scripts ${lint_fmt[@]+"${lint_fmt[@]}"}; then
+    predictionio_tpu scripts tests/*_child.py \
+    ${lint_fmt[@]+"${lint_fmt[@]}"}; then
     echo "pio-tpu lint FAILED (new findings — fix, suppress with a"
     echo "reason, or accept via: pio-tpu lint --write-baseline)"
     rc=1

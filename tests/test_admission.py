@@ -390,6 +390,43 @@ class TestAdmissionOverHTTP:
         finally:
             http.shutdown()
 
+    def test_slot_released_when_handler_machinery_raises(self):
+        """Regression for the ``acquire-release`` lint finding: an
+        exception escaping the handler *machinery* itself (here the
+        tracer's span factory — upstream of the dispatch try/except)
+        must still release the admission slot. Before the release
+        moved into a ``finally``, every such crash leaked a slot until
+        the limiter pinned the server shut."""
+
+        class BoomTracer:
+            enabled = True
+
+            def trace(self, *args, **kwargs):
+                raise RuntimeError("span factory down")
+
+        ctrl = _fixed_controller(2.0)
+        router = Router()
+        router.route("GET", "/work", lambda request: Response(200, {}))
+        router.admission = ctrl
+        http = HTTPServer(
+            router, host="127.0.0.1", port=0, service="test",
+            tracer=BoomTracer(),
+        )
+        http.start()
+        try:
+            base = f"http://127.0.0.1:{http.port}"
+            for _ in range(3):  # more crashes than the limit of 2
+                try:
+                    self._get(base + "/work")
+                except OSError:
+                    pass  # the connection dies mid-crash; that's fine
+            assert ctrl.inflight == 0
+            # released with NO verdict: a machinery crash says nothing
+            # about capacity, so it must not feed the latency signal
+            assert ctrl.limiter.samples == 0
+        finally:
+            http.shutdown()
+
     def test_telemetry_surface_exempt_from_admission(self):
         ctrl = _fixed_controller(1.0)
         registry = MetricRegistry()

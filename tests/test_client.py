@@ -110,6 +110,54 @@ class TestEngineClient:
             es.close()
 
 
+class TestEngineClientWireHeaders:
+    """Regression for the ``wire-header`` lint findings: the serving
+    side read ``X-PIO-Tenant`` (fair-share admission) and
+    ``X-PIO-Affinity`` (router sticky routing) but the SDK never set
+    either — the reads could only ever see the defaults."""
+
+    @pytest.fixture()
+    def capture_server(self):
+        from predictionio_tpu.serving.http import (
+            HTTPServer,
+            Response,
+            Router,
+        )
+
+        seen = []
+
+        def handler(request):
+            # request.headers is an email.Message: reads are
+            # case-insensitive, exactly how the real consumers
+            # (serving/http.py, the router) read these headers
+            seen.append({
+                "tenant": request.headers.get("X-PIO-Tenant"),
+                "affinity": request.headers.get("X-PIO-Affinity"),
+            })
+            return Response(200, {"ok": True})
+
+        router = Router()
+        router.route("POST", "/queries.json", handler)
+        router.route("POST", "/batch/queries.json", handler)
+        http = HTTPServer(router, host="127.0.0.1", port=0)
+        http.start()
+        yield f"http://127.0.0.1:{http.port}", seen
+        http.shutdown()
+
+    def test_tenant_and_affinity_headers_sent(self, capture_server):
+        base, seen = capture_server
+        client = EngineClient(base, tenant="acme")
+        client.send_query({"x": 1}, affinity="user-7")
+        assert seen[-1] == {"tenant": "acme", "affinity": "user-7"}
+        client.send_batch_queries([{"x": 1}])
+        assert seen[-1] == {"tenant": "acme", "affinity": None}
+
+    def test_unlabeled_client_sends_neither(self, capture_server):
+        base, seen = capture_server
+        EngineClient(base).send_query({"x": 1})
+        assert seen[-1] == {"tenant": None, "affinity": None}
+
+
 class TestUrlEncoding:
     def test_special_characters_roundtrip(self, event_server):
         c = EventClient("sdkkey", event_server)

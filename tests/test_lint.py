@@ -27,6 +27,22 @@ from predictionio_tpu.analysis.source import SourceModule
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def lint_surface() -> list[str]:
+    """The CI-linted paths (mirrors scripts/check.sh and the CLI
+    default): the package, the scripts, and the ``tests/*_child.py``
+    helper processes — they run as real separate processes in the
+    smokes, so they participate in the wire contract."""
+    import glob
+
+    return [
+        os.path.join(REPO_ROOT, "predictionio_tpu"),
+        os.path.join(REPO_ROOT, "scripts"),
+        *sorted(
+            glob.glob(os.path.join(REPO_ROOT, "tests", "*_child.py"))
+        ),
+    ]
+
+
 def lint_source(src: str, path: str = "mod.py", extra: dict | None = None):
     """Findings for one (or more) in-memory fixture modules."""
     sources = {path: src, **(extra or {})}
@@ -1823,10 +1839,7 @@ class TestRepoIsClean:
         path = os.path.join(REPO_ROOT, "scripts", "lint_baseline.txt")
         entries = load_baseline(path)  # must parse
         result = run_lint(
-            [
-                os.path.join(REPO_ROOT, "predictionio_tpu"),
-                os.path.join(REPO_ROOT, "scripts"),
-            ],
+            lint_surface(),
             root=REPO_ROOT,
             baseline_path=path,
         )
@@ -1838,10 +1851,7 @@ class TestRepoIsClean:
 
     def test_tree_has_no_new_findings(self):
         result = run_lint(
-            [
-                os.path.join(REPO_ROOT, "predictionio_tpu"),
-                os.path.join(REPO_ROOT, "scripts"),
-            ],
+            lint_surface(),
             root=REPO_ROOT,
             baseline_path=os.path.join(
                 REPO_ROOT, "scripts", "lint_baseline.txt"
@@ -1851,6 +1861,9 @@ class TestRepoIsClean:
         assert result.new == [], "\n".join(
             f.render() for f in result.new
         )
+        # the two new checker families report their own timings
+        assert "wire_contract" in result.timings_ms
+        assert "lifecycle" in result.timings_ms
 
     def test_shipped_baseline_is_empty(self):
         """The contract since PR 7: every violation is fixed or
@@ -1880,12 +1893,7 @@ class TestRepoIsClean:
             r"[\w\-*,\s]+?\s+--\s+\S"
         )
         offenders = []
-        files = iter_python_files(
-            [
-                os.path.join(REPO_ROOT, "predictionio_tpu"),
-                os.path.join(REPO_ROOT, "scripts"),
-            ]
-        )
+        files = iter_python_files(lint_surface())
         for path in files:
             with open(path, encoding="utf-8") as f:
                 text = f.read()
@@ -2935,3 +2943,1020 @@ class TestThreadOwnershipMap:
         self._assert_guarded(
             model, "EngineServer", "_batchers", "EngineServer._lock"
         )
+
+
+# -- wire-contract rules (wire.py + checkers/wire_contract.py) -------------
+
+
+class TestWireContractHeaders:
+    def test_consumed_but_never_produced(self):
+        findings = lint_source(
+            """
+            def handler(request):
+                return request.headers.get("X-PIO-Widget")
+            """
+        )
+        hits = [f for f in findings if f.rule == "wire-header"]
+        assert len(hits) == 1
+        assert "ever sets it" in hits[0].message
+
+    def test_produced_but_never_consumed(self):
+        findings = lint_source(
+            """
+            def send(req):
+                req.add_header("X-PIO-Widget", "1")
+            """
+        )
+        hits = [f for f in findings if f.rule == "wire-header"]
+        assert len(hits) == 1
+        assert "ever reads it" in hits[0].message
+
+    def test_paired_through_module_constants(self):
+        """Producer and consumer resolve through constants — including
+        a cross-module `other.WIDGET_HEADER` attribute reference."""
+        findings = lint_source(
+            """
+            WIDGET_HEADER = "X-PIO-Widget"
+
+            def send(req):
+                req.add_header(WIDGET_HEADER, "1")
+            """,
+            path="producer.py",
+            extra={
+                "consumer.py": """
+                    from producer import WIDGET_HEADER
+                    import producer
+
+                    def read(request):
+                        return request.headers.get(
+                            producer.WIDGET_HEADER
+                        )
+                """,
+            },
+        )
+        assert "wire-header" not in rules_of(findings)
+
+    def test_subscript_store_and_headers_kwarg_produce(self):
+        findings = lint_source(
+            """
+            def send(headers, other):
+                headers["X-PIO-Alpha"] = "1"
+                other.request(url="x", extra_headers={"X-PIO-Beta": "2"})
+
+            def read(request):
+                a = request.headers.get("X-PIO-Alpha")
+                b = request.headers["X-PIO-Beta"]
+                return a, b
+            """
+        )
+        assert "wire-header" not in rules_of(findings)
+
+    def test_near_miss_spelling_flagged_at_minority_site(self):
+        findings = lint_source(
+            """
+            def send_a(req):
+                req.add_header("X-PIO-Widget", "1")
+
+            def send_b(req):
+                req.add_header("X-PIO-Widget", "1")
+
+            def read(request):
+                return request.headers.get("X-Pio-Widget")
+            """
+        )
+        hits = [f for f in findings if f.rule == "wire-header"]
+        assert len(hits) == 1
+        assert "near-miss" in hits[0].message
+        assert "'X-Pio-Widget'" in hits[0].message
+        assert hits[0].context == "read"
+
+    def test_near_miss_tie_prefers_alphabetically_first(self):
+        """1-vs-1 tie: the alphabetically first spelling wins —
+        uppercase sorts before lowercase, so the canonical X-PIO-*
+        casing is kept and the deviating site is the one flagged."""
+        findings = lint_source(
+            """
+            def send(req):
+                req.add_header("X-PIO-Widget", "1")
+
+            def read(request):
+                return request.headers.get("X-PIO-widget")
+            """
+        )
+        hits = [f for f in findings if f.rule == "wire-header"]
+        assert len(hits) == 1
+        assert hits[0].context == "read"
+        assert "'X-PIO-widget'" in hits[0].message
+        assert "'X-PIO-Widget'" in hits[0].message
+
+    def test_underscore_variant_is_a_near_miss(self):
+        findings = lint_source(
+            """
+            def send_a(req):
+                req.add_header("X-PIO-Widget", "1")
+
+            def send_b(req):
+                req.add_header("X-PIO-Widget", "1")
+
+            def read(request):
+                return request.headers.get("X_PIO_Widget")
+            """
+        )
+        hits = [f for f in findings if f.rule == "wire-header"]
+        assert len(hits) == 1
+        assert "near-miss" in hits[0].message
+
+    def test_request_id_and_parent_span_exempt_from_pairing(self):
+        """The optional trace headers may legitimately be read-only
+        (a server that only ever echoes) or write-only in a fixture."""
+        findings = lint_source(
+            """
+            def read(request):
+                return request.headers.get("X-Request-ID")
+
+            def send(req):
+                req.add_header("X-Parent-Span", "abc")
+            """
+        )
+        assert "wire-header" not in rules_of(findings)
+
+    def test_standard_headers_out_of_scope(self):
+        findings = lint_source(
+            """
+            def send(req):
+                req.add_header("Content-Type", "application/json")
+
+            def read(request):
+                return request.headers.get("Accept")
+            """
+        )
+        assert "wire-header" not in rules_of(findings)
+
+    def test_dynamic_key_never_guessed(self):
+        findings = lint_source(
+            """
+            def send(req, name):
+                req.add_header(name, "1")
+            """
+        )
+        assert "wire-header" not in rules_of(findings)
+
+
+class TestWireContractRoutes:
+    def test_request_path_matching_registered_route_is_clean(self):
+        findings = lint_source(
+            """
+            def handler(request):
+                return None
+
+            def serve(router):
+                router.route("GET", "/things/<id>.json", handler)
+
+            def fetch(base):
+                return base + "/things/abc.json"
+            """
+        )
+        assert "wire-route" not in rules_of(findings)
+
+    def test_unmatched_request_path_flagged(self):
+        findings = lint_source(
+            """
+            def handler(request):
+                return None
+
+            def serve(router):
+                router.route("GET", "/things.json", handler)
+
+            def fetch(base):
+                return base + "/nothing.json"
+            """
+        )
+        hits = [f for f in findings if f.rule == "wire-route"]
+        assert len(hits) == 1
+        assert "'/nothing.json'" in hits[0].message
+
+    def test_fstring_dynamic_segment_matches_capture(self):
+        findings = lint_source(
+            """
+            def handler(request):
+                return None
+
+            def serve(router):
+                router.route("GET", "/things/<id>.json", handler)
+
+            def fetch(base, tid):
+                return f"{base}/things/{tid}.json?x=1"
+            """
+        )
+        assert "wire-route" not in rules_of(findings)
+
+    def test_direct_path_comparison_registers_the_route(self):
+        """`if path == "/healthz"` — handled ahead of routing (the
+        drain-exempt telemetry surface) still counts as served."""
+        findings = lint_source(
+            """
+            def dispatch(path):
+                if path == "/healthz":
+                    return "ok"
+                return None
+
+            def probe(base):
+                return base + "/healthz"
+            """
+        )
+        assert "wire-route" not in rules_of(findings)
+
+    def test_filesystem_paths_not_mistaken_for_requests(self):
+        """"/"-strings outside URL-ish contexts are not request
+        paths."""
+        findings = lint_source(
+            """
+            def load():
+                with open("/etc/widget.json") as f:
+                    return f.read()
+            """
+        )
+        assert "wire-route" not in rules_of(findings)
+
+
+class TestWireContractMetrics:
+    def test_scraped_but_never_registered(self):
+        findings = lint_source(
+            """
+            def read(data):
+                return data.get("pio_gone_total")
+            """
+        )
+        hits = [f for f in findings if f.rule == "wire-metric"]
+        assert len(hits) == 1
+        assert "'pio_gone_total'" in hits[0].message
+
+    def test_registered_and_scraped_cross_module_is_clean(self):
+        findings = lint_source(
+            """
+            def setup(registry):
+                registry.counter("pio_widgets_total", "widgets")
+            """,
+            path="server.py",
+            extra={
+                "scraper.py": """
+                    def read(data):
+                        return data.get("pio_widgets_total")
+                """,
+            },
+        )
+        assert "wire-metric" not in rules_of(findings)
+
+    def test_histogram_exposition_suffix_resolves(self):
+        findings = lint_source(
+            """
+            def setup(registry):
+                registry.histogram("pio_lat_seconds", "latency")
+
+            def scrape(metric_value, base):
+                return metric_value(base, "pio_lat_seconds_bucket")
+            """
+        )
+        assert "wire-metric" not in rules_of(findings)
+
+    def test_parameter_default_counts_as_registration(self):
+        """The StepTimer.publish pattern: the name arrives as a
+        parameter default and the body registers through the param."""
+        findings = lint_source(
+            """
+            def publish(registry, name="pio_step_seconds"):
+                registry.histogram(name, "per-step")
+            """,
+            extra={
+                "scraper.py": """
+                    def read(data):
+                        return data.get("pio_step_seconds")
+                """,
+            },
+        )
+        assert "wire-metric" not in rules_of(findings)
+
+    def test_factory_call_receiver_registers(self):
+        findings = lint_source(
+            """
+            def count(get_registry):
+                get_registry().counter("pio_hits_total", "hits").inc()
+
+            def scrape(sample):
+                return sample("pio_hits_total")
+            """
+        )
+        assert "wire-metric" not in rules_of(findings)
+
+
+class TestWireContractEnv:
+    def _run(self, tmp_path, src, rel="m.py", docs=""):
+        import textwrap
+
+        (tmp_path / "docs").mkdir(exist_ok=True)
+        (tmp_path / "docs" / "env.md").write_text(docs)
+        mod = SourceModule(
+            str(tmp_path / rel), rel, textwrap.dedent(src)
+        )
+        return analyze_modules([mod])
+
+    def test_undocumented_env_read_flagged(self, tmp_path):
+        findings = self._run(
+            tmp_path,
+            """
+            import os
+            knob = os.environ.get("PIO_SECRET_KNOB")
+            """,
+            docs="| `PIO_OTHER` | documented |\n",
+        )
+        hits = [f for f in findings if f.rule == "wire-env"]
+        assert len(hits) == 1
+        assert "'PIO_SECRET_KNOB'" in hits[0].message
+
+    def test_documented_env_read_is_clean(self, tmp_path):
+        findings = self._run(
+            tmp_path,
+            """
+            import os
+            knob = os.environ.get("PIO_SECRET_KNOB")
+            """,
+            docs="| `PIO_SECRET_KNOB` | the knob |\n",
+        )
+        assert "wire-env" not in rules_of(findings)
+
+    def test_helper_readers_and_membership_detected(self, tmp_path):
+        findings = self._run(
+            tmp_path,
+            """
+            import os
+
+            def _env_float(name, default):
+                return float(os.environ.get(name, default))
+
+            a = _env_float("PIO_KNOB_A", 1.0)
+            b = os.environ["PIO_KNOB_B"]
+            c = "PIO_KNOB_C" in os.environ
+            """,
+        )
+        names = {
+            f.message.split("'")[1]
+            for f in findings
+            if f.rule == "wire-env"
+        }
+        assert names == {"PIO_KNOB_A", "PIO_KNOB_B", "PIO_KNOB_C"}
+
+    def test_documented_prefix_family_covers_members(self, tmp_path):
+        findings = self._run(
+            tmp_path,
+            """
+            import os
+            x = os.environ.get("PIO_STORAGE_SOURCES_PGSQL_TYPE")
+            """,
+            docs="sources configured via `PIO_STORAGE_SOURCES_...`\n",
+        )
+        assert "wire-env" not in rules_of(findings)
+
+    def test_modules_under_tests_exempt(self, tmp_path):
+        (tmp_path / "tests").mkdir()
+        findings = self._run(
+            tmp_path,
+            """
+            import os
+            n = os.environ.get("PIO_TEST_NPROCS")
+            """,
+            rel="tests/helper_child.py",
+        )
+        assert "wire-env" not in rules_of(findings)
+
+
+class TestWireContractTable:
+    """The docs/scale_out.md "Wire contract" table, asserted row by
+    row against the checker's own registry (like the thread-ownership
+    map): the docs and the analyzer read the same facts, so the table
+    cannot drift from the code."""
+
+    def _registry(self):
+        from predictionio_tpu.analysis import wire
+        from predictionio_tpu.analysis.source import (
+            iter_python_files,
+            load_modules,
+        )
+
+        files = iter_python_files(lint_surface())
+        modules, errors = load_modules(files, REPO_ROOT)
+        assert errors == []
+        return wire.build_registry(modules)
+
+    def _docs_rows(self):
+        import re
+
+        path = os.path.join(REPO_ROOT, "docs", "scale_out.md")
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        section = text.split("## Wire contract", 1)[1]
+        section = section.split("\n## ", 1)[0]
+        rows = {}
+        for line in section.splitlines():
+            m = re.match(r"\|\s*`(X-[^`]+)`\s*\|", line)
+            if not m:
+                continue
+            cells = [c.strip() for c in line.strip("|").split("|")]
+            rows[m.group(1)] = (
+                set(re.findall(r"`([^`]+)`", cells[1])),
+                set(re.findall(r"`([^`]+)`", cells[2])),
+            )
+        return rows
+
+    def test_every_registry_header_has_a_row_and_matches(self):
+        from predictionio_tpu.analysis import wire
+
+        reg = self._registry()
+        rows = self._docs_rows()
+        canon_rows = {
+            wire.canonical_header(name): (name, row)
+            for name, (set_by, read_by) in rows.items()
+            for row in [(set_by, read_by)]
+        }
+        registry_headers = reg.header_canonical()
+        assert set(canon_rows) == set(registry_headers), (
+            "docs table and checker registry disagree on the header "
+            f"set: docs={sorted(canon_rows)} "
+            f"registry={sorted(registry_headers)}"
+        )
+        for canon, sides in registry_headers.items():
+            _name, (doc_set_by, doc_read_by) = canon_rows[canon]
+            produced = {
+                os.path.basename(s.path) for s in sides["produced"]
+            }
+            consumed = {
+                os.path.basename(s.path) for s in sides["consumed"]
+            }
+            assert doc_set_by == produced, (
+                f"{canon}: docs say set by {sorted(doc_set_by)}, "
+                f"checker sees {sorted(produced)}"
+            )
+            assert doc_read_by == consumed, (
+                f"{canon}: docs say read by {sorted(doc_read_by)}, "
+                f"checker sees {sorted(consumed)}"
+            )
+
+    def test_contract_headers_all_paired(self):
+        """Every non-optional header in the REAL tree has producers
+        AND consumers — the checker's zero-findings state, asserted
+        directly on the registry."""
+        from predictionio_tpu.analysis import wire
+
+        reg = self._registry()
+        for canon, sides in reg.header_canonical().items():
+            if canon in wire.OPTIONAL_HEADERS:
+                continue
+            assert sides["produced"], f"{canon}: no producer"
+            assert sides["consumed"], f"{canon}: no consumer"
+
+
+# -- resource-lifecycle rules (checkers/lifecycle.py) ----------------------
+
+
+class TestAcquireRelease:
+    def test_try_acquire_without_any_release(self):
+        findings = lint_source(
+            """
+            class S:
+                def handle(self):
+                    self.adm.try_acquire("c")
+                    return self.work()
+            """
+        )
+        hits = [f for f in findings if f.rule == "acquire-release"]
+        assert len(hits) == 1
+        assert "never paired" in hits[0].message
+
+    def test_release_on_fall_through_only(self):
+        findings = lint_source(
+            """
+            class S:
+                def handle(self):
+                    self.adm.try_acquire("c")
+                    out = self.work()
+                    self.adm.release(0.0)
+                    return out
+            """
+        )
+        hits = [f for f in findings if f.rule == "acquire-release"]
+        assert len(hits) == 1
+        assert "finally" in hits[0].message
+
+    def test_release_in_finally_is_clean(self):
+        findings = lint_source(
+            """
+            class S:
+                def handle(self):
+                    self.adm.try_acquire("c")
+                    try:
+                        return self.work()
+                    finally:
+                        self.adm.release(0.0)
+            """
+        )
+        assert "acquire-release" not in rules_of(findings)
+
+    def test_release_via_callee_from_finally_is_clean(self):
+        findings = lint_source(
+            """
+            class S:
+                def handle(self):
+                    self.adm.try_acquire("c")
+                    try:
+                        return self.work()
+                    finally:
+                        self._done()
+
+                def _done(self):
+                    self.adm.release(0.0)
+            """
+        )
+        assert "acquire-release" not in rules_of(findings)
+
+    def test_release_in_nested_callback_is_a_handoff(self):
+        findings = lint_source(
+            """
+            class S:
+                def handle(self, fut):
+                    self.adm.try_acquire("c")
+
+                    def done(f):
+                        self.adm.release(0.0)
+
+                    fut.add_done_callback(done)
+            """
+        )
+        assert "acquire-release" not in rules_of(findings)
+
+    def test_acquire_wrapper_is_exempt(self):
+        findings = lint_source(
+            """
+            class S:
+                def try_acquire(self, cls):
+                    return self.inner.try_acquire(cls)
+            """
+        )
+        assert "acquire-release" not in rules_of(findings)
+
+    def test_begin_end_pair_needs_finally(self):
+        findings = lint_source(
+            """
+            class R:
+                def forward(self):
+                    self.rep.begin()
+                    out = self.send()
+                    self.rep.end()
+                    return out
+            """
+        )
+        hits = [f for f in findings if f.rule == "acquire-release"]
+        assert len(hits) == 1
+        assert ".end()" in hits[0].message
+
+    def test_begin_end_in_finally_is_clean(self):
+        findings = lint_source(
+            """
+            class R:
+                def forward(self):
+                    self.rep.begin()
+                    try:
+                        return self.send()
+                    finally:
+                        self.rep.end()
+            """
+        )
+        assert "acquire-release" not in rules_of(findings)
+
+    def test_lone_begin_is_a_cross_thread_handoff(self):
+        """Only one half present: the pipeline-semaphore shape
+        (collector acquires, completer releases) — not this rule's
+        business."""
+        findings = lint_source(
+            """
+            class R:
+                def collect(self):
+                    self.rep.begin()
+
+                def complete(self):
+                    self.rep.end()
+            """
+        )
+        assert "acquire-release" not in rules_of(findings)
+
+    def test_inflight_counter_needs_finally_decrement(self):
+        findings = lint_source(
+            """
+            class S:
+                def track(self):
+                    self._inflight += 1
+                    out = self.work()
+                    self._inflight -= 1
+                    return out
+            """
+        )
+        hits = [f for f in findings if f.rule == "acquire-release"]
+        assert len(hits) == 1
+        assert "gauge" in hits[0].message
+
+    def test_inflight_decrement_in_finally_is_clean(self):
+        findings = lint_source(
+            """
+            class S:
+                def track(self):
+                    self._inflight += 1
+                    try:
+                        return self.work()
+                    finally:
+                        self._inflight -= 1
+            """
+        )
+        assert "acquire-release" not in rules_of(findings)
+
+
+class TestResourceLeak:
+    def test_close_on_fall_through_with_calls_between(self):
+        findings = lint_source(
+            """
+            def read(path):
+                f = open(path)
+                data = f.read()
+                f.close()
+                return data
+            """
+        )
+        hits = [f for f in findings if f.rule == "resource-leak"]
+        assert len(hits) == 1
+        assert "fall-through" in hits[0].message
+
+    def test_with_statement_is_clean(self):
+        findings = lint_source(
+            """
+            def read(path):
+                with open(path) as f:
+                    return f.read()
+            """
+        )
+        assert "resource-leak" not in rules_of(findings)
+
+    def test_close_in_finally_is_clean(self):
+        findings = lint_source(
+            """
+            def read(path):
+                f = open(path)
+                try:
+                    return f.read()
+                finally:
+                    f.close()
+            """
+        )
+        assert "resource-leak" not in rules_of(findings)
+
+    def test_never_closed_never_escaping(self):
+        findings = lint_source(
+            """
+            import tempfile
+
+            def work():
+                td = tempfile.TemporaryDirectory()
+                return td.name
+            """
+        )
+        hits = [f for f in findings if f.rule == "resource-leak"]
+        assert len(hits) == 1
+        assert "never escapes" in hits[0].message
+
+    def test_returned_resource_escapes(self):
+        findings = lint_source(
+            """
+            def make(path):
+                return open(path)
+
+            def make_named(path):
+                f = open(path)
+                return f
+            """
+        )
+        assert "resource-leak" not in rules_of(findings)
+
+    def test_ownership_transfer_to_container_or_call(self):
+        findings = lint_source(
+            """
+            import subprocess
+
+            def spawn(cmd, procs, supervise):
+                a = subprocess.Popen(cmd)
+                procs.append(a)
+                b = subprocess.Popen(cmd)
+                supervise(b)
+                c = subprocess.Popen(cmd)
+                procs[0] = c
+            """
+        )
+        assert "resource-leak" not in rules_of(findings)
+
+    def test_discarded_creator_flagged(self):
+        findings = lint_source(
+            """
+            import subprocess
+
+            def fire(cmd):
+                subprocess.Popen(cmd)
+            """
+        )
+        hits = [f for f in findings if f.rule == "resource-leak"]
+        assert len(hits) == 1
+        assert "discarded" in hits[0].message
+
+    def test_self_attr_without_cleanup_method(self):
+        findings = lint_source(
+            """
+            class S:
+                def start(self, path):
+                    self._f = open(path)
+            """
+        )
+        hits = [f for f in findings if f.rule == "resource-leak"]
+        assert len(hits) == 1
+        assert "self._f" in hits[0].message
+
+    def test_self_attr_with_cleanup_method_is_clean(self):
+        findings = lint_source(
+            """
+            class S:
+                def start(self, path):
+                    self._f = open(path)
+
+                def close(self):
+                    self._f.close()
+            """
+        )
+        assert "resource-leak" not in rules_of(findings)
+
+    def test_closure_capture_is_an_escape(self):
+        findings = lint_source(
+            """
+            import subprocess
+
+            def spawn(cmd, register):
+                proc = subprocess.Popen(cmd)
+
+                def reap():
+                    proc.wait()
+
+                register(reap)
+            """
+        )
+        assert "resource-leak" not in rules_of(findings)
+
+
+# -- --changed merge-base scoping ------------------------------------------
+
+
+class TestChangedMergeBase:
+    def _git(self, cwd, *args):
+        import subprocess
+
+        out = subprocess.run(
+            ["git", *args], cwd=cwd, capture_output=True, text=True,
+        )
+        assert out.returncode == 0, (args, out.stderr)
+        return out
+
+    def test_feature_branch_scopes_to_branch_point(
+        self, tmp_path, capsys, monkeypatch
+    ):
+        """`--changed main` on a feature branch diffs against
+        merge-base(main, HEAD): files main changed since the branch
+        point must NOT enter the scope."""
+        import json as _json
+        import shutil
+
+        from predictionio_tpu.cli.main import main
+
+        if shutil.which("git") is None:
+            pytest.skip("git not available")
+        self._git(tmp_path, "init", "-q")
+        self._git(tmp_path, "config", "user.email", "t@example.com")
+        self._git(tmp_path, "config", "user.name", "t")
+        (tmp_path / "base.py").write_text("x = 1\n")
+        self._git(tmp_path, "add", "base.py")
+        self._git(tmp_path, "commit", "-q", "-m", "seed")
+        trunk = self._git(
+            tmp_path, "rev-parse", "--abbrev-ref", "HEAD"
+        ).stdout.strip()
+        # feature branch: adds one file with a finding
+        self._git(tmp_path, "checkout", "-q", "-b", "feat")
+        (tmp_path / "feat.py").write_text(
+            "import time\ndeadline = time.time() + 5\n"
+        )
+        self._git(tmp_path, "add", "feat.py")
+        self._git(tmp_path, "commit", "-q", "-m", "feature")
+        # trunk moves ahead, touching base.py
+        self._git(tmp_path, "checkout", "-q", trunk)
+        (tmp_path / "base.py").write_text("x = 2\n")
+        self._git(tmp_path, "commit", "-q", "-am", "trunk moves")
+        self._git(tmp_path, "checkout", "-q", "feat")
+        monkeypatch.chdir(tmp_path)
+        rc = main(
+            ["lint", ".", "--no-baseline", "--changed", trunk, "--json"]
+        )
+        payload = _json.loads(capsys.readouterr().out)
+        assert rc == 1
+        # base.py differs from trunk's tip but NOT from the branch
+        # point — it must stay out of scope
+        assert payload["scopedTo"] == ["feat.py"]
+        assert {f["path"] for f in payload["new"]} == {"feat.py"}
+
+
+# -- cache salt: python minor + PIO_LINT_* env -----------------------------
+
+
+class TestCacheSalt:
+    def test_salt_changes_with_lint_env(self, monkeypatch):
+        from predictionio_tpu.analysis import cache as cache_mod
+
+        monkeypatch.delenv("PIO_LINT_FUTURE_KNOB", raising=False)
+        base = cache_mod.analyzer_salt()
+        monkeypatch.setenv("PIO_LINT_FUTURE_KNOB", "on")
+        salted = cache_mod.analyzer_salt()
+        assert salted != base
+        monkeypatch.setenv("PIO_LINT_FUTURE_KNOB", "off")
+        assert cache_mod.analyzer_salt() not in (base, salted)
+        monkeypatch.delenv("PIO_LINT_FUTURE_KNOB")
+        assert cache_mod.analyzer_salt() == base
+
+    def test_non_lint_env_does_not_touch_the_salt(self, monkeypatch):
+        from predictionio_tpu.analysis import cache as cache_mod
+
+        base = cache_mod.analyzer_salt()
+        monkeypatch.setenv("PIO_ADMISSION", "0")
+        assert cache_mod.analyzer_salt() == base
+
+    def test_salt_includes_python_minor(self, monkeypatch):
+        """A cached finding set produced under 3.11 must not replay
+        under 3.12, where the AST differs (try/except*)."""
+        import sys as _sys
+
+        from predictionio_tpu.analysis import cache as cache_mod
+
+        monkeypatch.setattr(cache_mod, "_salt_memo", {})
+        real = cache_mod.analyzer_salt()
+        monkeypatch.setattr(cache_mod, "_salt_memo", {})
+        monkeypatch.setattr(
+            cache_mod.sys, "version_info",
+            (_sys.version_info[0], 99, 0),
+        )
+        assert cache_mod.analyzer_salt() != real
+
+
+# -- SARIF fingerprint stability across renames ----------------------------
+
+
+class TestSarifFingerprintStability:
+    def _git(self, cwd, *args):
+        import subprocess
+
+        out = subprocess.run(
+            ["git", *args], cwd=cwd, capture_output=True, text=True,
+        )
+        assert out.returncode == 0, (args, out.stderr)
+        return out
+
+    def _fingerprints(self, sarif_text):
+        import json as _json
+
+        doc = _json.loads(sarif_text)
+        results = doc["runs"][0]["results"]
+        assert len(results) == 1
+        return results[0]["partialFingerprints"]
+
+    def test_fingerprint_survives_rename_plus_edits_above(
+        self, tmp_path, monkeypatch
+    ):
+        """git mv a.py b.py + unrelated lines inserted ABOVE the
+        finding: the line number and the path both change, the
+        path-free `pioLint/contextV1` fingerprint does not — so a
+        code-scanning alert keeps its identity across the rename."""
+        import shutil
+
+        from predictionio_tpu.analysis import render_sarif
+
+        if shutil.which("git") is None:
+            pytest.skip("git not available")
+        self._git(tmp_path, "init", "-q")
+        self._git(tmp_path, "config", "user.email", "t@example.com")
+        self._git(tmp_path, "config", "user.name", "t")
+        bad = "import time\ndeadline = time.time() + 5\n"
+        (tmp_path / "a.py").write_text(bad)
+        self._git(tmp_path, "add", "a.py")
+        self._git(tmp_path, "commit", "-q", "-m", "seed")
+        monkeypatch.chdir(tmp_path)
+
+        before = run_lint([str(tmp_path)], root=str(tmp_path))
+        assert [f.rule for f in before.new] == ["wall-clock"]
+        fp_before = self._fingerprints(render_sarif(before, "0"))
+
+        # rename + unrelated edits above the site
+        self._git(tmp_path, "mv", "a.py", "b.py")
+        (tmp_path / "b.py").write_text(
+            "# comment\n# another\n\n" + bad
+        )
+        after = run_lint(
+            [str(tmp_path)], root=str(tmp_path), changed_ref="HEAD"
+        )
+        # the rename-aware --changed scope picks up the NEW path
+        assert after.scoped_to == ["b.py"]
+        assert [f.rule for f in after.new] == ["wall-clock"]
+        assert after.new[0].path == "b.py"
+        assert after.new[0].line == before.new[0].line + 3
+        fp_after = self._fingerprints(render_sarif(after, "0"))
+
+        assert (
+            fp_after["pioLint/contextV1"]
+            == fp_before["pioLint/contextV1"]
+        )
+        # the path-scoped key changes exactly in its path component
+        assert fp_before["pioLint/v1"] == fp_before[
+            "pioLint/contextV1"
+        ].replace("wall-clock|", "wall-clock|a.py|", 1)
+        assert fp_after["pioLint/v1"] == fp_after[
+            "pioLint/contextV1"
+        ].replace("wall-clock|", "wall-clock|b.py|", 1)
+
+
+# -- explicit-path runs: analyze the project, report the slice -------------
+
+
+class TestExplicitPathScope:
+    """`pio-tpu lint <subpath>` inside the project: cross-file rules
+    (wire-contract pairing, metric registries) need both sides of
+    every wire, so the CLI widens ANALYSIS to the default surface and
+    scopes REPORTING to the named paths — the --changed split."""
+
+    def test_single_file_run_has_no_bogus_wire_findings(
+        self, capsys, monkeypatch
+    ):
+        import json as _json
+
+        from predictionio_tpu.cli.main import main
+
+        monkeypatch.chdir(REPO_ROOT)
+        rc = main(
+            ["lint", "predictionio_tpu/client.py", "--no-baseline",
+             "--no-cache", "--json"]
+        )
+        payload = _json.loads(capsys.readouterr().out)
+        # client.py consumes routes/headers the serving side provides:
+        # without the widened analysis surface this reported bogus
+        # wire-route/wire-header findings and exited 1
+        assert rc == 0, payload["new"]
+        assert payload["new"] == []
+        assert payload["scopedTo"] == [
+            "predictionio_tpu/client.py"
+        ]
+
+    def test_outside_a_project_explicit_paths_unchanged(
+        self, tmp_path, capsys, monkeypatch
+    ):
+        """No default surface on the cwd: explicit paths behave
+        exactly as before (no scoping, no widening)."""
+        import json as _json
+
+        from predictionio_tpu.cli.main import main
+
+        (tmp_path / "bad.py").write_text(
+            "import time\ndeadline = time.time() + 5\n"
+        )
+        monkeypatch.chdir(tmp_path)
+        rc = main(["lint", ".", "--no-baseline", "--json"])
+        payload = _json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert "scopedTo" not in payload
+        assert {f["path"] for f in payload["new"]} == {"bad.py"}
+
+
+class TestSarifContextFingerprintCollision:
+    def test_copy_paste_twins_omit_the_path_free_key(self, tmp_path):
+        """Two files with the identical flagged line share the
+        (rule, context, source) triple: emitting the path-free key
+        for both would conflate two distinct code-scanning alerts —
+        fixing one file would silently close the other's. Both keep
+        the path-scoped pioLint/v1 key."""
+        import json as _json
+
+        from predictionio_tpu.analysis import render_sarif
+
+        bad = "import time\ndeadline = time.time() + 5\n"
+        (tmp_path / "a.py").write_text(bad)
+        (tmp_path / "b.py").write_text(bad)
+        result = run_lint([str(tmp_path)], root=str(tmp_path))
+        assert len(result.new) == 2
+        doc = _json.loads(render_sarif(result, "0"))
+        for res in doc["runs"][0]["results"]:
+            fps = res["partialFingerprints"]
+            assert "pioLint/v1" in fps
+            assert "pioLint/contextV1" not in fps

@@ -799,6 +799,51 @@ class TestSaturationBackpressure:
         assert status == 200
         assert seen == ["sheddable"]
 
+    def test_tenant_forwarded_to_replica(self, pair):
+        """Regression for the ``wire-header`` lint finding: the
+        replica's per-tenant fair share read ``X-PIO-Tenant`` but no
+        hop ever set it — routed traffic was all anonymous, so one
+        tenant could starve the rest THROUGH the router. The router
+        now forwards the tenant, resolved like the admission gate
+        resolves it: accessKey query param first, then the header."""
+        from predictionio_tpu.serving import admission
+
+        router, http, a, b = pair
+        seen = []
+        orig_a, orig_b = a._queries, b._queries
+
+        def spy(rep_orig):
+            def _h(request):
+                seen.append(
+                    request.headers.get(admission.TENANT_HEADER)
+                )
+                return rep_orig(request)
+            return _h
+
+        a._queries = spy(orig_a)
+        b._queries = spy(orig_b)
+        for rep in (a, b):
+            rep.http.router._routes = []
+            rep.http.router.route("POST", "/queries.json", rep._queries)
+            rep.http.router.route("GET", "/metrics.json", rep._metrics)
+        base = f"http://127.0.0.1:{http.port}"
+        status, _, _ = post(
+            base, "/queries.json", {"x": 1},
+            headers={admission.TENANT_HEADER: "acme"},
+        )
+        assert status == 200
+        status, _, _ = post(
+            base, "/queries.json?accessKey=k-42", {"x": 2}
+        )
+        assert status == 200
+        # an accessKey outranks the header, mirroring the gate
+        status, _, _ = post(
+            base, "/queries.json?accessKey=k-42", {"x": 3},
+            headers={admission.TENANT_HEADER: "acme"},
+        )
+        assert status == 200
+        assert seen == ["acme", "k-42", "k-42"]
+
     def test_empty_pool_hint_is_computed_not_hardcoded(self):
         router = make_router()  # no replicas at all
         http = router.serve(host="127.0.0.1", port=0)
