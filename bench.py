@@ -112,6 +112,15 @@ _ALIVE_MARKERS = (
 )
 
 
+def _per_chip_hour(epoch_seconds: float, n_devices) -> float | None:
+    """Fused ALS epochs one chip-hour buys: 3600 / (epoch_s × chips).
+    The $/throughput figure every scale-out decision should cite —
+    speedup that costs proportionally more chips leaves it flat."""
+    if not epoch_seconds or not n_devices:
+        return None
+    return round(3600.0 / (epoch_seconds * int(n_devices)), 2)
+
+
 def _scale() -> str:
     if "--large" in sys.argv:
         return "ml20m"
@@ -157,6 +166,44 @@ def serving_bench_summary() -> dict | None:
                 "critical_p99_ms", "sheddable_shed_ratio",
             )
         }
+    return summary
+
+
+def multichip_summary() -> dict | None:
+    """The latest recorded multichip scaling run
+    (scripts/multichip_bench.py appends every sweep — strong/weak
+    curves, sharded-serving latency, factor bytes-per-device, the
+    sharded-vs-replicated equality check — to MULTICHIP.json).
+    Attached to the per-round record so scale-out decisions cite the
+    measured curves, not the dryrun's mere existence."""
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "MULTICHIP.json"
+    )
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        runs = doc.get("runs") or []
+        last = runs[-1]
+    except (OSError, ValueError, IndexError, AttributeError):
+        return None
+    extra = last.get("extra") or {}
+    summary = {
+        "recordedAtUtc": last.get("recordedAtUtc"),
+        "strong_speedup": extra.get("strong_speedup"),
+        "strong_efficiency": extra.get("strong_efficiency"),
+        "weak_efficiency": extra.get("weak_efficiency"),
+        "equality_ok": (extra.get("equality") or {}).get("ok"),
+        "runs_recorded": len(runs),
+    }
+    devices = extra.get("devices") or []
+    if devices:
+        top = devices[-1]
+        summary["max_devices"] = top.get("n_devices")
+        serving = top.get("serving") or {}
+        summary["serving_p99_ms"] = serving.get("p99_ms")
+        summary["factor_bytes_per_device"] = serving.get(
+            "factor_bytes_per_device"
+        )
     return summary
 
 
@@ -271,6 +318,7 @@ def run_epoch_bench(scale: str) -> dict:
         "backend": jax.default_backend(),
         "workload": f"{n_users}x{n_items}x{nnz}@r{rank}",
         "peak_hbm_gib": peak_hbm,
+        "n_devices": int(ctx.n_devices),
     }
 
 
@@ -541,8 +589,18 @@ def main() -> None:
                 # the platform initialized slower than the base window
                 # but the measurement is REAL — annotated, not degraded
                 "slow_init": bool(result.get("slow_init")),
-                # the serving trajectory rides along (ROADMAP item 5)
+                # cost-performance axis (ROADMAP item 5): fused epochs
+                # one chip-hour buys at the measured rate — scale-out
+                # decisions compare THIS across device counts, not raw
+                # epoch time (8 chips at 2x speedup is 4x the $/epoch)
+                "throughput_per_chip_hour": _per_chip_hour(
+                    secs, result.get("n_devices")
+                ),
+                "n_devices": result.get("n_devices"),
+                # the serving + multichip trajectories ride along
+                # (ROADMAP item 5)
                 "serving_bench": serving_bench_summary(),
+                "multichip": multichip_summary(),
             },
         }
         if errors:
@@ -576,7 +634,12 @@ def main() -> None:
                     "extra": {
                         "backend": "cpu",
                         "workload": cpu_result.get("workload"),
+                        "throughput_per_chip_hour": _per_chip_hour(
+                            secs, cpu_result.get("n_devices")
+                        ),
+                        "n_devices": cpu_result.get("n_devices"),
                         "serving_bench": serving_bench_summary(),
+                        "multichip": multichip_summary(),
                     },
                 }
             )

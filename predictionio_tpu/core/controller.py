@@ -195,7 +195,16 @@ class Algorithm(_Controller, Generic[PD, M, Q, P], abc.ABC):
         :meth:`batch_predict_collect`; the serving micro-batcher uses
         the pair to overlap batch N+1's enqueue with batch N's barrier
         (docs/serving.md "Pipelined dispatch"). Algorithms that don't
-        override this serve single-phase through ``batch_predict``."""
+        override this serve single-phase through ``batch_predict``.
+
+        Sharded-model contract: implementations must accept model
+        state whose arrays are mesh-sharded ``jax.Array``s (e.g. ALS
+        factor matrices split over the ``model`` axis,
+        docs/parallelism.md "Sharded ALS") WITHOUT gathering them to
+        the host — dispatch the jitted program against the sharded
+        arrays and let GSPMD insert the collectives. A host gather
+        here would both serialize serving and cap the catalog at one
+        chip's HBM."""
         raise NotImplementedError(
             f"{type(self).__name__} does not implement two-phase predict"
         )
@@ -216,7 +225,15 @@ class Algorithm(_Controller, Generic[PD, M, Q, P], abc.ABC):
         workflow/CreateServer.scala:495-647; the TPU analogue is
         device-committed ``jax.Array`` factors). Called by
         ``Engine.prepare_deploy`` for every load and ``/reload``.
-        Default: identity (host-resident models)."""
+        Default: identity (host-resident models).
+
+        When ``ctx.model_parallelism > 1`` implementations should
+        commit large row-addressed state SHARDED over the model mesh
+        axis (``predictionio_tpu.parallel.partition`` has the rule
+        tables and ``stage_factor_matrix`` helper) so per-device HBM
+        divides by the axis size; already-sharded device arrays must
+        pass through untouched — that is the unbroken
+        train→serve path."""
         return model
 
     # -- persistence hooks (MANUAL mode) ---------------------------------

@@ -32,6 +32,7 @@ from predictionio_tpu.data.eventframe import Interactions
 from predictionio_tpu.data.store import EventStore
 from predictionio_tpu.ops import similarity
 from predictionio_tpu.ops.als import train_als
+from predictionio_tpu.parallel import partition
 from predictionio_tpu.parallel.mesh import ComputeContext
 from predictionio_tpu.utils.bimap import BiMap
 
@@ -95,6 +96,10 @@ class ECommModel:
     item_map: BiMap
     item_categories: dict[str, list[str]]
     popularity: np.ndarray  # [I] interaction counts (cold-user fallback)
+    #: True on phantom padding rows of a model-sharded catalog (None
+    #: when unpadded) — excluded from the device top-k. Optional so
+    #: pre-sharding pickled models load unchanged.
+    item_phantom_mask: "jax.Array | None" = None
 
 
 class ECommAlgorithm(Algorithm):
@@ -132,12 +137,23 @@ class ECommAlgorithm(Algorithm):
         )
 
     def stage_model(self, ctx, model: ECommModel) -> ECommModel:
-        """Factors live on device after deploy; popularity stays host —
-        the cold-user fallback ranks on the CPU without a device trip."""
+        """Factors commit through the sharded-catalog machinery the
+        other ALS templates use (row-sharded over a model mesh axis,
+        phantom padding rows masked — the ``Algorithm.stage_model``
+        sharded-model contract); popularity stays host — the cold-user
+        fallback ranks on the CPU without a device trip and indexes
+        only real items."""
+        user_f, _ = partition.stage_factor_matrix(
+            ctx, model.user_factors, n_real=len(model.user_map)
+        )
+        item_f, item_mask = partition.stage_factor_matrix(
+            ctx, model.item_factors, n_real=len(model.item_map)
+        )
         return dataclasses.replace(
             model,
-            user_factors=similarity.stage_factors(model.user_factors),
-            item_factors=similarity.stage_factors(model.item_factors),
+            user_factors=user_f,
+            item_factors=item_f,
+            item_phantom_mask=item_mask,
         )
 
     # -- serve-time business rules (reference ECommAlgorithm.predict) -----
@@ -182,7 +198,9 @@ class ECommAlgorithm(Algorithm):
         user = str(query.get("user", ""))
         num = int(query.get("num", 10))
         user_idx = model.user_map.get(user, -1)
-        n_items = len(model.item_factors)
+        # the REAL catalog size — a model-sharded factor matrix carries
+        # phantom padding rows, masked from the top-k below
+        n_items = len(model.item_map)
         if user_idx >= 0:
             k = min(1 << max(0, (4 * num - 1)).bit_length(), n_items)
             # fused on-device gather + score + top-k: uploads one index
@@ -191,6 +209,7 @@ class ECommAlgorithm(Algorithm):
                 np.asarray([user_idx], np.int32),
                 model.item_factors,
                 k,
+                mask=getattr(model, "item_phantom_mask", None),
             )
             scores, cand = jax.device_get((scores, cand))  # parallel fetch
             scores, cand = scores[0], cand[0]

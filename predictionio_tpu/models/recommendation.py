@@ -35,6 +35,7 @@ from predictionio_tpu.data.eventframe import Interactions
 from predictionio_tpu.data.store import EventStore
 from predictionio_tpu.ops import similarity
 from predictionio_tpu.ops.als import train_als
+from predictionio_tpu.parallel import partition
 from predictionio_tpu.parallel.mesh import ComputeContext
 from predictionio_tpu.utils.bimap import BiMap
 
@@ -160,6 +161,10 @@ class ALSParams(Params):
     checkpoint_dir: str = ""
     checkpoint_every: int = 0
     resume: bool = False
+    #: factor-matrix layout: "auto" shards over the model mesh axis
+    #: whenever the serving/training mesh has one (docs/parallelism.md
+    #: "Sharded ALS"); "replicated"/"sharded" force a mode
+    factor_sharding: str = "auto"
 
 
 @dataclasses.dataclass
@@ -170,6 +175,12 @@ class ALSRecModel:
     item_factors: np.ndarray | jax.Array
     user_map: BiMap
     item_map: BiMap
+    #: [rows(item_factors)] bool device array, True on phantom padding
+    #: rows of a model-sharded catalog (None when factors are
+    #: unpadded); serving passes it as the top-k score mask so a
+    #: padded row never surfaces as a recommendation. Optional so
+    #: pre-sharding pickled models load unchanged.
+    item_phantom_mask: "jax.Array | None" = None
 
 
 class ALSAlgorithm(Algorithm[RecTrainingData, ALSRecModel, dict, dict]):
@@ -198,6 +209,7 @@ class ALSAlgorithm(Algorithm[RecTrainingData, ALSRecModel, dict, dict]):
             checkpoint_dir=p.checkpoint_dir or None,
             checkpoint_every=p.checkpoint_every,
             resume=p.resume,
+            factor_sharding=p.factor_sharding,
         )
         return ALSRecModel(
             user_factors=factors.user_factors,
@@ -210,12 +222,31 @@ class ALSAlgorithm(Algorithm[RecTrainingData, ALSRecModel, dict, dict]):
     def stage_model(
         self, ctx: ComputeContext, model: ALSRecModel
     ) -> ALSRecModel:
-        """Commit both factor matrices to the device once at deploy; the
-        per-request upload is then just the int32 user indices."""
+        """Commit both factor matrices once at deploy; the per-request
+        upload is then just the int32 user indices.
+
+        On a mesh with a model axis the matrices are committed
+        ROW-SHARDED over it (the same partition rule that trained
+        them), so the catalog's HBM footprint divides by
+        model_parallelism — a factor table too big for one chip serves
+        from one engine instance; on a model-axis-1 mesh the same spec
+        is physically replicated. Already-sharded device arrays (the
+        ``train_als(return_layout="device")`` path) pass straight
+        through without a host gather. The phantom mask is keyed on
+        the factors actually carrying padded rows (device-layout
+        training pads on EVERY mesh, data-parallel ones included) —
+        never on the mesh shape."""
+        user_f, _ = partition.stage_factor_matrix(
+            ctx, model.user_factors, n_real=len(model.user_map)
+        )
+        item_f, item_mask = partition.stage_factor_matrix(
+            ctx, model.item_factors, n_real=len(model.item_map)
+        )
         return dataclasses.replace(
             model,
-            user_factors=similarity.stage_factors(model.user_factors),
-            item_factors=similarity.stage_factors(model.item_factors),
+            user_factors=user_f,
+            item_factors=item_f,
+            item_phantom_mask=item_mask,
         )
 
     def predict(self, model: ALSRecModel, query: dict) -> dict:
@@ -231,17 +262,20 @@ class ALSAlgorithm(Algorithm[RecTrainingData, ALSRecModel, dict, dict]):
     def batch_predict_launch(self, model: ALSRecModel, queries):
         """Host prep + device enqueue, no barrier: the returned handle
         holds un-fetched device arrays, so the serving pipeline can
-        enqueue the next batch while this one computes."""
+        enqueue the next batch while this one computes. Works unchanged
+        on model-sharded factor matrices (the jitted program runs GSPMD
+        over their mesh; nothing here gathers factors to the host) —
+        phantom padding rows are masked out of the ranking and the
+        top-k size clamps to the REAL catalog, never the padded one."""
         if not queries:
             return None
+        n_items = len(model.item_map)
         num = max(int(q.get("num", 10)) for q in queries)
-        num = min(num, len(model.item_factors))
+        num = min(num, n_items)
         # bucket the jit-static shapes (top-k size and batch rows) to
         # powers of two so arbitrary client input cannot force unbounded
         # recompiles at serving time
-        num_bucket = min(
-            1 << max(0, (num - 1)).bit_length(), len(model.item_factors)
-        )
+        num_bucket = min(1 << max(0, (num - 1)).bit_length(), n_items)
         user_idx = np.asarray(
             [model.user_map.get(q.get("user", ""), -1) for q in queries],
             np.int32,
@@ -254,7 +288,8 @@ class ALSAlgorithm(Algorithm[RecTrainingData, ALSRecModel, dict, dict]):
         # (factors are staged jax.Arrays after stage_model; the
         # evaluation path passes host arrays and pays the upload there)
         scores, items = similarity.gather_top_k_dot(
-            model.user_factors, idx, model.item_factors, num_bucket
+            model.user_factors, idx, model.item_factors, num_bucket,
+            mask=getattr(model, "item_phantom_mask", None),
         )
         return scores, items, user_idx, num
 

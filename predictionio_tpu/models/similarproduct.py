@@ -35,6 +35,7 @@ from predictionio_tpu.data.eventframe import Interactions
 from predictionio_tpu.data.store import EventStore
 from predictionio_tpu.ops import similarity
 from predictionio_tpu.ops.als import train_als
+from predictionio_tpu.parallel import partition
 from predictionio_tpu.parallel.mesh import ComputeContext
 from predictionio_tpu.utils.bimap import BiMap
 
@@ -105,6 +106,10 @@ class SimilarModel:
     item_factors: np.ndarray | jax.Array
     item_map: BiMap
     item_categories: dict[str, list[str]]
+    #: True on phantom padding rows of a model-sharded catalog (None
+    #: when unpadded) — excluded from the cosine ranking. Optional so
+    #: pre-sharding pickled models load unchanged.
+    item_phantom_mask: "jax.Array | None" = None
 
 
 class SimilarALSAlgorithm(Algorithm):
@@ -143,9 +148,19 @@ class SimilarALSAlgorithm(Algorithm):
     def stage_model(
         self, ctx: ComputeContext, model: SimilarModel
     ) -> SimilarModel:
+        """Item factors shard over the model mesh axis exactly like the
+        recommendation template's (they ARE the same ALS item factors
+        — this path shares the sharded-catalog machinery). The phantom
+        mask is keyed on the factors carrying padded rows, never on
+        the mesh shape: device-layout training pads on data-parallel
+        meshes too."""
+        item_f, item_mask = partition.stage_factor_matrix(
+            ctx, model.item_factors, n_real=len(model.item_map)
+        )
         return dataclasses.replace(
             model,
-            item_factors=similarity.stage_factors(model.item_factors),
+            item_factors=item_f,
+            item_phantom_mask=item_mask,
         )
 
     def predict(self, model: SimilarModel, query: dict) -> dict:
@@ -158,7 +173,10 @@ class SimilarALSAlgorithm(Algorithm):
         ]
         if not idx:
             return {"itemScores": []}
-        n_items = len(model.item_factors)
+        # clamp the candidate pool to the REAL catalog: a model-sharded
+        # factor matrix carries phantom padding rows, masked from the
+        # ranking below and never counted here
+        n_items = len(model.item_map)
         k = min(1 << max(0, (num + len(idx) - 1)).bit_length(), n_items)
         # pad the query-item indices to a power-of-two bucket (-1 = pad)
         # so arbitrary basket sizes cannot force unbounded recompiles;
@@ -168,7 +186,8 @@ class SimilarALSAlgorithm(Algorithm):
         idx_arr = np.full(bucket, -1, np.int32)
         idx_arr[: len(idx)] = idx
         scores, cand = similarity.gather_mean_top_k_cosine(
-            model.item_factors, idx_arr, k
+            model.item_factors, idx_arr, k,
+            mask=getattr(model, "item_phantom_mask", None),
         )
         scores, cand = jax.device_get((scores, cand))  # parallel fetch
         scores, cand = scores[0], cand[0]

@@ -42,14 +42,22 @@ import math
 import os
 import time
 from functools import partial
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
-from predictionio_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, ComputeContext
+from predictionio_tpu.parallel import partition
+from predictionio_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    ComputeContext,
+    assert_phantom_rows_zero,
+)
+from predictionio_tpu.parallel.partition import shard_map
 
 logger = logging.getLogger(__name__)
 
@@ -742,15 +750,31 @@ def make_bucketed_solver(
     return solve
 
 
+def _slab_tree(slabs: Sequence[Slab]) -> list[dict]:
+    """Slabs as a named pytree — the leaf paths (``slabs/0/idx``) are
+    what the partition-rule regexes match against."""
+    return [
+        {"idx": s.idx, "weights": s.weights, "valid": s.valid}
+        for s in slabs
+    ]
+
+
+def _slab_tuples(tree: list[dict]) -> tuple:
+    return tuple((d["idx"], d["weights"], d["valid"]) for d in tree)
+
+
 def _device_slabs(ctx: ComputeContext, packed: Bucketed):
-    put = lambda a: jax.device_put(a, ctx.data_sharded)  # noqa: E731
-    slabs = tuple(
-        (put(s.idx), put(s.weights), put(s.valid)) for s in packed.slabs
+    """Stage the replicated-factor geometry per the ALS rule table:
+    slab rows split over ``data``, everything else replicated."""
+    placed = partition.shard_pytree(
+        ctx,
+        partition.ALS_REPLICATED_RULES,
+        {
+            "slabs": _slab_tree(packed.slabs),
+            "heavy": _slab_tree(packed.heavy),
+        },
     )
-    heavy = tuple(
-        (put(h.idx), put(h.weights), put(h.valid)) for h in packed.heavy
-    )
-    return slabs, heavy
+    return _slab_tuples(placed["slabs"]), _slab_tuples(placed["heavy"])
 
 
 def make_solve_side(
@@ -955,24 +979,31 @@ class ShardedSide:
 def stage_sharded(
     ctx: ComputeContext, packed: Bucketed, plan: ShardPlan
 ) -> ShardedSide:
-    rows_sharded = ctx.sharding((DATA_AXIS, MODEL_AXIS))
-    put = lambda a: jax.device_put(a, rows_sharded)  # noqa: E731
-    slabs = tuple(
-        (put(s.idx), put(s.weights), put(s.valid)) for s in packed.slabs
+    """Stage one direction's sharded geometry per the ALS rule table
+    (``partition.ALS_SHARDED_RULES``): slab rows split over the combined
+    (data, model) axes, the heavy owner map with its slab, the
+    device-major permutation over ``model``. Rule→axis validation runs
+    here (at staging), mirroring the static sharding-spec lint."""
+    tree: dict = {"slabs": _slab_tree(packed.slabs)}
+    if plan.heavy is not None:
+        tree["heavy"] = {
+            "idx": plan.heavy.idx,
+            "weights": plan.heavy.weights,
+            "valid": plan.heavy.valid,
+            "owner": plan.heavy_owner_local,
+        }
+    tree["inv_perm"] = plan.inv_perm_dm
+    placed = partition.shard_pytree(
+        ctx, partition.ALS_SHARDED_RULES, tree
     )
     heavy: tuple = ()
     if plan.heavy is not None:
-        heavy = (
-            put(plan.heavy.idx),
-            put(plan.heavy.weights),
-            put(plan.heavy.valid),
-            put(plan.heavy_owner_local),
-        )
-    inv = jax.device_put(plan.inv_perm_dm, ctx.sharding(MODEL_AXIS))
+        h = placed["heavy"]
+        heavy = (h["idx"], h["weights"], h["valid"], h["owner"])
     return ShardedSide(
-        slabs=slabs,
+        slabs=_slab_tuples(placed["slabs"]),
         heavy=heavy,
-        inv=inv,
+        inv=placed["inv_perm"],
         n_heavy_slots_local=plan.n_heavy_slots_local,
     )
 
@@ -1032,16 +1063,34 @@ def make_sharded_train_step(
     compute = _resolve_compute(compute_dtype)
     gather_layout = _resolve_gather_layout()
 
+    # the factor in/out contract comes from the SAME rule table that
+    # staged the geometry: each carry is a true NamedSharding over
+    # P(model) — inputs are pinned with a sharding constraint (a
+    # mis-sharded caller reshards once instead of silently replicating
+    # through the whole epoch chain) and outputs are pinned via
+    # out_shardings so the solve→scatter layout survives the jit edge
+    factor_sharding = NamedSharding(
+        mesh,
+        partition.match_partition_rule(
+            partition.ALS_SHARDED_RULES, "user_factors"
+        ),
+    )
+
     # donate the sharded factor carries like the replicated path: each
     # device's P(model) row slice is reused in place across the fused
     # epoch chain. CPU backends have no donation support.
     donate = (0, 1) if jax.default_backend() != "cpu" else ()
 
     @partial(
-        jax.jit, static_argnames=("n_iters",), donate_argnums=donate
+        jax.jit,
+        static_argnames=("n_iters",),
+        donate_argnums=donate,
+        out_shardings=(factor_sharding, factor_sharding),
     )
     def _run(x, y, u_slabs_a, u_heavy_a, u_inv_a,
              i_slabs_a, i_heavy_a, i_inv_a, lam, n_iters):
+        x = lax.with_sharding_constraint(x, factor_sharding)
+        y = lax.with_sharding_constraint(y, factor_sharding)
         def body(x_loc, y_loc, u_slabs, u_heavy, u_inv,
                  i_slabs, i_heavy, i_inv, lam_):
             def it(_, carry):
@@ -1066,7 +1115,7 @@ def make_sharded_train_step(
 
             return lax.fori_loop(0, n_iters, it, (x_loc, y_loc))
 
-        f = jax.shard_map(
+        f = shard_map(
             body,
             mesh=mesh,
             in_specs=(
@@ -1076,7 +1125,6 @@ def make_sharded_train_step(
                 P(),
             ),
             out_specs=(P(MODEL_AXIS, None), P(MODEL_AXIS, None)),
-            check_vma=False,
         )
         return f(
             x, y, u_slabs_a, u_heavy_a, u_inv_a,
@@ -1106,9 +1154,16 @@ def make_sharded_half_step(
     nh = side.n_heavy_slots_local
     compute = _resolve_compute(compute_dtype)
     gather_layout = _resolve_gather_layout()
+    factor_sharding = NamedSharding(
+        mesh,
+        partition.match_partition_rule(
+            partition.ALS_SHARDED_RULES, "user_factors"
+        ),
+    )
 
-    @jax.jit
+    @partial(jax.jit, out_shardings=factor_sharding)
     def _solve(y, slabs_a, heavy_a, inv_a, lam):
+        y = lax.with_sharding_constraint(y, factor_sharding)
         def body(y_loc, slabs, heavy, inv, lam_):
             y_full = lax.all_gather(
                 y_loc.astype(compute) if compute is not None else y_loc,
@@ -1119,7 +1174,7 @@ def make_sharded_half_step(
                 compute, gather_layout,
             )
 
-        f = jax.shard_map(
+        f = shard_map(
             body,
             mesh=mesh,
             in_specs=(
@@ -1127,7 +1182,6 @@ def make_sharded_half_step(
                 P(MODEL_AXIS), P(),
             ),
             out_specs=P(MODEL_AXIS, None),
-            check_vma=False,
         )
         return f(y, slabs_a, heavy_a, inv_a, lam)
 
@@ -1187,8 +1241,21 @@ def check_factor_sharding(
 
 @dataclasses.dataclass
 class ALSFactors:
-    user_factors: np.ndarray  # [n_users, k] (unpadded)
-    item_factors: np.ndarray  # [n_items, k]
+    """Trained factor matrices.
+
+    Host layout (default): unpadded numpy, ``[n_users, k]`` /
+    ``[n_items, k]``. Device layout (``train_als(...,
+    return_layout="device")``): the PADDED, device-resident (possibly
+    model-sharded) ``jax.Array`` carries exactly as the fused epoch
+    chain left them — the unbroken train→serve path; ``n_users`` /
+    ``n_items`` give the real row counts, rows past them are exact-zero
+    phantoms (asserted centrally before return).
+    """
+
+    user_factors: np.ndarray | jax.Array
+    item_factors: np.ndarray | jax.Array
+    n_users: int = 0
+    n_items: int = 0
 
 
 def _train_chaos_sleep_s() -> float:
@@ -1232,6 +1299,7 @@ def train_als(
     checkpoint_every: int = 0,
     resume: bool = False,
     factor_sharding: str = "auto",
+    return_layout: str = "host",
 ) -> ALSFactors:
     """Alternate user/item normal-equation solves on the mesh.
 
@@ -1257,12 +1325,32 @@ def train_als(
     split over all devices (the TPU-native equivalent of the
     reference's cluster-blocked factor RDDs, ALSModel.scala:10-12);
     "auto" picks "sharded" whenever the mesh has a model axis > 1.
+
+    ``return_layout`` selects the output form: "host" (default)
+    fetches unpadded numpy matrices; "device" returns the PADDED
+    device-resident carries exactly as trained — model-sharded factors
+    flow unbroken into serving (``Algorithm.stage_model`` /
+    ``similarity.stage_factors`` pass resident arrays through), so one
+    engine instance can serve a catalog that never fits a single
+    chip's HBM. Both layouts assert the phantom-row invariant (padded
+    rows solve to exact zeros) before returning.
     """
     del row_chunk
     if factor_sharding not in ("auto", "sharded", "replicated"):
         raise ValueError(
             f"factor_sharding must be 'auto', 'sharded' or 'replicated', "
             f"got {factor_sharding!r}"
+        )
+    if return_layout not in ("host", "device"):
+        raise ValueError(
+            f"return_layout must be 'host' or 'device', "
+            f"got {return_layout!r}"
+        )
+    if return_layout == "device" and jax.process_count() > 1:
+        raise NotImplementedError(
+            "return_layout='device' is single-process only (other "
+            "hosts' shards are not addressable here); use the default "
+            "host layout on multi-host meshes"
         )
     sharded = factor_sharding == "sharded" or (
         factor_sharding == "auto" and ctx.model_parallelism > 1
@@ -1359,7 +1447,14 @@ def train_als(
         (item_packed.n_rows_padded, rank), np.asarray(init).dtype
     )
     item_factors[:n_items] = init
-    factor_place = ctx.sharding(MODEL_AXIS) if sharded else ctx.replicated
+    # factor placement comes from the same rule table that stages the
+    # geometry and pins the train step's in/out specs — one source of
+    # layout truth per mode (docs/parallelism.md partition-rule table)
+    rules = partition.als_partition_rules(sharded)
+    partition.validate_rules(rules, ctx.mesh)
+    factor_place = NamedSharding(
+        ctx.mesh, partition.match_partition_rule(rules, "item_factors")
+    )
     item_factors = jax.device_put(item_factors, factor_place)
     user_factors = jax.device_put(
         np.zeros((user_packed.n_rows_padded, rank), np.asarray(init).dtype),
@@ -1480,14 +1575,55 @@ def train_als(
         # loop never ran (iterations == 0, or resume at full count):
         # use the checkpointed user factors if any, else solve once
         if resumed_user_factors is not None:
+            if return_layout == "device":
+                # the device-layout contract (padded, device-resident,
+                # factor-rule placement) holds on the resume-complete
+                # path too — pad the checkpointed host factors back to
+                # the mesh shape and commit them like the cold init
+                padded_u = np.zeros(
+                    (user_packed.n_rows_padded, rank),
+                    np.asarray(resumed_user_factors).dtype,
+                )
+                padded_u[:n_users] = resumed_user_factors[:n_users]
+                return ALSFactors(
+                    user_factors=jax.device_put(padded_u, factor_place),
+                    item_factors=item_factors,
+                    n_users=n_users,
+                    n_items=n_items,
+                )
+            item_full = fetch(item_factors)
+            assert_phantom_rows_zero(item_full, n_items, "item factors")
             return ALSFactors(
                 user_factors=resumed_user_factors[:n_users],
-                item_factors=fetch(item_factors)[:n_items],
+                item_factors=item_full[:n_items],
+                n_users=n_users,
+                n_items=n_items,
             )
         user_factors = solve_u_half(item_factors, lam)
+    if return_layout == "device":
+        # the phantom-row invariant still holds on-device: fetch ONLY
+        # the padded tails (cheap — at most row_multiple-1 rows/side)
+        assert_phantom_rows_zero(
+            jax.device_get(user_factors[n_users:]), 0, "user factors"
+        )
+        assert_phantom_rows_zero(
+            jax.device_get(item_factors[n_items:]), 0, "item factors"
+        )
+        return ALSFactors(
+            user_factors=user_factors,
+            item_factors=item_factors,
+            n_users=n_users,
+            n_items=n_items,
+        )
+    user_full = fetch(user_factors)
+    item_full = fetch(item_factors)
+    assert_phantom_rows_zero(user_full, n_users, "user factors")
+    assert_phantom_rows_zero(item_full, n_items, "item factors")
     return ALSFactors(
-        user_factors=fetch(user_factors)[:n_users],
-        item_factors=fetch(item_factors)[:n_items],
+        user_factors=user_full[:n_users],
+        item_factors=item_full[:n_items],
+        n_users=n_users,
+        n_items=n_items,
     )
 
 

@@ -34,15 +34,26 @@ def _top_k_dot_xla(
     queries: jax.Array,      # [B, k]
     items: jax.Array,        # [I, k]
     num: int,
-    mask: jax.Array | None = None,  # [B, I] True = exclude
+    mask: jax.Array | None = None,  # [B, I] or [I] — True = exclude
 ) -> tuple[jax.Array, jax.Array]:
     scores = queries @ items.T  # [B, I] — MXU
     # NaN scores (corrupted factors) map to -inf, matching the Pallas
     # kernel's masking — both top_k_dot paths must rank identically
     scores = jnp.where(jnp.isnan(scores), -jnp.inf, scores)
     if mask is not None:
+        # [I] masks (per-item, e.g. phantom padding rows of a sharded
+        # catalog) broadcast over the batch dim
         scores = jnp.where(mask, -jnp.inf, scores)
     return jax.lax.top_k(scores, num)
+
+
+def _pallas_mask(mask, batch: int):
+    """The Pallas kernel streams ``[B, IB]`` mask blocks through VMEM,
+    so a per-item ``[I]`` mask must materialize its batch dim first
+    (the XLA path broadcasts lazily and never pays this)."""
+    if mask is not None and mask.ndim == 1:
+        return jnp.broadcast_to(mask[None, :], (batch, mask.shape[0]))
+    return mask
 
 
 def _use_pallas(batch: int, n_items: int) -> bool:
@@ -76,7 +87,7 @@ def top_k_dot(
         # a forced override off-TPU runs the interpreter (slow but
         # correct); Mosaic kernels only compile for TPU
         return fused_top_k_dot(
-            queries, items, num, mask,
+            queries, items, num, _pallas_mask(mask, queries.shape[0]),
             interpret=jax.default_backend() != "tpu",
         )
     return _top_k_dot_xla(queries, items, num, mask)
@@ -108,7 +119,11 @@ def top_k_cosine(
 
 def stage_factors(x) -> jax.Array:
     """Upload a factor matrix to the default device once; idempotent —
-    an already device-resident ``jax.Array`` is returned as-is."""
+    an already device-resident ``jax.Array`` is returned as-is (a
+    mesh-sharded array keeps its placement). Catalogs that should be
+    committed SHARDED go through
+    ``parallel.partition.stage_factor_matrix`` instead, which also
+    pads rows and builds the phantom mask."""
     if isinstance(x, jax.Array) and not x.is_deleted():
         return x
     return jax.device_put(jnp.asarray(x))
@@ -141,7 +156,7 @@ def gather_top_k_dot(
 
         vecs = jnp.take(factors, idx, axis=0)
         return fused_top_k_dot(
-            vecs, items, num, mask,
+            vecs, items, num, _pallas_mask(mask, idx.shape[0]),
             interpret=jax.default_backend() != "tpu",
         )
     return _gather_top_k_dot_xla(factors, idx, items, num, mask)
@@ -152,6 +167,7 @@ def _gather_mean_top_k_cosine_xla(
     items_f: jax.Array,   # [I, k] staged
     idx: jax.Array,       # [L] int32, -1 = padding
     num: int,
+    mask: jax.Array | None = None,  # [I] True = exclude (phantom rows)
 ) -> tuple[jax.Array, jax.Array]:
     valid = idx >= 0
     rows = jnp.take(items_f, jnp.clip(idx, 0, None), axis=0)
@@ -160,17 +176,22 @@ def _gather_mean_top_k_cosine_xla(
         w.sum(), 1.0
     )
     return _top_k_dot_xla(
-        l2_normalize(q), l2_normalize(items_f), num
+        l2_normalize(q), l2_normalize(items_f), num, mask
     )
 
 
 def gather_mean_top_k_cosine(
-    items_f, idx, num: int
+    items_f, idx, num: int, mask=None
 ) -> tuple[jax.Array, jax.Array]:
     """Similar-product query in one dispatch: mean of the (``-1``-padded)
     gathered item rows → cosine against the whole catalog → top-``num``.
+    ``mask`` ([I] bool, True = exclude) drops rows from the ranking —
+    the phantom padding rows of a model-sharded catalog score -inf.
     Returns ([1, num] scores, [1, num] indices)."""
     items_f = jnp.asarray(items_f)
     return _gather_mean_top_k_cosine_xla(
-        items_f, jnp.asarray(idx, jnp.int32), min(num, items_f.shape[0])
+        items_f,
+        jnp.asarray(idx, jnp.int32),
+        min(num, items_f.shape[0]),
+        mask,
     )

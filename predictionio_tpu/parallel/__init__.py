@@ -12,12 +12,32 @@ from predictionio_tpu.parallel.mesh import (
     ComputeContext,
     DATA_AXIS,
     MODEL_AXIS,
+    assert_phantom_rows_zero,
     pad_to_multiple,
+)
+from predictionio_tpu.parallel.partition import (
+    als_partition_rules,
+    match_partition_rule,
+    match_partition_rules,
+    mesh_from_topology,
+    shard_pytree,
+    stage_factor_matrix,
+    topology_mesh_shape,
+    validate_rules,
 )
 
 __all__ = [
     "ComputeContext",
     "DATA_AXIS",
     "MODEL_AXIS",
+    "assert_phantom_rows_zero",
     "pad_to_multiple",
+    "als_partition_rules",
+    "match_partition_rule",
+    "match_partition_rules",
+    "mesh_from_topology",
+    "shard_pytree",
+    "stage_factor_matrix",
+    "topology_mesh_shape",
+    "validate_rules",
 ]

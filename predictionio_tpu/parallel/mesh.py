@@ -134,6 +134,43 @@ def pad_to_multiple(
     return np.pad(arr, widths, constant_values=fill)
 
 
+def record_padded_rows(added: int, n_rows: int, parallelism: int) -> None:
+    """Telemetry for mesh-padding sites (`shard_rows`, factor staging):
+    counts phantom rows added so a workload quietly dominated by
+    padding — e.g. an array smaller than the device count — is
+    scrape-visible instead of silent."""
+    from predictionio_tpu.obs import get_registry
+
+    get_registry().counter(
+        "pio_mesh_pad_rows_total",
+        "Phantom rows added when padding arrays to a mesh-axis "
+        "multiple (shard_rows / sharded factor staging)",
+    ).inc(added)
+    if n_rows < parallelism:
+        logger.warning(
+            "padding %d-row array to %d rows to shard over %d "
+            "devices — padding exceeds the real data",
+            n_rows, n_rows + added, parallelism,
+        )
+
+
+def assert_phantom_rows_zero(
+    arr: np.ndarray, n_real: int, what: str = "factors"
+) -> None:
+    """The phantom-row invariant, asserted once centrally: rows past
+    ``n_real`` exist only for mesh-shape padding and must be EXACT
+    zeros (the padded normal equations have ``b = 0``, so the solver
+    produces 0 — any nonzero phantom means corrupt packing/solve state
+    and would score into serving top-k as a ghost entity)."""
+    tail = np.asarray(arr)[n_real:]
+    if tail.size and np.any(tail != 0):
+        bad = int(np.count_nonzero(np.any(tail != 0, axis=-1)))
+        raise AssertionError(
+            f"phantom-row invariant violated: {bad} padded row(s) of "
+            f"{what} past row {n_real} are nonzero"
+        )
+
+
 @dataclasses.dataclass
 class ComputeContext:
     """Mesh + sharding helpers threaded through DASE controllers."""
@@ -214,8 +251,20 @@ class ComputeContext:
         return NamedSharding(self.mesh, P(MODEL_AXIS))
 
     def shard_rows(self, arr: np.ndarray, fill: Any = 0) -> jax.Array:
-        """Pad rows to the data-axis multiple and place data-sharded."""
-        padded = pad_to_multiple(arr, self.data_parallelism, axis=0, fill=fill)
+        """Pad rows to the data-axis multiple and place data-sharded.
+
+        An array smaller than the device count pads up to one row per
+        device and still shards (never a silent replicated fallback);
+        the added phantom rows are counted in
+        ``pio_mesh_pad_rows_total`` and warned about, since a workload
+        dominated by padding usually means the mesh is too wide for
+        the data."""
+        multiple = max(self.data_parallelism, 1)
+        padded = pad_to_multiple(arr, multiple, axis=0, fill=fill)
+        if padded.shape[0] != arr.shape[0]:
+            record_padded_rows(
+                padded.shape[0] - arr.shape[0], arr.shape[0], multiple
+            )
         return jax.device_put(padded, self.data_sharded)
 
     def replicate(self, arr: Any) -> jax.Array:

@@ -475,6 +475,15 @@ class EngineServer:
                 "engineVariant": self._engine_variant,
                 "engineInstanceId": self._instance.id,
                 "generation": self._generation,
+                # serving mesh topology: a model axis > 1 means the
+                # factor catalog is row-sharded across devices — one
+                # instance serving a catalog bigger than one chip's
+                # HBM (docs/parallelism.md "Sharded ALS")
+                "mesh": {
+                    str(name): int(size)
+                    for name, size in self._ctx.mesh.shape.items()
+                },
+                "modelSharded": self._ctx.model_parallelism > 1,
                 "canaryState": (
                     self._canary.state
                     if self._canary is not None

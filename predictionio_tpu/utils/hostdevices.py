@@ -1,0 +1,41 @@
+"""XLA host-platform virtual-device pinning (pre-jax-import).
+
+The CPU backend honours ``--xla_force_host_platform_device_count`` only
+at client creation, so the flag must land in ``XLA_FLAGS`` BEFORE jax
+initializes — later edits no-op silently. Every multi-device harness in
+the repo (tests/conftest.py, ``__graft_entry__.dryrun_multichip``, the
+``tests/distributed*_child.py`` processes, ``scripts/multichip_bench.py``
+workers) shares THIS helper so the set-or-rewrite contract lives in one
+place. This module must stay importable without jax.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_OPT = "--xla_force_host_platform_device_count"
+
+
+def force_host_platform_device_count(n: int, *, exact: bool = False) -> None:
+    """Pin the CPU host platform to ``n`` virtual devices via
+    ``XLA_FLAGS``, preserving every other flag.
+
+    An existing pin is raised to ``n`` when lower and otherwise left
+    alone (``exact=False`` — the test-harness/dryrun contract: never
+    shrink a wider pin another harness set), or rewritten to exactly
+    ``n`` (``exact=True`` — the multichip bench's per-worker sweep,
+    where each device count must be measured at precisely that count).
+    """
+    if n < 1:
+        raise ValueError(f"device count must be >= 1, got {n}")
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(rf"{_OPT}=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = f"{flags} {_OPT}={n}".strip()
+    elif (exact and int(m.group(1)) != n) or (
+        not exact and int(m.group(1)) < n
+    ):
+        os.environ["XLA_FLAGS"] = re.sub(
+            rf"{_OPT}=\d+", f"{_OPT}={n}", flags
+        )

@@ -1,0 +1,54 @@
+"""Shared trajectory-file discipline for bench records.
+
+One implementation of the load→validate→append→trim-to-100→write
+cycle used by every bench that persists its runs
+(``SERVING_BENCH.json``, ``MULTICHIP.json``): a file whose schema
+string doesn't match is replaced rather than appended to (never
+trusted), the last 100 runs are kept, and an unwritable path degrades
+to a stderr note — a bench must never fail because its trajectory
+file can't be written.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import sys
+
+
+def append_run(
+    record: dict, out_path: str, schema: str, label: str
+) -> None:
+    """Append ``record`` (stamped ``recordedAtUtc``) to the trajectory
+    file at ``out_path`` under ``schema``; ``label`` prefixes the
+    cannot-persist stderr note."""
+    doc = {"schema": schema, "runs": []}
+    try:
+        with open(out_path) as f:
+            existing = json.load(f)
+        if (
+            isinstance(existing, dict)
+            and existing.get("schema") == schema
+            and isinstance(existing.get("runs"), list)
+        ):
+            doc = existing
+    except (OSError, ValueError):
+        pass
+    doc["runs"].append(
+        {
+            "recordedAtUtc": _dt.datetime.now(
+                _dt.timezone.utc
+            ).isoformat(timespec="seconds"),
+            **record,
+        }
+    )
+    del doc["runs"][:-100]
+    try:
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+    except OSError as e:
+        print(
+            f"{label}: cannot persist to {out_path}: {e}",
+            file=sys.stderr,
+        )

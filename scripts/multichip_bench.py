@@ -1,0 +1,550 @@
+"""Multichip scaling bench — measured 1→2→4→8 device curves for the
+model-sharded ALS path, training AND serving.
+
+The 8-device dryrun proves the sharded programs *execute*; this bench
+proves (and records) what they *buy*:
+
+* **strong scaling** — one fixed, compute-bound workload; the fused
+  sharded epoch is timed at each device count. ``speedup(N) =
+  t(1)/t(N)``, ``efficiency(N) = speedup(N)/N``.
+* **weak scaling** — the workload grows ∝ N (users and interactions);
+  ideal is flat epoch time, ``efficiency(N) = t_weak(1)/t_weak(N)``.
+* **sharded serving** — the two-phase
+  ``batch_predict_launch/collect`` step over the factor matrices the
+  sharded epoch just produced, taken UNBROKEN (device-resident,
+  model-sharded, no host gather) into an ``ALSRecModel``; p50/p99 per
+  batch at each device count, plus factor bytes-per-device — the
+  catalog-capacity axis.
+* **numerical equality** — the sharded epoch's factors must match the
+  replicated epoch's within tolerance (always gated).
+
+Each device count runs in a fresh worker subprocess so
+``XLA_FLAGS=--xla_force_host_platform_device_count=N`` lands before
+jax initializes — CI always exercises the sweep on the host platform
+(the same virtual-device harness the test suite uses); on a real TPU
+slice pass ``--platform native``.
+
+The run prints ONE BENCH-format JSON line and appends to
+``MULTICHIP.json`` at the repo root (schema ``multichip-bench/v1``,
+last 100 runs kept — the same trajectory discipline as
+``SERVING_BENCH.json``).
+
+Gate (CI ``--smoke``): every worker must succeed and sharded factors
+must equal replicated factors within tolerance. The ≥1.6× strong
+scaling floor at 4 devices applies only when the runner can physically
+show it — on hosts with fewer cores than simulated devices the
+number is RECORDED, not gated (the ``serving_bench.py --ramp``
+degenerate-escape pattern): virtual devices time-share the same
+cores, so a flat curve there says nothing about the program.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)  # the package itself (no install required)
+DEFAULT_OUT = os.path.join(REPO_ROOT, "MULTICHIP.json")
+SCHEMA = "multichip-bench/v1"
+
+#: (n_users, n_items, nnz, rank, block_len) at N=1; weak mode scales
+#: users and nnz by N
+WORKLOADS = {
+    # compute-bound enough that 4-way parallelism shows on real cores,
+    # small enough that the whole sweep stays in CI budgets
+    "smoke": (2_048, 768, 40_000, 16, 32),
+    # ml-1m territory — the measured-scaling workload for real runs
+    "default": (49_152, 8_192, 2_000_000, 32, 64),
+}
+STRONG_FLOOR_4DEV = 1.6
+EQUALITY_RTOL = 1e-4
+EQUALITY_ATOL = 1e-5
+
+
+def _phase(msg: str) -> None:
+    print(f"[multichip] {msg}", file=sys.stderr, flush=True)
+
+
+# --------------------------------------------------------------------------
+# Worker (one device count per process)
+# --------------------------------------------------------------------------
+
+
+def _force_host_devices(n: int) -> None:
+    """Pin the CPU host platform to EXACTLY n virtual devices before
+    jax initializes (shared contract: utils/hostdevices.py)."""
+    from predictionio_tpu.utils.hostdevices import (
+        force_host_platform_device_count,
+    )
+
+    force_host_platform_device_count(n, exact=True)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def _make_data(n_users: int, n_items: int, nnz: int):
+    import numpy as np
+
+    rng = np.random.default_rng(42)
+    pop = rng.zipf(1.3, nnz) % n_items  # power-law item popularity
+    rows = rng.integers(0, n_users, nnz).astype(np.int32)
+    cols = pop.astype(np.int32)
+    vals = rng.integers(1, 6, nnz).astype(np.float32)
+    return rows, cols, vals
+
+
+def _time_sharded_epochs(ctx, rows, cols, vals, n_users, n_items,
+                         rank, block_len, epochs, rounds):
+    """Median per-epoch seconds of the fused model-sharded train step
+    (plus the staged factor arrays for the serving phase)."""
+    import time
+
+    import jax
+    import numpy as np
+
+    from predictionio_tpu.ops.als import (
+        build_bucketed,
+        make_sharded_train_step,
+        plan_shards,
+        stage_sharded,
+    )
+    from predictionio_tpu.parallel import partition
+
+    n_dev = ctx.n_devices
+    user_packed = build_bucketed(
+        rows, cols, vals, n_users, block_len=block_len,
+        row_multiple=n_dev,
+    )
+    item_packed = build_bucketed(
+        cols, rows, vals, n_items, block_len=block_len,
+        row_multiple=n_dev,
+    )
+    u_side = stage_sharded(ctx, user_packed, plan_shards(user_packed, n_dev))
+    i_side = stage_sharded(ctx, item_packed, plan_shards(item_packed, n_dev))
+    run = make_sharded_train_step(ctx, u_side, i_side, True, 1.0)
+
+    placed = partition.shard_pytree(
+        ctx,
+        partition.ALS_SHARDED_RULES,
+        {
+            "user_factors": np.zeros(
+                (user_packed.n_rows_padded, rank), np.float32
+            ),
+            "item_factors": (
+                np.random.default_rng(7)
+                .normal(size=(item_packed.n_rows_padded, rank))
+                .astype(np.float32)
+                / np.sqrt(rank)
+            ),
+        },
+    )
+    x, y = placed["user_factors"], placed["item_factors"]
+    lam = np.float32(0.01)
+
+    def sync(arr) -> float:
+        # device→host fetch of a scalar reduction: the only barrier
+        # that is reliable on every platform (bench.py convention)
+        return float(jax.device_get(arr.sum()))
+
+    t0 = time.perf_counter()
+    x, y = run(x, y, lam, n_iters=epochs)
+    sync(y)
+    _phase(f"  compile+warmup {time.perf_counter() - t0:.1f}s")
+    times = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        x, y = run(x, y, lam, n_iters=epochs)
+        sync(y)
+        times.append((time.perf_counter() - t0) / epochs)
+    return float(np.median(times)), x, y
+
+
+def _serve_sharded(ctx, x, y, n_users, n_items, rank, batch, iters):
+    """Two-phase serving latency over the factors the sharded epoch
+    just produced — device-resident and model-sharded, no host gather
+    anywhere on the path."""
+    import time
+
+    import numpy as np
+
+    from predictionio_tpu.models.recommendation import (
+        ALSAlgorithm,
+        ALSRecModel,
+    )
+    from predictionio_tpu.utils.bimap import BiMap
+
+    algo = ALSAlgorithm()
+    model = algo.stage_model(
+        ctx,
+        ALSRecModel(
+            user_factors=x,
+            item_factors=y,
+            user_map=BiMap([f"u{i}" for i in range(n_users)]),
+            item_map=BiMap([f"i{i}" for i in range(n_items)]),
+        ),
+    )
+    rng = np.random.default_rng(5)
+    queries = [
+        {"user": f"u{int(u)}", "num": 10}
+        for u in rng.integers(0, n_users, batch)
+    ]
+    # warmup (compiles the serving bucket)
+    algo.batch_predict_collect(
+        model, algo.batch_predict_launch(model, queries), queries
+    )
+    lat = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = algo.batch_predict_collect(
+            model, algo.batch_predict_launch(model, queries), queries
+        )
+        lat.append((time.perf_counter() - t0) * 1000.0)
+        assert len(out) == batch
+    lat.sort()
+    factor_bytes = sum(
+        s.data.nbytes
+        for arr in (model.user_factors, model.item_factors)
+        for s in arr.addressable_shards
+        if s.device == arr.addressable_shards[0].device
+    )
+    return {
+        "p50_ms": round(lat[len(lat) // 2], 3),
+        "p99_ms": round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 3),
+        "batch": batch,
+        "iters": iters,
+        "factor_bytes_per_device": int(factor_bytes),
+    }
+
+
+def _check_equality(ctx, rows, cols, vals, n_users, n_items, rank,
+                    block_len):
+    """Sharded vs replicated epochs on identical data/seed — the
+    correctness gate behind every scaling number here."""
+    import numpy as np
+
+    from predictionio_tpu.ops.als import train_als
+
+    kwargs = dict(
+        n_users=n_users, n_items=n_items, rank=rank, iterations=3,
+        block_len=block_len, seed=13,
+    )
+    f_sharded = train_als(
+        ctx, rows, cols, vals, factor_sharding="sharded", **kwargs
+    )
+    f_repl = train_als(
+        ctx, rows, cols, vals, factor_sharding="replicated", **kwargs
+    )
+    diff_u = float(
+        np.max(np.abs(f_sharded.user_factors - f_repl.user_factors))
+    )
+    diff_i = float(
+        np.max(np.abs(f_sharded.item_factors - f_repl.item_factors))
+    )
+    ok = np.allclose(
+        f_sharded.user_factors, f_repl.user_factors,
+        rtol=EQUALITY_RTOL, atol=EQUALITY_ATOL,
+    ) and np.allclose(
+        f_sharded.item_factors, f_repl.item_factors,
+        rtol=EQUALITY_RTOL, atol=EQUALITY_ATOL,
+    )
+    return {
+        "ok": bool(ok),
+        "max_abs_diff_user": diff_u,
+        "max_abs_diff_item": diff_i,
+        "rtol": EQUALITY_RTOL,
+        "atol": EQUALITY_ATOL,
+    }
+
+
+def run_worker(args) -> dict:
+    n = args.worker
+    if args.platform == "host":
+        _force_host_devices(n)
+    import jax
+
+    from predictionio_tpu.parallel import partition
+
+    ctx = partition.mesh_from_topology(n, batch=f"multichip:{n}")
+    mesh = {
+        str(k): int(v) for k, v in ctx.mesh.shape.items()
+    }
+    _phase(f"worker n={n}: mesh {mesh} on {jax.default_backend()}")
+    n_users, n_items, nnz, rank, block_len = WORKLOADS[args.workload]
+
+    rows, cols, vals = _make_data(n_users, n_items, nnz)
+    _phase(f"  strong: {n_users}x{n_items}x{nnz}@r{rank}")
+    strong_s, x, y = _time_sharded_epochs(
+        ctx, rows, cols, vals, n_users, n_items, rank, block_len,
+        args.epochs, args.rounds,
+    )
+    _phase(f"  strong epoch {strong_s:.4f}s")
+
+    serving = _serve_sharded(
+        ctx, x, y, n_users, n_items, rank,
+        batch=args.serve_batch, iters=args.serve_iters,
+    )
+    _phase(f"  serving p50 {serving['p50_ms']}ms p99 {serving['p99_ms']}ms")
+
+    w_users, w_nnz = n_users * n, nnz * n
+    w_rows, w_cols, w_vals = _make_data(w_users, n_items, w_nnz)
+    _phase(f"  weak: {w_users}x{n_items}x{w_nnz}@r{rank}")
+    weak_s, _, _ = _time_sharded_epochs(
+        ctx, w_rows, w_cols, w_vals, w_users, n_items, rank, block_len,
+        args.epochs, args.rounds,
+    )
+    _phase(f"  weak epoch {weak_s:.4f}s")
+
+    result = {
+        "n_devices": n,
+        "mesh": mesh,
+        "backend": jax.default_backend(),
+        "strong_epoch_s": round(strong_s, 5),
+        "weak_epoch_s": round(weak_s, 5),
+        "weak_workload": f"{w_users}x{n_items}x{w_nnz}@r{rank}",
+        "serving": serving,
+    }
+    if args.check_equality:
+        _phase("  equality: sharded vs replicated train")
+        result["equality"] = _check_equality(
+            ctx, rows, cols, vals, n_users, n_items, rank, block_len
+        )
+        _phase(f"  equality ok={result['equality']['ok']}")
+    return result
+
+
+# --------------------------------------------------------------------------
+# Orchestrator
+# --------------------------------------------------------------------------
+
+
+def _run_one_worker(n: int, args, check_equality: bool):
+    cmd = [
+        sys.executable, os.path.abspath(__file__),
+        "--worker", str(n),
+        "--workload", args.workload,
+        "--platform", args.platform,
+        "--epochs", str(args.epochs),
+        "--rounds", str(args.rounds),
+        "--serve-batch", str(args.serve_batch),
+        "--serve-iters", str(args.serve_iters),
+    ]
+    if check_equality:
+        cmd.append("--check-equality")
+    env = dict(os.environ)
+    try:
+        proc = subprocess.run(
+            cmd, env=env, capture_output=True, text=True,
+            timeout=args.worker_timeout_s, cwd=REPO_ROOT,
+        )
+    except subprocess.TimeoutExpired as e:
+        tail = (e.stderr or "").strip().splitlines()[-3:] if e.stderr else []
+        return None, (
+            f"worker n={n} timed out after {args.worker_timeout_s}s"
+            + (f" (last: {tail[-1]})" if tail else "")
+        )
+    # phase lines surface in CI logs even on success
+    if proc.stderr:
+        sys.stderr.write(proc.stderr)
+    lines = proc.stdout.strip().splitlines()
+    if proc.returncode == 0 and lines:
+        try:
+            return json.loads(lines[-1]), None
+        except ValueError:
+            pass
+    tail = (proc.stderr or "").strip().splitlines()[-3:]
+    return None, f"worker n={n} rc={proc.returncode}: " + " | ".join(tail)
+
+
+def _curves(per_device: list[dict]) -> dict:
+    base = per_device[0]
+    t1, w1 = base["strong_epoch_s"], base["weak_epoch_s"]
+    strong_speedup, strong_eff, weak_eff = {}, {}, {}
+    for r in per_device:
+        n = r["n_devices"]
+        s = t1 / r["strong_epoch_s"] if r["strong_epoch_s"] else 0.0
+        strong_speedup[str(n)] = round(s, 3)
+        strong_eff[str(n)] = round(s / n, 3)
+        weak_eff[str(n)] = round(
+            w1 / r["weak_epoch_s"] if r["weak_epoch_s"] else 0.0, 3
+        )
+    return {
+        "strong_speedup": strong_speedup,
+        "strong_efficiency": strong_eff,
+        "weak_efficiency": weak_eff,
+    }
+
+
+def degenerate_reason(per_device: list[dict], devices: list[int]) -> str:
+    """Scaling-gate escape: conditions under which a flat strong curve
+    says nothing about the program (recorded, never gated). Equality
+    and worker health are ALWAYS gated — a real sharding bug still
+    fails on a degenerate runner."""
+    cores = os.cpu_count() or 1
+    gate_n = max(n for n in devices if n <= 4)
+    if gate_n < 4:
+        return f"no 4-device point in sweep {devices}"
+    if per_device[0]["backend"] == "cpu" and cores < 4:
+        return (
+            f"host has {cores} core(s) for 4 simulated devices — "
+            "virtual devices time-share cores, strong scaling is "
+            "physically capped"
+        )
+    return ""
+
+
+def persist_record(record: dict, out_path: str) -> None:
+    """Append the run to the MULTICHIP trajectory (schema
+    multichip-bench/v1, last 100 runs) — scaling claims cite these,
+    the SERVING_BENCH.json discipline (shared bench_record helper)."""
+    from bench_record import append_run
+
+    append_run(record, out_path, SCHEMA, "multichip_bench")
+
+
+def orchestrate(args) -> int:
+    devices = sorted({int(d) for d in args.devices.split(",")})
+    if devices[0] != 1:
+        print(
+            "multichip_bench: the sweep needs the 1-device baseline "
+            f"(got {devices})",
+            file=sys.stderr,
+        )
+        return 2
+    per_device = []
+    failures: list[str] = []
+    for n in devices:
+        _phase(f"spawning worker n={n}")
+        result, err = _run_one_worker(
+            n, args, check_equality=(n == devices[-1])
+        )
+        if result is None:
+            failures.append(err)
+            _phase(err)
+            continue
+        per_device.append(result)
+
+    record: dict = {
+        "metric": "multichip_scaling",
+        "unit": "x",
+        "extra": {
+            "workload": args.workload,
+            "platform": args.platform,
+            "host_cores": os.cpu_count(),
+            "devices": per_device,
+        },
+    }
+    if failures or not per_device:
+        record["value"] = None
+        record["error"] = failures
+        persist_record(record, args.out)
+        print(json.dumps(record))
+        return 1
+
+    curves = _curves(per_device)
+    record["extra"].update(curves)
+    measured = [r["n_devices"] for r in per_device]
+    gate_n = max(n for n in measured if n <= 4)
+    headline = curves["strong_speedup"].get(str(gate_n), 0.0)
+    record["value"] = headline
+    record["vs_baseline"] = headline
+
+    equality = per_device[-1].get("equality")
+    record["extra"]["equality"] = equality
+    reason = degenerate_reason(per_device, measured)
+    if reason:
+        record["extra"]["scaling_gate"] = {
+            "gated": False,
+            "degenerate": reason,
+        }
+        _phase(f"scaling gate skipped (degenerate runner): {reason}")
+    else:
+        gated_ok = headline >= STRONG_FLOOR_4DEV
+        record["extra"]["scaling_gate"] = {
+            "gated": True,
+            "floor": STRONG_FLOOR_4DEV,
+            "at_devices": gate_n,
+            "ok": gated_ok,
+        }
+        if not gated_ok:
+            failures.append(
+                f"strong scaling at {gate_n} devices is {headline}x, "
+                f"below the {STRONG_FLOOR_4DEV}x floor"
+            )
+    if equality is None or not equality.get("ok"):
+        failures.append(
+            f"sharded factors do not match replicated factors: "
+            f"{equality}"
+        )
+
+    persist_record(record, args.out)
+    print(json.dumps(record))
+    if failures:
+        for f in failures:
+            print(f"multichip_bench: GATE FAILED: {f}", file=sys.stderr)
+        return 1
+    serving_max = per_device[-1]["serving"]
+    print(
+        f"multichip_bench: strong x{headline} @ {gate_n} dev "
+        f"(eff {curves['strong_efficiency']}), weak eff "
+        f"{curves['weak_efficiency']}, serving p99 "
+        f"{serving_max['p99_ms']}ms @ {per_device[-1]['n_devices']} dev, "
+        f"equality ok — recorded to {os.path.basename(args.out)}",
+        file=sys.stderr,
+    )
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="small CI-safe sweep (host platform)")
+    ap.add_argument("--devices", default="1,2,4,8",
+                    help="comma-separated device counts (must include 1)")
+    ap.add_argument("--workload", default=None,
+                    choices=sorted(WORKLOADS),
+                    help="workload size (default: smoke⇒smoke, else default)")
+    ap.add_argument("--platform", default="host",
+                    choices=("host", "native"),
+                    help="host = simulated CPU devices (CI); native = "
+                         "the process's real default platform")
+    ap.add_argument("--epochs", type=int, default=None,
+                    help="fused epochs per timed dispatch")
+    ap.add_argument("--rounds", type=int, default=None,
+                    help="timed dispatches per measurement")
+    ap.add_argument("--serve-batch", type=int, default=64)
+    ap.add_argument("--serve-iters", type=int, default=None)
+    ap.add_argument("--worker-timeout-s", type=float, default=None)
+    ap.add_argument("--out", default=DEFAULT_OUT,
+                    help="MULTICHIP trajectory file to append to")
+    ap.add_argument("--worker", type=int, default=None,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--check-equality", action="store_true",
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args()
+
+    if args.workload is None:
+        args.workload = "smoke" if args.smoke else "default"
+    if args.epochs is None:
+        args.epochs = 2 if args.workload == "smoke" else 8
+    if args.rounds is None:
+        args.rounds = 2 if args.workload == "smoke" else 3
+    if args.serve_iters is None:
+        args.serve_iters = 20 if args.workload == "smoke" else 100
+    if args.worker_timeout_s is None:
+        # smoke budget must nest inside check.sh's outer timeout: 4
+        # sequential workers x 150s < the 780s block bound, so a hung
+        # worker dies HERE with a per-worker diagnostic and a persisted
+        # error record, never as a bare outer SIGTERM
+        args.worker_timeout_s = 150 if args.workload == "smoke" else 1800
+
+    if args.worker is not None:
+        print(json.dumps(run_worker(args)))
+        return 0
+    return orchestrate(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

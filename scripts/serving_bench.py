@@ -737,37 +737,11 @@ def ramp_main(args) -> int:
 def persist_record(record: dict, out_path: str) -> None:
     """Append the run to the stable serving-bench trajectory file
     (schema serving-bench/v1), mirroring how the training bench's
-    BENCH_*.json rounds persist — scaling claims cite these."""
-    import datetime as _dt
+    BENCH_*.json rounds persist — scaling claims cite these (shared
+    bench_record helper)."""
+    from bench_record import append_run
 
-    doc = {"schema": "serving-bench/v1", "runs": []}
-    try:
-        with open(out_path) as f:
-            existing = json.load(f)
-        if (
-            isinstance(existing, dict)
-            and existing.get("schema") == "serving-bench/v1"
-            and isinstance(existing.get("runs"), list)
-        ):
-            doc = existing
-    except (OSError, ValueError):
-        pass
-    doc["runs"].append(
-        {
-            "recordedAtUtc": _dt.datetime.now(
-                _dt.timezone.utc
-            ).isoformat(timespec="seconds"),
-            **record,
-        }
-    )
-    del doc["runs"][:-100]
-    try:
-        with open(out_path, "w") as f:
-            json.dump(doc, f, indent=1)
-            f.write("\n")
-    except OSError as e:
-        print(f"serving_bench: cannot persist to {out_path}: {e}",
-              file=sys.stderr)
+    append_run(record, out_path, "serving-bench/v1", "serving_bench")
 
 
 def main() -> int:

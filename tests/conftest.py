@@ -13,11 +13,12 @@ import os
 # var alone is not enough (the TPU-tunnel plugin stomps it), so also
 # force the platform via jax.config after import.
 os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# shared pre-jax-import pinning contract (jax-free module)
+from predictionio_tpu.utils.hostdevices import (  # noqa: E402
+    force_host_platform_device_count,
+)
+
+force_host_platform_device_count(8)
 
 import jax  # noqa: E402
 

@@ -20,9 +20,13 @@ _MESH = tuple(
 )
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = (
-    f"--xla_force_host_platform_device_count={_LOCAL_DEVICES}"
+from predictionio_tpu.utils.hostdevices import (  # noqa: E402
+    force_host_platform_device_count,
 )
+
+# each process must see EXACTLY its local device count — a wider pin
+# inherited from a parent harness would break the global mesh math
+force_host_platform_device_count(_LOCAL_DEVICES, exact=True)
 
 import jax  # noqa: E402
 

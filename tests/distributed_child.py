@@ -11,7 +11,11 @@ actually coordinates processes.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+from predictionio_tpu.utils.hostdevices import (  # noqa: E402
+    force_host_platform_device_count,
+)
+
+force_host_platform_device_count(2, exact=True)
 
 import jax  # noqa: E402
 

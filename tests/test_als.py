@@ -1,6 +1,9 @@
 """ALS kernel tests: packing correctness, normal-equation agreement with a
 dense numpy reference, reconstruction quality, multi-device equivalence."""
 
+import dataclasses
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -677,3 +680,305 @@ class TestGatherLayoutDefault:
 
         with _pytest.raises(ValueError, match="bogus"):
             _resolve_gather_layout()
+
+
+class TestShardPlanEdges:
+    """plan_shards / stage_sharded edge cases (previously untested):
+    non-divisible row counts, a mesh axis of size 1 degrading to the
+    unsharded layout, and empty slab groups."""
+
+    def _packed(self, row_multiple=8, nnz=200, n_rows=24):
+        from predictionio_tpu.ops.als import build_bucketed
+
+        rng = np.random.default_rng(3)
+        rows = rng.integers(0, n_rows, nnz).astype(np.int32)
+        cols = rng.integers(0, 16, nnz).astype(np.int32)
+        vals = np.ones(nnz, np.float32)
+        return build_bucketed(
+            rows, cols, vals, n_rows, block_len=4,
+            row_multiple=row_multiple,
+        )
+
+    def test_rows_not_divisible_by_shards_raises(self):
+        from predictionio_tpu.ops.als import plan_shards
+
+        packed = self._packed(row_multiple=3)
+        with pytest.raises(ValueError, match="not divisible"):
+            plan_shards(packed, 8)
+
+    def test_one_shard_degrades_to_unsharded_layout(self):
+        """n_shards=1 must reproduce the plain Bucketed layout: the
+        device-major permutation IS inv_perm and one device owns every
+        stats row."""
+        from predictionio_tpu.ops.als import plan_shards
+
+        packed = self._packed(row_multiple=1)
+        plan = plan_shards(packed, 1)
+        assert plan.n_shards == 1
+        assert plan.c_local == packed.n_stat_rows
+        np.testing.assert_array_equal(
+            np.sort(plan.inv_perm_dm), np.sort(packed.inv_perm)
+        )
+
+    def test_empty_heavy_group_stages_clean(self, ctx8):
+        """No heavy rows: the staged side carries an empty heavy tuple
+        and the sharded train step still runs."""
+        from predictionio_tpu.ops.als import plan_shards, stage_sharded
+
+        packed = self._packed(row_multiple=8)
+        assert packed.heavy == []
+        plan = plan_shards(packed, 8)
+        assert plan.heavy is None and plan.n_heavy_slots_local == 0
+        side = stage_sharded(ctx8, packed, plan)
+        assert side.heavy == ()
+        assert side.inv.shape == (packed.n_rows_padded,)
+
+    def test_empty_interactions_stage_and_train(self, ctx8):
+        """Zero nnz: every slab row is padding, the sharded epoch still
+        executes and every factor row is an exact-zero phantom-like
+        solve (nothing observed anywhere)."""
+        f = train_als(
+            ctx8,
+            np.zeros(0, np.int32),
+            np.zeros(0, np.int32),
+            np.zeros(0, np.float32),
+            n_users=4, n_items=4, rank=2, iterations=1, block_len=4,
+            factor_sharding="sharded",
+        )
+        np.testing.assert_allclose(f.user_factors, 0.0)
+        assert f.user_factors.shape == (4, 2)
+
+
+class TestPhantomRowRegression:
+    """The phantom-row invariant end to end: padded factor rows solve
+    to exact zeros, and even a CORRUPT nonzero phantom cannot leak
+    into serving top-k (the staged mask excludes it)."""
+
+    def test_sharded_train_phantoms_exactly_zero(self, ctx42):
+        rng = np.random.default_rng(11)
+        nnz = 300
+        rows = rng.integers(0, 21, nnz).astype(np.int32)  # 21 -> pad 24
+        cols = rng.integers(0, 13, nnz).astype(np.int32)  # 13 -> pad 16
+        vals = np.ones(nnz, np.float32)
+        f = train_als(
+            ctx42, rows, cols, vals, n_users=21, n_items=13, rank=4,
+            iterations=2, block_len=4, factor_sharding="sharded",
+            return_layout="device",
+        )
+        uf = np.asarray(f.user_factors)
+        itf = np.asarray(f.item_factors)
+        assert uf.shape[0] == 24 and itf.shape[0] == 16
+        # EXACT zeros, not allclose: the padded normal equations have
+        # b = 0, so any nonzero is corrupt state, not roundoff
+        assert not uf[21:].any()
+        assert not itf[13:].any()
+
+    def test_nonzero_phantom_is_caught_centrally(self, ctx42, monkeypatch):
+        """If a solver bug ever leaves a phantom nonzero, train_als
+        refuses to return factors rather than let it reach top-k."""
+        from predictionio_tpu.ops import als as als_mod
+
+        real_solve = als_mod._solve
+
+        def corrupt_solve(a, b, cnt, yty, lam, implicit, k, dtype):
+            return real_solve(a, b, cnt, yty, lam, implicit, k, dtype) + 0.5
+
+        monkeypatch.setattr(als_mod, "_solve", corrupt_solve)
+        rows = np.asarray([0, 1, 2], np.int32)
+        cols = np.asarray([0, 1, 2], np.int32)
+        vals = np.ones(3, np.float32)
+        with pytest.raises(AssertionError, match="phantom-row"):
+            train_als(
+                ctx42, rows, cols, vals, n_users=3, n_items=3, rank=2,
+                iterations=1, block_len=4,
+            )
+
+    def test_corrupt_phantom_never_reaches_topk(self, ctx42):
+        """Serving-side belt to the trainer-side suspenders: a staged
+        catalog whose phantom row is (artificially) nonzero is still
+        masked out of every ranking."""
+        from predictionio_tpu.models.recommendation import (
+            ALSAlgorithm,
+            ALSRecModel,
+        )
+        from predictionio_tpu.utils.bimap import BiMap
+
+        n_items = 3  # pads to 4 on the model axis
+        item_f = np.zeros((n_items, 2), np.float32)
+        item_f[:] = [[0.1, 0.0], [0.2, 0.0], [0.3, 0.0]]
+        user_f = np.asarray([[-1.0, 0.0]], np.float32)  # all scores < 0
+        algo = ALSAlgorithm()
+        model = algo.stage_model(
+            ctx42,
+            ALSRecModel(
+                user_factors=user_f,
+                item_factors=item_f,
+                user_map=BiMap(["u0"]),
+                item_map=BiMap([f"i{i}" for i in range(n_items)]),
+            ),
+        )
+        # corrupt the padded row AFTER staging: phantom gets factors
+        # that would out-score every real item (dot = 0 > negatives)
+        corrupt = np.array(model.item_factors)  # writable host copy
+        assert corrupt.shape[0] == 4
+        corrupt[3] = [0.0, 5.0]
+        model = dataclasses.replace(
+            model,
+            item_factors=jax.device_put(
+                corrupt, model.item_factors.sharding
+            ),
+        )
+        qs = [{"user": "u0", "num": 3}]
+        out = algo.batch_predict_collect(
+            model, algo.batch_predict_launch(model, qs), qs
+        )
+        items = [s["item"] for s in out[0]["itemScores"]]
+        assert len(items) == 3 and set(items) == {"i0", "i1", "i2"}
+
+    def test_without_mask_the_phantom_would_leak(self, ctx42):
+        """The scenario the mask exists for: same corrupt catalog with
+        the mask stripped ranks the phantom first — proving the
+        regression test above actually bites."""
+        from predictionio_tpu.ops import similarity
+
+        item_f = np.asarray(
+            [[0.1, 0.0], [0.2, 0.0], [0.3, 0.0], [0.0, 5.0]], np.float32
+        )
+        user_f = np.asarray([[-1.0, 0.0], [0.0, 1.0]], np.float32)
+        scores, idx = similarity.gather_top_k_dot(
+            user_f, np.asarray([0], np.int32), item_f, 3
+        )
+        assert int(np.asarray(idx)[0, 0]) == 3  # phantom wins unmasked
+        scores_m, idx_m = similarity.gather_top_k_dot(
+            user_f, np.asarray([0], np.int32), item_f, 3,
+            mask=jnp.asarray([False, False, False, True]),
+        )
+        assert 3 not in np.asarray(idx_m)[0].tolist()
+
+
+class TestDeviceLayoutServing:
+    def test_unbroken_sharded_train_to_serve(self, ctx42):
+        """train_als(return_layout='device') feeds serving with zero
+        host gathers: the staged model keeps the training arrays (same
+        objects), predictions match the host-layout pipeline."""
+        from predictionio_tpu.models.recommendation import (
+            ALSAlgorithm,
+            ALSRecModel,
+        )
+        from predictionio_tpu.utils.bimap import BiMap
+
+        rng = np.random.default_rng(9)
+        nnz, n_u, n_i = 400, 30, 20
+        rows = rng.integers(0, n_u, nnz).astype(np.int32)
+        cols = rng.integers(0, n_i, nnz).astype(np.int32)
+        vals = np.ones(nnz, np.float32)
+        kwargs = dict(
+            n_users=n_u, n_items=n_i, rank=4, iterations=2, block_len=4
+        )
+        f_dev = train_als(
+            ctx42, rows, cols, vals, return_layout="device", **kwargs
+        )
+        assert isinstance(f_dev.user_factors, jax.Array)
+        assert f_dev.n_users == n_u and f_dev.n_items == n_i
+        umap = BiMap([f"u{i}" for i in range(n_u)])
+        imap = BiMap([f"i{i}" for i in range(n_i)])
+        algo = ALSAlgorithm()
+        staged = algo.stage_model(
+            ctx42,
+            ALSRecModel(
+                user_factors=f_dev.user_factors,
+                item_factors=f_dev.item_factors,
+                user_map=umap,
+                item_map=imap,
+            ),
+        )
+        # the training arrays ARE the serving arrays — no host gather
+        assert staged.user_factors is f_dev.user_factors
+        assert staged.item_factors is f_dev.item_factors
+        assert staged.item_phantom_mask is not None
+
+        f_host = train_als(ctx42, rows, cols, vals, **kwargs)
+        host_model = algo.stage_model(
+            ctx42,
+            ALSRecModel(
+                user_factors=f_host.user_factors,
+                item_factors=f_host.item_factors,
+                user_map=umap,
+                item_map=imap,
+            ),
+        )
+        qs = [{"user": f"u{i}", "num": 5} for i in (0, 7, 19)]
+        dev_out = algo.batch_predict_collect(
+            staged, algo.batch_predict_launch(staged, qs), qs
+        )
+        host_out = algo.batch_predict_collect(
+            host_model, algo.batch_predict_launch(host_model, qs), qs
+        )
+        assert [
+            [s["item"] for s in o["itemScores"]] for o in dev_out
+        ] == [[s["item"] for s in o["itemScores"]] for o in host_out]
+
+
+class TestReviewRegressionsPR14:
+    def test_data_parallel_padded_factors_still_masked(self, ctx8):
+        """Device-layout factors are padded on data-parallel meshes
+        too (row_multiple = data_parallelism); the phantom mask must
+        key on 'rows > real', never on the mesh having a model axis —
+        unmasked, a zero phantom out-scores all-negative real items
+        and serving would decode a ghost index."""
+        from predictionio_tpu.models.recommendation import (
+            ALSAlgorithm,
+            ALSRecModel,
+        )
+        from predictionio_tpu.utils.bimap import BiMap
+
+        n_u, n_i = 9, 13  # both pad to multiples of 8 on the 8x1 mesh
+        rng = np.random.default_rng(2)
+        rows = rng.integers(0, n_u, 200).astype(np.int32)
+        cols = rng.integers(0, n_i, 200).astype(np.int32)
+        f = train_als(
+            ctx8, rows, cols, np.ones(200, np.float32),
+            n_users=n_u, n_items=n_i, rank=4, iterations=2, block_len=4,
+            return_layout="device",
+        )
+        assert f.item_factors.shape[0] == 16  # padded
+        algo = ALSAlgorithm()
+        staged = algo.stage_model(
+            ctx8,
+            ALSRecModel(
+                user_factors=f.user_factors,
+                item_factors=f.item_factors,
+                user_map=BiMap([f"u{i}" for i in range(n_u)]),
+                item_map=BiMap([f"i{i}" for i in range(n_i)]),
+            ),
+        )
+        assert staged.item_phantom_mask is not None
+        assert np.asarray(staged.item_phantom_mask).sum() == 3
+        qs = [{"user": "u0", "num": 13}]
+        out = algo.batch_predict_collect(
+            staged, algo.batch_predict_launch(staged, qs), qs
+        )
+        items = {s["item"] for s in out[0]["itemScores"]}
+        assert len(out[0]["itemScores"]) == 13
+        assert items == {f"i{i}" for i in range(n_i)}  # no ghosts
+
+    def test_resume_complete_honors_device_layout(self, ctx8, tmp_path):
+        """A resume that lands at the full iteration count must still
+        return the documented device layout (padded, device-resident),
+        not silently fall back to host numpy."""
+        rows = np.asarray([0, 1, 2], np.int32)
+        cols = np.asarray([0, 1, 2], np.int32)
+        vals = np.ones(3, np.float32)
+        kwargs = dict(
+            n_users=3, n_items=3, rank=2, block_len=4,
+            checkpoint_dir=str(tmp_path), checkpoint_every=2,
+        )
+        train_als(ctx8, rows, cols, vals, iterations=4, **kwargs)
+        f = train_als(
+            ctx8, rows, cols, vals, iterations=2, resume=True,
+            return_layout="device", **kwargs,
+        )
+        assert isinstance(f.user_factors, jax.Array)
+        assert isinstance(f.item_factors, jax.Array)
+        assert f.user_factors.shape[0] == 8  # padded to the mesh
+        assert f.n_users == 3

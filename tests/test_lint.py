@@ -864,6 +864,45 @@ class TestShardingSpec:
         )
         assert "sharding-spec" not in rules_of(findings)
 
+    def test_partition_rule_table_bad_axis_flagged(self):
+        """A match_partition_rules-style rule table whose spec names a
+        nonexistent mesh axis is caught statically — the regex engine
+        (parallel/partition.py) would only catch it at staging time."""
+        findings = lint_source(
+            """
+            from jax.sharding import PartitionSpec as P
+
+            from mesh import DATA_AXIS
+
+            ALS_RULES = (
+                (r"(user|item)_factors$", P("modle", None)),
+                (r"idx$", P(DATA_AXIS)),
+            )
+            """,
+            path="rules.py",
+            extra={"mesh.py": MESH_MODULE},
+        )
+        flagged = [f for f in findings if f.rule == "sharding-spec"]
+        assert len(flagged) == 1
+        assert "'modle'" in flagged[0].message
+
+    def test_partition_rule_table_known_axes_clean(self):
+        findings = lint_source(
+            """
+            from jax.sharding import PartitionSpec as P
+
+            from mesh import DATA_AXIS, MODEL_AXIS
+
+            ALS_RULES = (
+                (r"(user|item)_factors$", P(MODEL_AXIS, None)),
+                (r"idx$", P((DATA_AXIS, MODEL_AXIS), None)),
+            )
+            """,
+            path="rules.py",
+            extra={"mesh.py": MESH_MODULE},
+        )
+        assert "sharding-spec" not in rules_of(findings)
+
     def test_no_mesh_anywhere_skips_axis_check(self):
         findings = lint_source(
             """
