@@ -246,10 +246,46 @@ def _fleet_summary_line(status: dict) -> str:
     return " ".join(parts)
 
 
+def _fmt_bytes(n: float) -> str:
+    for unit, div in (("GiB", 2**30), ("MiB", 2**20), ("KiB", 2**10)):
+        if n >= div:
+            return f"{n / div:.2f}{unit}"
+    return f"{n:.0f}B"
+
+
+def _fleet_health_line(health) -> str | None:
+    """One-line fleet-health summary from the router's federated
+    ``fleetHealth`` status block: goodput, worst-class SLO burn, and
+    per-replica HBM headroom (or RSS where the backend exports no
+    memory stats) — printed beside the swap/autoscaler summary."""
+    if not isinstance(health, dict):
+        return None
+    parts = [
+        f"health: goodput={health.get('goodputQps', 0.0)}qps",
+        f"burn={health.get('burnRate', 0.0)}",
+    ]
+    for rid, entry in sorted((health.get("replicas") or {}).items()):
+        if not isinstance(entry, dict):
+            continue
+        bits = []
+        if "hbmHeadroomBytes" in entry:
+            bits.append(
+                f"hbmFree={_fmt_bytes(entry['hbmHeadroomBytes'])}"
+            )
+        elif "residentBytes" in entry:
+            bits.append(f"rss={_fmt_bytes(entry['residentBytes'])}")
+        if entry.get("stale"):
+            bits.append("stale")
+        if bits:
+            parts.append(f"{rid}[{' '.join(bits)}]")
+    return " ".join(parts)
+
+
 def _print_router_status(url: str, access_key: str = "") -> int:
-    """``status --router-url``: the fleet summary line from the
-    router's own status route, then its metrics scrape (which carries
-    the model-lifecycle line when the router exports those gauges)."""
+    """``status --router-url``: the fleet summary + fleet-health lines
+    from the router's own status route, then its federated metrics
+    scrape (which carries the model-lifecycle line when the fleet
+    exports those gauges)."""
     status = _fetch_json(url.rstrip("/") + "/", access_key=access_key)
     if status is None:
         return 1
@@ -261,37 +297,61 @@ def _print_router_status(url: str, access_key: str = "") -> int:
         )
         return 1
     print(_fleet_summary_line(status))
+    health = _fleet_health_line(status.get("fleetHealth"))
+    if health:
+        print(health)
     return _print_metrics(url, access_key=access_key)
+
+
+def _print_families(data: dict) -> None:
+    for name in sorted(data):
+        family = data[name]
+        for sample in family["samples"]:
+            label = ",".join(
+                f"{k}={v}" for k, v in sample["labels"].items()
+            )
+            label = f"{{{label}}}" if label else ""
+            if family["type"] == "histogram":
+                print(
+                    f"{name}{label} count={sample['count']} "
+                    f"p50={sample['p50']} p95={sample['p95']} "
+                    f"p99={sample['p99']}"
+                )
+            else:
+                print(f"{name}{label} {sample['value']}")
 
 
 def _print_metrics(url: str, access_key: str = "") -> int:
     """Scrape a live server's ``/metrics.json`` and print a per-metric
     one-liner (histograms with derived p50/p95/p99), led by a model-
     lifecycle summary (generation / age / last-train / canary) when the
-    server exposes those gauges."""
+    server exposes those gauges. A router answers the FEDERATED shape
+    (fleet-merged counters/histograms + its own registry), printed with
+    a federation header line instead."""
     target = url.rstrip("/") + "/metrics.json"
     data = _fetch_json(target, access_key=access_key)
     if data is None:
         return 1
     try:
+        if (
+            isinstance(data, dict)
+            and isinstance(data.get("federation"), dict)
+            and "fleet" in data
+        ):
+            fed = data["federation"]
+            replicas = ",".join(fed.get("replicas") or []) or "none"
+            line = f"federation: replicas={replicas}"
+            stale = fed.get("stale") or []
+            if stale:
+                line += " stale=" + ",".join(stale)
+            print(line)
+            _print_families(data.get("fleet") or {})
+            _print_families(data.get("local") or {})
+            return 0
         summary = _model_summary_line(data)
         if summary:
             print(summary)
-        for name in sorted(data):
-            family = data[name]
-            for sample in family["samples"]:
-                label = ",".join(
-                    f"{k}={v}" for k, v in sample["labels"].items()
-                )
-                label = f"{{{label}}}" if label else ""
-                if family["type"] == "histogram":
-                    print(
-                        f"{name}{label} count={sample['count']} "
-                        f"p50={sample['p50']} p95={sample['p95']} "
-                        f"p99={sample['p99']}"
-                    )
-                else:
-                    print(f"{name}{label} {sample['value']}")
+        _print_families(data)
     except (AttributeError, KeyError, TypeError) as e:
         print(
             f"[ERROR] {redact_keys(target)} is not a pio metrics.json "
@@ -389,6 +449,95 @@ def cmd_trace(args) -> int:
     print(f"Wrote {summary} to {args.out}")
     if not args.raw:
         print("Open it at https://ui.perfetto.dev (or chrome://tracing).")
+    return 0
+
+
+def _safe_extract(tar, dest: str) -> None:
+    """Extract refusing path-traversing members (absolute paths,
+    ``..``) — the server is trusted, the archive format is not."""
+    try:
+        tar.extractall(dest, filter="data")
+        return
+    except TypeError:
+        pass  # Python without the tarfile filter API
+    base = os.path.realpath(dest)
+    for member in tar.getmembers():
+        target = os.path.realpath(os.path.join(dest, member.name))
+        if target != base and not target.startswith(base + os.sep):
+            raise ValueError(f"unsafe tar member: {member.name}")
+    tar.extractall(dest)
+
+
+def cmd_profile(args) -> int:
+    """Trigger an on-demand profile capture on a live engine server
+    and pull the artifact locally (``pio-tpu profile --url
+    http://host:8000 --out ./prof``): ``POST /debug/profile`` runs a
+    duration-bounded jax.profiler window plus a flight-recorder/device
+    snapshot of the same window, and the response's tar.gz bundle is
+    extracted under ``--out``. Pure HTTP — never imports jax."""
+    import base64
+    import io
+    import tarfile
+    import urllib.request
+
+    target = args.url.rstrip("/") + "/debug/profile"
+    req = urllib.request.Request(
+        target,
+        data=json.dumps({"durationMs": args.duration_ms}).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    if args.access_key:
+        req.add_header("X-PIO-Server-Key", args.access_key)
+    try:
+        timeout = max(30.0, args.duration_ms / 1000.0 + 30.0)
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            data = json.load(resp)
+    except (OSError, ValueError) as e:
+        print(
+            f"[ERROR] cannot fetch {redact_keys(target)}: {e}",
+            file=sys.stderr,
+        )
+        return 1
+    if (
+        not isinstance(data, dict)
+        or not data.get("bundle")
+        or not isinstance(data.get("profile"), dict)
+    ):
+        print(
+            f"[ERROR] {redact_keys(target)} did not answer a profile "
+            "bundle",
+            file=sys.stderr,
+        )
+        return 1
+    try:
+        raw = base64.b64decode(data["bundle"])
+    except (TypeError, ValueError):
+        print(
+            "[ERROR] profile bundle is not valid base64",
+            file=sys.stderr,
+        )
+        return 1
+    try:
+        os.makedirs(args.out, exist_ok=True)
+        with tarfile.open(fileobj=io.BytesIO(raw), mode="r:gz") as tar:
+            _safe_extract(tar, args.out)
+    except (OSError, ValueError, tarfile.TarError) as e:
+        print(
+            f"[ERROR] cannot extract profile bundle: {e}",
+            file=sys.stderr,
+        )
+        return 1
+    manifest = data["profile"]
+    dest = os.path.join(args.out, f"profile-{manifest.get('id')}")
+    print(
+        f"Wrote profile artifact {manifest.get('id')} "
+        f"({manifest.get('durationS')}s window) to {dest}"
+    )
+    print(
+        "spans.json opens at https://ui.perfetto.dev; "
+        "jax_trace/ loads in TensorBoard."
+    )
     return 0
 
 
@@ -1637,6 +1786,28 @@ def build_parser() -> argparse.ArgumentParser:
         help="server access key (servers that key-auth every route)",
     )
     p.set_defaults(func=cmd_trace)
+
+    p = sub.add_parser("profile")
+    p.add_argument(
+        "--url", required=True,
+        help="base URL of a live engine server",
+    )
+    p.add_argument(
+        "--out", default="profile",
+        help="directory the profile artifact extracts into "
+             "(default: ./profile)",
+    )
+    p.add_argument(
+        "--duration-ms", dest="duration_ms", type=float, default=1000.0,
+        help="capture window in milliseconds (server-clamped; "
+             "default: 1000)",
+    )
+    p.add_argument(
+        "--access-key", dest="access_key", default="",
+        help="server access key (/debug/profile is key-gated when "
+             "the server has one configured)",
+    )
+    p.set_defaults(func=cmd_profile)
 
     p = sub.add_parser("lint")
     p.add_argument(

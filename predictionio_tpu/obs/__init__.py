@@ -19,6 +19,13 @@ from predictionio_tpu.obs.context import (
     new_request_id,
     set_request_id,
 )
+from predictionio_tpu.obs.device import CompileTracker, DeviceSampler
+from predictionio_tpu.obs.federation import (
+    combine_families,
+    counter_total,
+    merge_payloads,
+    render_prometheus_families,
+)
 from predictionio_tpu.obs.registry import (
     Counter,
     Gauge,
@@ -28,6 +35,7 @@ from predictionio_tpu.obs.registry import (
     TRAIN_STEP_BUCKETS,
     get_registry,
 )
+from predictionio_tpu.obs.slo import Objective, SLOMonitor
 from predictionio_tpu.obs.tracing import (
     Span,
     Tracer,
@@ -37,19 +45,27 @@ from predictionio_tpu.obs.tracing import (
 )
 
 __all__ = [
+    "CompileTracker",
     "Counter",
+    "DeviceSampler",
     "Gauge",
     "Histogram",
     "LATENCY_BUCKETS",
     "MetricRegistry",
+    "Objective",
+    "SLOMonitor",
     "Span",
     "TRAIN_STEP_BUCKETS",
     "Tracer",
+    "combine_families",
+    "counter_total",
     "current_span",
     "get_registry",
     "get_request_id",
     "get_tracer",
+    "merge_payloads",
     "new_request_id",
+    "render_prometheus_families",
     "set_request_id",
     "span",
 ]

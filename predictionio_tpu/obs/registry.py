@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import bisect
 import math
+import os
 import threading
 import time
 from typing import Callable, Iterable
@@ -242,12 +243,16 @@ class _HistogramChild:
         # percentiles derive from the SAME copied counts — computing
         # them from live state could disagree with count/buckets when
         # a scrape races an observe()
+        buckets = {_fmt(b): c for b, c in zip(self._bounds, counts)}
+        # the overflow bucket travels explicitly so two snapshots can
+        # be merged bucket-wise (fleet federation) without deriving it
+        # as count - sum(buckets) — backward-compatible: finite-bound
+        # readers (render_prometheus) never look the key up
+        buckets["+Inf"] = counts[len(self._bounds)]
         return {
             "count": total,
             "sum": round(s, 6),
-            "buckets": {
-                _fmt(b): c for b, c in zip(self._bounds, counts)
-            },
+            "buckets": buckets,
             "p50": _nan_none(_quantile(self._bounds, counts, total, 0.50)),
             "p95": _nan_none(_quantile(self._bounds, counts, total, 0.95)),
             "p99": _nan_none(_quantile(self._bounds, counts, total, 0.99)),
@@ -452,6 +457,17 @@ class MetricRegistry:
 _PROCESS_START_TIME = time.time()  # pio-lint: disable=wall-clock -- Prometheus semantics: epoch, consumed off-host
 
 
+def _read_resident_bytes() -> float:
+    """RSS from ``/proc/self/statm`` (field 2, in pages)."""
+    with open("/proc/self/statm", "rb") as f:
+        pages = int(f.read().split()[1])
+    return float(pages * os.sysconf("SC_PAGE_SIZE"))
+
+
+def _count_open_fds() -> float:
+    return float(len(os.listdir("/proc/self/fd")))
+
+
 def _install_process_metrics(registry: MetricRegistry) -> None:
     """Deploy-correlation gauges on the default registry:
     ``pio_build_info{version=...} 1`` identifies which build answered a
@@ -469,6 +485,19 @@ def _install_process_metrics(registry: MetricRegistry) -> None:
         "pio_process_start_time_seconds",
         "Unix time this process's telemetry started",
     ).set(_PROCESS_START_TIME)
+    # self-telemetry: resident set + open fds, read at scrape time from
+    # /proc so replica memory/fd creep is visible before the OOM killer
+    # (or EMFILE) sees it. Registered only where /proc exists — off
+    # Linux the families are simply absent, not NaN noise.
+    if os.path.isdir("/proc/self"):
+        registry.gauge(
+            "pio_process_resident_bytes",
+            "Resident set size of this process (/proc/self/statm)",
+        ).set_function(_read_resident_bytes)
+        registry.gauge(
+            "pio_process_open_fds",
+            "Open file descriptors of this process (/proc/self/fd)",
+        ).set_function(_count_open_fds)
 
 
 _default_registry = MetricRegistry()

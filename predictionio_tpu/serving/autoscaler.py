@@ -86,6 +86,11 @@ class AutoscalerConfig:
     low_inflight_per_replica: float = 0.5
     #: consecutive underutilized ticks before one replica retires
     shrink_after_ticks: int = 10
+    #: fleet SLO burn rate (worst class, short window) at or above
+    #: which the pool is under pressure — an SLO on fire wants
+    #: replicas even before anything sheds (1.0 = burning exactly at
+    #: budget; 2.0 = the budget halves early)
+    burn_threshold: float = 2.0
 
     @staticmethod
     def from_env() -> "AutoscalerConfig":
@@ -123,6 +128,12 @@ class AutoscalerConfig:
                     _env_float(
                         "PIO_AUTOSCALE_SHRINK_TICKS", d.shrink_after_ticks
                     )
+                ),
+            ),
+            burn_threshold=max(
+                0.1,
+                _env_float(
+                    "PIO_AUTOSCALE_BURN_THRESHOLD", d.burn_threshold
                 ),
             ),
         )
@@ -400,9 +411,17 @@ class ReplicaAutoscaler:
                 return self._grow(signals)
             return "idle"
 
-        pressure = shed_delta > 0 or (
-            healthy > 0
-            and signals["saturated"] / healthy >= cfg.saturation_fraction
+        burn_rate = float(signals.get("burnRate", 0.0) or 0.0)
+        pressure = (
+            shed_delta > 0
+            or (
+                healthy > 0
+                and signals["saturated"] / healthy
+                >= cfg.saturation_fraction
+            )
+            # SLO burn is the leading indicator: the fleet can be
+            # failing its latency objective before any replica sheds
+            or burn_rate >= cfg.burn_threshold
         )
         if pressure:
             self._low_ticks = 0
@@ -414,6 +433,7 @@ class ReplicaAutoscaler:
                     logger, logging.INFO, "autoscaler_target_up",
                     target=self.target, shedDelta=shed_delta,
                     saturated=signals["saturated"], healthy=healthy,
+                    burnRate=burn_rate,
                 )
         elif (
             healthy > 0

@@ -24,11 +24,17 @@ class Dashboard:
         self,
         storage: Storage | None = None,
         registry: MetricRegistry | None = None,
+        server_config=None,
     ):
         self._storage = storage or get_storage()
         self.registry = registry if registry is not None else get_registry()
         self.router = Router()
-        install_metrics_routes(self.router, self.registry)
+        # server_config key-gates /debug/traces like every other server
+        # mounting the telemetry seam — the dashboard was the one
+        # surface handing per-request traces to anonymous clients
+        install_metrics_routes(
+            self.router, self.registry, server_config=server_config
+        )
         self.router.route("GET", "/", self._index)
         self.router.route("GET", "/engine_instances/<iid>", self._detail)
 
@@ -81,7 +87,9 @@ def create_dashboard(
 
     if server_config is None:
         server_config = ServerConfig.from_env()
-    dashboard = Dashboard(storage, registry=registry)
+    dashboard = Dashboard(
+        storage, registry=registry, server_config=server_config
+    )
     return HTTPServer(
         dashboard.router,
         host=host,

@@ -31,6 +31,7 @@ code.
 
 from __future__ import annotations
 
+import base64
 import dataclasses
 import datetime as _dt
 import html as _html
@@ -55,6 +56,7 @@ from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage import Storage, get_storage
 from predictionio_tpu.obs import MetricRegistry, get_registry
 from predictionio_tpu.obs import tracing
+from predictionio_tpu.obs.device import CompileTracker, DeviceSampler
 from predictionio_tpu.parallel.mesh import ComputeContext
 from predictionio_tpu.serving import admission as admission_mod
 from predictionio_tpu.serving import canary as canary_mod
@@ -214,6 +216,16 @@ class EngineServer:
             "Seconds since the serving generation finished training "
             "(freshness of the model users are hitting)",
         ).set_function(self._model_age_seconds)
+        # device runtime telemetry (docs/observability.md "Device
+        # telemetry"): HBM/live-array sampler started by serve(), and
+        # compile counters the warmup path records into. CPU backends
+        # without memory stats degrade to a clean no-op.
+        self._device_sampler = DeviceSampler(self._registry)
+        self._compile_tracker = CompileTracker(self._registry)
+        #: one profile capture at a time (jax.profiler is process-
+        #: global) — guarded by self._lock, never held across the
+        #: capture window itself
+        self._profile_active = False
         self._batchers: list[MicroBatcher] = []
         self._load()
 
@@ -226,6 +238,7 @@ class EngineServer:
         self.router.route("POST", "/reload", self._reload)
         self.router.route("GET", "/canary", self._canary_status)
         self.router.route("POST", "/stop", self._stop)
+        self.router.route("POST", "/debug/profile", self._debug_profile)
         install_metrics_routes(
             self.router, self._registry, self._tracer,
             server_config=self._server_config,
@@ -426,9 +439,17 @@ class EngineServer:
                     bucket_gauge.labels(batcher_name, str(bucket)).set(
                         time.perf_counter() - b0
                     )
+                    self._compile_tracker.record(
+                        batcher_name, str(bucket)
+                    )
                 except Exception as e:  # noqa: BLE001 - warmup best-effort
                     bucket_gauge.labels(batcher_name, str(bucket)).set(
                         time.perf_counter() - b0
+                    )
+                    # a failed compile still burned a trace attempt —
+                    # shape-churn accounting counts it
+                    self._compile_tracker.record(
+                        batcher_name, str(bucket)
                     )
                     failures += 1
                     if compiled == 0:
@@ -1272,6 +1293,52 @@ class EngineServer:
             ).start()
         return Response(200, {"message": "stopping"})
 
+    def _debug_profile(self, request: Request) -> Response:
+        """Key-gated on-demand profile capture (docs/observability.md
+        "Profile capture"): run a duration-bounded jax.profiler trace
+        plus a flight-recorder/device snapshot of the same window and
+        return the whole artifact as a base64 tar.gz — one at a time
+        (jax.profiler is process-global), 409 on overlap."""
+        self._server_config.check_key(request)
+        body = request.json() if request.body else {}
+        if body is None:
+            body = {}
+        if not isinstance(body, dict):
+            raise HTTPError(400, "body must be a JSON object")
+        max_ms = max(
+            50.0, resilience._env_float("PIO_PROFILE_MAX_MS", 30000.0)
+        )
+        try:
+            duration_ms = float(body.get("durationMs", 1000.0))
+        except (TypeError, ValueError):
+            raise HTTPError(400, "durationMs must be a number")
+        duration_ms = min(max_ms, max(50.0, duration_ms))
+        with self._lock:
+            # flag, not a held lock: the capture window sleeps for
+            # durationMs and must not block status/metrics readers
+            if self._profile_active:
+                raise HTTPError(
+                    409, "a profile capture is already running"
+                )
+            self._profile_active = True
+        try:
+            manifest = profiling.capture(
+                duration_ms / 1000.0,
+                tracer=self._tracer,
+                device_sample_fn=self._device_sampler.sample_once,
+            )
+            bundle = profiling.bundle(manifest["artifactDir"])
+        finally:
+            with self._lock:
+                self._profile_active = False
+        return Response(
+            200,
+            {
+                "profile": manifest,
+                "bundle": base64.b64encode(bundle).decode("ascii"),
+            },
+        )
+
     # -- lifecycle --------------------------------------------------------
     def serve(
         self,
@@ -1307,6 +1374,7 @@ class EngineServer:
                 # close() the batchers so the current device batch
                 # completes before the process exits
                 self._http.add_drain_hook(self.close)
+                self._device_sampler.start()
                 return self._http
             except OSError as exc:
                 last_exc = exc
@@ -1343,6 +1411,7 @@ class EngineServer:
                     b.close()
         for b in batchers:
             b.close()
+        self._device_sampler.stop()
         self._plugins.close()
         if self._log_queue is not None:
             # stop the sender so a retired server (and its staged

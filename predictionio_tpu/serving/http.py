@@ -24,6 +24,7 @@ from urllib.parse import parse_qs, urlparse
 
 from predictionio_tpu.obs import MetricRegistry, set_request_id
 from predictionio_tpu.obs import tracing
+from predictionio_tpu.obs.slo import SLOMonitor
 from predictionio_tpu.obs.context import log_json, redact_keys
 from predictionio_tpu.serving import admission, resilience
 
@@ -180,6 +181,7 @@ def install_metrics_routes(
     registry: MetricRegistry,
     tracer: tracing.Tracer | None = None,
     server_config=None,
+    federation=None,
 ) -> None:
     """The common telemetry surface every server mounts: Prometheus
     text at ``GET /metrics``, the same registry as JSON at
@@ -193,18 +195,34 @@ def install_metrics_routes(
     IDs, store hosts, per-hop latencies — which servers whose HTTP
     layer is otherwise open (event server, engine server) must not
     hand to anonymous clients once an operator configured a key.
-    ``/metrics`` stays as open as the server itself: aggregates only."""
+    ``/metrics`` stays as open as the server itself: aggregates only.
+
+    ``federation`` (an object with ``render_text()`` / ``to_dict()``,
+    e.g. the serving router's fleet federation) replaces both metrics
+    bodies with the fleet-wide view: every replica's series re-labeled
+    ``replica=...`` plus exactly merged fleet counters/histograms —
+    one scrape sees the whole fleet (docs/observability.md)."""
     tracer = tracer if tracer is not None else tracing.get_tracer()
 
     def _metrics(request: Request) -> Response:
+        body = (
+            federation.render_text()
+            if federation is not None
+            else registry.render_prometheus()
+        )
         return Response(
             200,
-            registry.render_prometheus(),
+            body,
             content_type="text/plain; version=0.0.4; charset=utf-8",
         )
 
     def _metrics_json(request: Request) -> Response:
-        return Response(200, registry.to_dict())
+        body = (
+            federation.to_dict()
+            if federation is not None
+            else registry.to_dict()
+        )
+        return Response(200, body)
 
     def _traces(request: Request) -> Response:
         if server_config is not None:
@@ -251,6 +269,7 @@ class HTTPServer:
         service: str = "http",
         registry: MetricRegistry | None = None,
         tracer: tracing.Tracer | None = None,
+        slo=None,
     ):
         """``server_config`` (a
         :class:`~predictionio_tpu.serving.config.ServerConfig`) adds the
@@ -313,6 +332,18 @@ class HTTPServer:
             )
         else:
             requests_total = request_seconds = rejected_total = None
+        # SLO scoring rides the same telemetry tail: slo=None auto-
+        # creates a monitor on the registry (env-configured
+        # objectives), slo=False disables it (the router scores fleet
+        # traffic from federated counters instead — scoring its own
+        # proxy hops too would double-count every request), and an
+        # explicit SLOMonitor is shared (tests, embedding servers)
+        if slo is False or registry is None:
+            slo_ref = None
+        elif slo is not None:
+            slo_ref = slo
+        else:
+            slo_ref = SLOMonitor(registry)
 
         class _Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -648,6 +679,12 @@ class HTTPServer:
                     request_seconds.labels(service, route).observe(
                         elapsed
                     )
+                if slo_ref is not None and not telemetry_path:
+                    # scrapes and debug pulls are operator traffic,
+                    # not served load — they never burn the budget
+                    slo_ref.observe(
+                        request.criticality, response.status, elapsed
+                    )
                 log_json(
                     access_logger,
                     logging.WARNING if response.status >= 500
@@ -726,6 +763,9 @@ class HTTPServer:
         self._service = service
         self._drain_hooks: list[Callable[[], None]] = []
         self.router = router
+        #: the per-server SLO monitor (None when disabled) — exposed
+        #: so tests and status endpoints can read burn rates directly
+        self.slo = slo_ref
 
     @property
     def port(self) -> int:

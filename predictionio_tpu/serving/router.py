@@ -72,12 +72,16 @@ import time
 import urllib.error
 import urllib.request
 import uuid
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Iterable
 
 from predictionio_tpu.obs import MetricRegistry, get_registry
+from predictionio_tpu.obs import federation as federation_mod
 from predictionio_tpu.obs import tracing
 from predictionio_tpu.obs.context import log_json
+from predictionio_tpu.obs.slo import SLOMonitor
 from predictionio_tpu.serving import admission, resilience
+from predictionio_tpu.serving.resilience import _env_float
 from predictionio_tpu.serving import canary as canary_mod
 from predictionio_tpu.serving.http import (
     HTTPError,
@@ -239,6 +243,12 @@ class Replica:
         self._inflight = 0
         self.probe_failures = 0
         self.last_probe: str = "never"
+        #: last successful ``/metrics.json`` scrape, kept across probe
+        #: failures: fleet federation serves a dead replica's final
+        #: snapshot marked ``pio_federation_stale`` instead of letting
+        #: one SIGKILLed process fail the whole fleet scrape
+        self._metrics_snapshot: dict = {}
+        self._metrics_stale = True
         # NOT the process-global get_breaker map: two routers (or a
         # test building many) must not share breaker state for
         # same-named targets
@@ -284,6 +294,23 @@ class Replica:
     def saturation_remaining_s(self) -> float:
         return max(0.0, self.saturated_until - time.monotonic())
 
+    def store_metrics(self, payload: dict) -> None:
+        """A fresh ``/metrics.json`` scrape landed (prober or
+        federation fan-out)."""
+        with self._lock:
+            self._metrics_snapshot = payload
+            self._metrics_stale = False
+
+    def mark_metrics_stale(self) -> None:
+        with self._lock:
+            self._metrics_stale = True
+
+    def metrics_state(self) -> tuple[dict, bool]:
+        """``(last snapshot, stale?)`` — snapshot is ``{}`` until the
+        first successful scrape."""
+        with self._lock:
+            return self._metrics_snapshot, self._metrics_stale
+
     def to_dict(self) -> dict:
         return {
             "id": self.replica_id,
@@ -311,6 +338,38 @@ def _metric_sample(data: dict, name: str, **labels) -> float | None:
     except (AttributeError, TypeError, ValueError):
         return None
     return None
+
+
+def _sum_samples(data: dict, name: str) -> float | None:
+    """Sum every sample of a family in a ``/metrics.json`` payload
+    (e.g. HBM bytes across a replica's devices); None when absent."""
+    try:
+        samples = data.get(name, {}).get("samples", ())
+    except AttributeError:
+        return None
+    total, seen = 0.0, False
+    for sample in samples:
+        try:
+            total += float(sample.get("value", sample.get("count")))
+            seen = True
+        except (AttributeError, TypeError, ValueError):
+            continue
+    return total if seen else None
+
+
+class _FleetFederation:
+    """The scrape surface handed to ``install_metrics_routes``: each
+    ``GET /metrics[.json]`` on the router fans out to the live fleet
+    and re-renders it as one exposition."""
+
+    def __init__(self, router: "ServingRouter"):
+        self._router = router
+
+    def render_text(self) -> str:
+        return self._router.federated_text()
+
+    def to_dict(self) -> dict:
+        return self._router.federated_dict()
 
 
 class ServingRouter:
@@ -436,6 +495,49 @@ class ServingRouter:
             "— no replica budget burned)",
         )
 
+        # -- fleet federation state (docs/observability.md) --
+        self._federation_timeout_s = max(
+            0.05,
+            _env_float("PIO_FEDERATION_TIMEOUT_MS", 1000.0) / 1000.0,
+        )
+        self._federation_concurrency = max(
+            1, int(_env_float("PIO_FEDERATION_CONCURRENCY", 8))
+        )
+        #: guards goodput anchor + per-replica SLO counter watermarks
+        self._fed_lock = threading.Lock()
+        #: replica id -> {(class, outcome): last counter value} —
+        #: watermarks so probe rounds and federation scrapes feed each
+        #: request into the fleet SLO exactly once
+        self._slo_seen: dict[str, dict[tuple, float]] = {}
+        self._goodput_anchor: tuple[float, float] | None = None
+        self._goodput_qps = 0.0
+        #: fleet-level SLO from federated counter deltas; no local
+        #: pio_slo_requests_total export — the fleet totals live in the
+        #: merged view, a router-side copy would double-count
+        self._fleet_slo = SLOMonitor(
+            self._registry, export_counter=False
+        )
+        self._stale_gauge = self._registry.gauge(
+            "pio_federation_stale",
+            "1 while the replica's federated series come from its "
+            "last snapshot instead of a live scrape",
+            ("replica",),
+        )
+        self._goodput_gauge = self._registry.gauge(
+            "pio_fleet_goodput_qps",
+            "Fleet-wide good (SLO-passing) requests per second, from "
+            "federated pio_slo_requests_total deltas",
+        )
+        fleet_replicas = self._registry.gauge(
+            "pio_fleet_replicas",
+            "Replicas known to the router, by lifecycle state",
+            ("state",),
+        )
+        for st in (WARMING, HEALTHY, DRAINING, UNHEALTHY):
+            fleet_replicas.labels(st).set_function(
+                lambda s=st: float(self._count_state(s))
+            )
+
         for replica in replicas:
             self._install(replica)
         self._adopt_state()
@@ -454,6 +556,7 @@ class ServingRouter:
         install_metrics_routes(
             self.router, self._registry, self._tracer,
             server_config=self._server_config,
+            federation=_FleetFederation(self),
         )
         self._http: HTTPServer | None = None
         self._prober = threading.Thread(
@@ -616,6 +719,9 @@ class ServingRouter:
     def autoscaler_signals(self) -> dict:
         """The signal bundle the replica autoscaler reconciles on —
         nothing the stack does not already export."""
+        # fleet SLO burn (its own lock) resolves before taking the
+        # replica lock: scale-up must trigger on burn, not just sheds
+        burn_rate = self._fleet_slo.max_burn_rate()
         with self._lock:
             pool = [
                 r for r in self._replicas.values() if r.state != RETIRED
@@ -644,6 +750,10 @@ class ServingRouter:
                 "saturated": sum(1 for r in healthy if r.saturated),
                 "shedTotal": self._shed_count,
                 "swapActive": swap_active,
+                # worst-class short-window burn from the fleet SLO
+                # monitor — an SLO on fire wants replicas even while
+                # nothing sheds yet
+                "burnRate": round(burn_rate, 4),
                 # the INFERRED generation: a fleet that never ran a
                 # gated swap has no explicit one, and the autoscaler
                 # substitutes this into the spawn template — "" would
@@ -895,9 +1005,18 @@ class ServingRouter:
                 draining = draining or (
                     drain_v is not None and drain_v >= 1.0
                 )
+                if isinstance(metrics, dict):
+                    # every probe doubles as a federation refresh:
+                    # snapshot for stale-tolerant scrapes, SLO counter
+                    # deltas into the fleet burn monitor
+                    replica.store_metrics(metrics)
+                    self._ingest_replica_slo(
+                        replica.replica_id, metrics
+                    )
         except (OSError, ValueError):
             replica.probe_failures += 1
             replica.last_probe = "unreachable"
+            replica.mark_metrics_stale()
             if (
                 replica.probe_failures >= self._unhealthy_after
                 and replica.state in (HEALTHY, DRAINING)
@@ -1900,6 +2019,189 @@ class ServingRouter:
             raise RuntimeError(f"staged replica answered HTTP {status}")
         return canary_mod.strip_volatile(json.loads(payload))
 
+    # -- fleet federation --------------------------------------------------
+    def _count_state(self, state: str) -> int:
+        with self._lock:
+            return sum(
+                1 for r in self._replicas.values() if r.state == state
+            )
+
+    def _ingest_replica_slo(self, rid: str, payload: dict) -> None:
+        """Feed one replica's ``pio_slo_requests_total`` deltas into
+        the fleet SLO monitor — watermarked per replica so overlapping
+        probe rounds and federation scrapes count each request exactly
+        once, and a counter reset (replica restart) re-baselines
+        instead of going negative."""
+        family = payload.get("pio_slo_requests_total")
+        samples = (
+            family.get("samples") if isinstance(family, dict) else None
+        )
+        if not samples:
+            return
+        deltas: dict[tuple, float] = {}
+        with self._fed_lock:
+            seen = self._slo_seen.setdefault(rid, {})
+            for sample in samples:
+                labels = sample.get("labels") or {}
+                key = (labels.get("class"), labels.get("outcome"))
+                if key[0] is None or key[1] not in ("good", "bad"):
+                    continue
+                try:
+                    value = float(sample.get("value") or 0.0)
+                except (TypeError, ValueError):
+                    continue
+                prev = seen.get(key, 0.0)
+                delta = value - prev if value >= prev else value
+                seen[key] = value
+                if delta > 0.0:
+                    deltas[key] = deltas.get(key, 0.0) + delta
+        for (cls, outcome), delta in deltas.items():
+            self._fleet_slo.ingest(
+                cls,
+                good=delta if outcome == "good" else 0.0,
+                bad=delta if outcome == "bad" else 0.0,
+            )
+
+    def _federation_scrape(self) -> tuple[dict, dict]:
+        """Fan out to every live replica's ``/metrics.json`` with
+        bounded concurrency and a per-replica deadline. A replica that
+        fails the scrape contributes its LAST snapshot, marked
+        ``pio_federation_stale{replica}`` — one SIGKILLed process must
+        never fail the fleet scrape."""
+        with self._lock:
+            targets = [
+                r for r in self._replicas.values() if r.state != RETIRED
+            ]
+
+        def scrape(replica: Replica) -> None:
+            try:
+                with urllib.request.urlopen(
+                    urllib.request.Request(
+                        replica.url + "/metrics.json"
+                    ),
+                    timeout=self._federation_timeout_s,
+                ) as resp:
+                    payload = json.loads(resp.read() or b"null")
+            except (OSError, ValueError):
+                replica.mark_metrics_stale()
+                return
+            if isinstance(payload, dict):
+                replica.store_metrics(payload)
+                self._ingest_replica_slo(replica.replica_id, payload)
+            else:
+                replica.mark_metrics_stale()
+
+        if targets:
+            with ThreadPoolExecutor(
+                max_workers=min(
+                    self._federation_concurrency, len(targets)
+                ),
+                thread_name_prefix="pio-federation",
+            ) as pool:
+                list(pool.map(scrape, targets))
+        payloads: dict[str, dict] = {}
+        stale: dict[str, bool] = {}
+        for replica in targets:
+            snapshot, is_stale = replica.metrics_state()
+            if snapshot:
+                payloads[replica.replica_id] = snapshot
+                stale[replica.replica_id] = is_stale
+            self._stale_gauge.labels(replica.replica_id).set(
+                1.0 if is_stale else 0.0
+            )
+        self._update_goodput(payloads)
+        return payloads, stale
+
+    def _update_goodput(self, payloads: dict) -> None:
+        """Fleet goodput = rate of SLO-good requests across federated
+        counters, differentiated between scrapes on the monotonic
+        clock (≥ 1 s apart — sub-second windows only amplify noise)."""
+        merged = federation_mod.merge_payloads(payloads)
+        good = federation_mod.counter_total(
+            merged, "pio_slo_requests_total", outcome="good"
+        )
+        now = time.monotonic()
+        with self._fed_lock:
+            if self._goodput_anchor is None:
+                self._goodput_anchor = (now, good)
+            else:
+                prev_t, prev_good = self._goodput_anchor
+                if good < prev_good:
+                    # a replica restarted (counter reset): re-anchor
+                    self._goodput_anchor = (now, good)
+                elif now - prev_t >= 1.0:
+                    self._goodput_qps = (good - prev_good) / (
+                        now - prev_t
+                    )
+                    self._goodput_anchor = (now, good)
+            qps = self._goodput_qps
+        self._goodput_gauge.set(qps)
+
+    def federated_dict(self) -> dict:
+        """The router's ``/metrics.json`` body: merged fleet counters
+        and histograms, the router's own registry, raw per-replica
+        payloads, and the scrape's staleness verdicts."""
+        payloads, stale = self._federation_scrape()
+        return {
+            "federation": {
+                "replicas": sorted(payloads),
+                "stale": sorted(r for r, s in stale.items() if s),
+            },
+            "fleet": federation_mod.merge_payloads(payloads),
+            "local": self._registry.to_dict(),
+            "perReplica": payloads,
+        }
+
+    def federated_text(self) -> str:
+        """The router's ``/metrics`` body: one Prometheus exposition
+        with every replica's series labeled ``replica=...`` beside the
+        router's own (which carry the fleet rollup gauges)."""
+        payloads, _ = self._federation_scrape()
+        combined = federation_mod.combine_families(
+            self._registry.to_dict(), payloads
+        )
+        return federation_mod.render_prometheus_families(combined)
+
+    def fleet_health(self) -> dict:
+        """The status/CLI fleet-health block: goodput, worst-class
+        burn, per-class SLO detail, and per-replica HBM headroom from
+        the federated device gauges."""
+        with self._lock:
+            targets = [
+                r for r in self._replicas.values() if r.state != RETIRED
+            ]
+        replicas: dict[str, dict] = {}
+        for replica in targets:
+            snapshot, is_stale = replica.metrics_state()
+            if not snapshot:
+                continue
+            entry: dict = {"stale": is_stale}
+            used = _sum_samples(snapshot, "pio_device_hbm_used_bytes")
+            limit = _sum_samples(
+                snapshot, "pio_device_hbm_limit_bytes"
+            )
+            if used is not None:
+                entry["hbmUsedBytes"] = used
+            if limit:
+                entry["hbmLimitBytes"] = limit
+                entry["hbmHeadroomBytes"] = max(
+                    0.0, limit - (used or 0.0)
+                )
+            rss = _metric_sample(
+                snapshot, "pio_process_resident_bytes"
+            )
+            if rss is not None:
+                entry["residentBytes"] = rss
+            replicas[replica.replica_id] = entry
+        with self._fed_lock:
+            qps = self._goodput_qps
+        return {
+            "goodputQps": round(qps, 3),
+            "burnRate": round(self._fleet_slo.max_burn_rate(), 4),
+            "slo": self._fleet_slo.snapshot(),
+            "replicas": replicas,
+        }
+
     def _fleet_observe(
         self, request: Request, response: Response | None,
         elapsed_s: float,
@@ -1970,6 +2272,9 @@ class ServingRouter:
                 "completedKept": swaps_kept,
                 "completedTotal": completed_total,
             },
+            # goodput + burn + per-replica HBM headroom, from probe-
+            # refreshed snapshots (status must not fan out a scrape)
+            "fleetHealth": self.fleet_health(),
         }
         if gate is not None:
             body["fleetGate"] = gate.to_dict()
@@ -2085,6 +2390,10 @@ class ServingRouter:
             service="router",
             registry=self._registry,
             tracer=self._tracer,
+            # the fleet SLO monitor scores real served traffic from
+            # federated counters; scoring the router's proxy hops too
+            # would count every request twice
+            slo=False,
         )
         self._http.add_drain_hook(self.close)
         return self._http

@@ -63,6 +63,7 @@ from predictionio_tpu.data.storage import (
 )
 from predictionio_tpu.data.storage.localfs import atomic_write_bytes
 from predictionio_tpu.obs import MetricRegistry, get_registry
+from predictionio_tpu.obs.device import DeviceSampler
 from predictionio_tpu.ops import als as als_ops
 from predictionio_tpu.utils.bimap import BiMap
 
@@ -835,11 +836,21 @@ class ContinuousTrainer:
         """Poll → maybe train → sleep, until ``stopping`` is set. One
         failure does not kill the loop (the supervisor handles process
         death; an application error is logged and retried next tick)."""
-        while not stopping.is_set():
-            try:
-                action = self.poll_once()
-                if action != "idle":
-                    logger.info("trainer tick: %s", action)
-            except Exception:
-                logger.exception("trainer tick failed; retrying next poll")
-            stopping.wait(self._config.poll_interval_s)
+        # device telemetry rides the daemon loop's lifetime: training
+        # is where HBM actually moves (factor matrices, batch staging),
+        # so the trainer publishes the same pio_device_hbm_* gauges the
+        # serving replicas do (no-op on backends without memory stats)
+        sampler = DeviceSampler(self._registry).start()
+        try:
+            while not stopping.is_set():
+                try:
+                    action = self.poll_once()
+                    if action != "idle":
+                        logger.info("trainer tick: %s", action)
+                except Exception:
+                    logger.exception(
+                        "trainer tick failed; retrying next poll"
+                    )
+                stopping.wait(self._config.poll_interval_s)
+        finally:
+            sampler.stop()

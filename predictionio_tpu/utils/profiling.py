@@ -18,10 +18,14 @@ alone is not a reliable barrier on every platform (see bench.py).
 from __future__ import annotations
 
 import contextlib
+import io
 import json
 import logging
 import os
+import tarfile
+import tempfile
 import time
+import uuid
 from collections import defaultdict
 
 import jax
@@ -122,3 +126,76 @@ def trace(trace_dir: str | None = None):
     logger.info("writing profiler trace to %s", trace_dir)
     with jax.profiler.trace(trace_dir):
         yield
+
+
+def capture(
+    duration_s: float,
+    tracer: "tracing.Tracer | None" = None,
+    device_sample_fn=None,
+    out_dir: str | None = None,
+) -> dict:
+    """On-demand profile capture (the ``POST /debug/profile`` body of
+    docs/observability.md): run a duration-bounded :func:`trace`
+    (jax.profiler, XLA timeline) and snapshot the same window's
+    flight-recorder spans (Perfetto-loadable Chrome trace-event JSON)
+    plus the current device gauges into ONE artifact directory:
+
+    * ``jax_trace/`` — the jax.profiler output (TensorBoard/Perfetto)
+    * ``spans.json`` — the tracing flight recorder's chrome trace
+    * ``device.json`` — HBM/live-array sample (when a sampler is given)
+    * ``manifest.json`` — id, window, file list
+
+    Returns the manifest. The artifact root is ``out_dir``, else
+    ``PIO_PROFILE_DIR``, else a fresh temp dir."""
+    art_id = uuid.uuid4().hex[:12]
+    base = (
+        out_dir
+        or os.environ.get("PIO_PROFILE_DIR")
+        or tempfile.mkdtemp(prefix="pio-profile-")
+    )
+    artifact_dir = os.path.join(base, f"profile-{art_id}")
+    trace_dir = os.path.join(artifact_dir, "jax_trace")
+    os.makedirs(trace_dir, exist_ok=True)
+    t0 = time.perf_counter()
+    with trace(trace_dir):
+        time.sleep(max(0.0, duration_s))
+    elapsed = time.perf_counter() - t0
+    tracer = tracer if tracer is not None else tracing.get_tracer()
+    with open(os.path.join(artifact_dir, "spans.json"), "w") as f:
+        json.dump(tracer.chrome_trace(), f, default=str)
+    files = ["jax_trace/", "manifest.json", "spans.json"]
+    if device_sample_fn is not None:
+        try:
+            sample = device_sample_fn()
+        except Exception:  # noqa: BLE001 - capture must not fail on a flaky backend read
+            sample = None
+        if sample is not None:
+            with open(
+                os.path.join(artifact_dir, "device.json"), "w"
+            ) as f:
+                json.dump(sample, f)
+            files.append("device.json")
+    manifest = {
+        "id": art_id,
+        "durationS": round(elapsed, 6),
+        "artifactDir": artifact_dir,
+        "files": sorted(files),
+    }
+    with open(os.path.join(artifact_dir, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
+    logger.info(
+        "profile capture %s: %.2fs window -> %s",
+        art_id, elapsed, artifact_dir,
+    )
+    return manifest
+
+
+def bundle(artifact_dir: str) -> bytes:
+    """One capture artifact as an in-memory ``tar.gz`` — the
+    ``/debug/profile`` response ships it base64-encoded and
+    ``pio-tpu profile`` extracts it locally."""
+    buf = io.BytesIO()
+    arcname = os.path.basename(artifact_dir.rstrip(os.sep))
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        tar.add(artifact_dir, arcname=arcname)
+    return buf.getvalue()

@@ -2,13 +2,23 @@
 ``/metrics``, verify request-ID echo, and pull ``/debug/traces`` to
 assert a non-empty Perfetto-valid trace — run by ``scripts/check.sh``
 so a telemetry regression fails fast without waiting on the full suite.
+
+Part two federates: two REAL replica processes behind an in-process
+serving router, proving the fleet-merged counters exactly equal the
+sum of the per-replica scrapes, every replica series carries its
+``replica`` label, and a SIGKILLed replica turns stale (marked, last
+snapshot retained) instead of vanishing from the fleet view.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import signal
+import subprocess
 import sys
+import threading
+import time
 import urllib.request
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -184,11 +194,181 @@ def main() -> int:
         http.shutdown()
         server.close()
 
+    federation_section(failures)
+
     if failures:
         print(f"metrics smoke: {len(failures)} check(s) FAILED")
         return 1
     print("metrics smoke: all checks passed")
     return 0
+
+
+def _spawn_replica(generation: str):
+    """(proc, port): one REAL replica child process (SIGKILLable)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYTHONUNBUFFERED"] = "1"
+    child = os.path.join(REPO, "tests", "router_replica_child.py")
+    proc = subprocess.Popen(
+        [sys.executable, child, "--port", "0",
+         "--generation", generation],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    bound: list[int] = []
+
+    def _scan():
+        for line in proc.stdout:
+            if "listening on" in line and not bound:
+                bound.append(
+                    int(line.split("pid=")[0].rsplit(":", 1)[1])
+                )
+        # keep draining so request logs can't block the child
+
+    threading.Thread(target=_scan, daemon=True).start()
+    deadline = time.monotonic() + 120
+    while not bound and time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(f"replica {generation} died at startup")
+        time.sleep(0.1)
+    if not bound:
+        proc.kill()
+        raise RuntimeError(f"replica {generation} never bound")
+    return proc, bound[0]
+
+
+def federation_section(failures: list[str]) -> None:
+    """Two replica processes behind a router: exact counter merge,
+    per-replica labels, SIGKILL staleness."""
+    from predictionio_tpu.obs import MetricRegistry
+    from predictionio_tpu.obs.federation import counter_total
+    from predictionio_tpu.serving.router import ServingRouter
+
+    def check(cond: bool, label: str) -> None:
+        print(("ok   " if cond else "FAIL ") + label)
+        if not cond:
+            failures.append(label)
+
+    proc_a, port_a = _spawn_replica("fed-a")
+    proc_b, port_b = _spawn_replica("fed-b")
+    router = ServingRouter(
+        probe_interval_s=0.2, registry=MetricRegistry()
+    )
+    router.add_replica(f"http://127.0.0.1:{port_a}", replica_id="a")
+    router.add_replica(f"http://127.0.0.1:{port_b}", replica_id="b")
+    http = router.serve(host="127.0.0.1", port=0)
+    http.start()
+    base = f"http://127.0.0.1:{http.port}"
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            with urllib.request.urlopen(f"{base}/", timeout=10) as r:
+                status = json.load(r)
+            states = {
+                rep["id"]: rep["state"]
+                for rep in status.get("replicas", [])
+            }
+            if all(states.get(rid) == "healthy" for rid in ("a", "b")):
+                break
+            time.sleep(0.2)
+
+        served = 0
+        for i in range(24):
+            req = urllib.request.Request(
+                f"{base}/queries.json",
+                data=json.dumps({"x": i}).encode(),
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=20) as resp:
+                served += resp.status == 200
+        check(served == 24, "24 queries served through the router")
+
+        with urllib.request.urlopen(
+            f"{base}/metrics.json", timeout=20
+        ) as resp:
+            fed = json.load(resp)
+        replicas = sorted(fed["federation"]["replicas"])
+        check(
+            replicas == ["a", "b"],
+            "federated scrape reaches both replicas",
+        )
+        check(
+            fed["federation"]["stale"] == [],
+            "no replica stale while both live",
+        )
+        name = "pio_http_requests_total"
+        fleet_total = counter_total(fed["fleet"], name)
+        per_replica = sum(
+            counter_total(fed["perReplica"][rid], name)
+            for rid in replicas
+        )
+        check(
+            fleet_total == per_replica and fleet_total >= 24,
+            f"fleet {name} ({fleet_total}) == sum of per-replica "
+            f"scrapes ({per_replica})",
+        )
+        slo_total = counter_total(
+            fed["fleet"], "pio_slo_requests_total", outcome="good"
+        )
+        check(
+            slo_total >= 24,
+            "fleet SLO good-request counter federates",
+        )
+
+        with urllib.request.urlopen(
+            f"{base}/metrics", timeout=20
+        ) as resp:
+            text = resp.read().decode()
+        check(
+            'replica="a"' in text,
+            "federated text carries replica=a labels",
+        )
+        check(
+            'replica="b"' in text,
+            "federated text carries replica=b labels",
+        )
+        check(
+            text.count(f"# TYPE {name} counter") == 1,
+            "one TYPE line per federated family",
+        )
+        check(
+            "pio_fleet_goodput_qps" in text
+            and "pio_slo_burn_rate" in text,
+            "fleet rollup gauges exported beside replica series",
+        )
+
+        print(f"SIGKILL replica b (pid {proc_b.pid})", flush=True)
+        os.kill(proc_b.pid, signal.SIGKILL)
+        proc_b.wait(timeout=30)
+        with urllib.request.urlopen(
+            f"{base}/metrics.json", timeout=20
+        ) as resp:
+            fed2 = json.load(resp)
+        check(
+            "b" in fed2["federation"]["replicas"]
+            and "b" in fed2["federation"]["stale"],
+            "SIGKILLed replica marked stale, not dropped",
+        )
+        b_total = counter_total(fed2["perReplica"].get("b", {}), name)
+        check(
+            b_total > 0,
+            "stale replica still contributes its last snapshot",
+        )
+        stale_marker = counter_total(
+            {"s": fed2["local"]["pio_federation_stale"]}, "s",
+            replica="b",
+        )
+        check(
+            stale_marker == 1.0,
+            "pio_federation_stale{replica=b} == 1",
+        )
+    finally:
+        http.shutdown()
+        router.close()
+        for proc in (proc_a, proc_b):
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait(timeout=30)
 
 
 if __name__ == "__main__":

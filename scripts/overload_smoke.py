@@ -283,6 +283,14 @@ def measure_capacity(base: str, duration_s: float = 1.2) -> float:
 
 def part_b_http(out_path: str) -> None:
     print("== part B: HTTP overload wiring (real EngineServer) ==")
+    # equalize the per-class error budgets for the burn-order check:
+    # with production budgets (0.001 vs 0.05) the NORMALIZED burn of a
+    # lightly-shed critical class can exceed a heavily-shed sheddable
+    # class, which would make the assertion test the budget ratio, not
+    # the shedding order the admission plane guarantees
+    os.environ["PIO_SLO_CRITICAL_AVAILABILITY"] = "0.5"
+    os.environ["PIO_SLO_DEFAULT_AVAILABILITY"] = "0.5"
+    os.environ["PIO_SLO_SHEDDABLE_AVAILABILITY"] = "0.5"
     server = build_server()
     http = server.serve(host="127.0.0.1", port=0)
     http.start()
@@ -458,6 +466,63 @@ def part_b_http(out_path: str) -> None:
             ) is not None,
             "pio_http_rejected_total{reason=overload} counted",
         )
+
+        # -- class-ordered SLO burn (ISSUE 16) -------------------------
+        # the shed order must show up in the burn-rate gauges: the
+        # sheddable class burns its (equalized) budget first while the
+        # critical class keeps budget
+        shed_burn = sample(
+            "pio_slo_burn_rate",
+            **{"class": admission.SHEDDABLE, "window": "short"},
+        )
+        crit_burn = sample(
+            "pio_slo_burn_rate",
+            **{"class": admission.CRITICAL, "window": "short"},
+        )
+        check(
+            shed_burn is not None and shed_burn > 0,
+            f"sheddable class burns budget under 2x overload "
+            f"(burn={shed_burn})",
+        )
+        check(
+            shed_burn is not None
+            and crit_burn is not None
+            and shed_burn > crit_burn,
+            f"class-ordered burn: sheddable {shed_burn} > critical "
+            f"{crit_burn}",
+        )
+        crit_left = sample(
+            "pio_slo_budget_remaining",
+            **{"class": admission.CRITICAL},
+        )
+        check(
+            crit_left is not None and crit_left > 0,
+            f"critical budget intact (remaining={crit_left})",
+        )
+
+        # fleet view: a router federating this server derives the same
+        # burn from counter deltas and hands it to the autoscaler
+        from predictionio_tpu.obs import MetricRegistry
+        from predictionio_tpu.serving.router import ServingRouter
+
+        router = ServingRouter(
+            probe_interval_s=999.0, registry=MetricRegistry()
+        )
+        router.add_replica(base, replica_id="overload")
+        try:
+            router.federated_dict()  # one scrape ingests SLO deltas
+            signals = router.autoscaler_signals()
+            check(
+                "burnRate" in signals,
+                "autoscaler signal dict carries burnRate",
+            )
+            check(
+                signals.get("burnRate", 0.0) > 0,
+                f"fleet burn rate from federated counters is live "
+                f"(burnRate={signals.get('burnRate')})",
+            )
+        finally:
+            router.close()
     finally:
         http.shutdown()
         server.close()

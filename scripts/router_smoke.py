@@ -122,6 +122,10 @@ def wait_states(base: str, want: dict, deadline_s: float = 120) -> bool:
 
 def metric_value(base: str, name: str, **labels):
     _, data, _ = http_json(f"{base}/metrics.json")
+    # the router's /metrics.json is a federated payload: its own
+    # series live under "local" (docs/observability.md)
+    if "federation" in data:
+        data = data.get("local", {})
     for sample in data.get(name, {}).get("samples", ()):
         if all(sample["labels"].get(k) == v for k, v in labels.items()):
             return sample.get("value", sample.get("count"))

@@ -722,3 +722,174 @@ class TestFleetCLI:
             "status", "--router-url", "http://127.0.0.1:1"
         )
         assert code == 1 and "ERROR" in err
+
+
+class TestObservabilityCLI:
+    """ISSUE 16: the fleet-health status line and the profile verb."""
+
+    def test_fleet_health_line_formats(self):
+        from predictionio_tpu.cli.main import _fleet_health_line
+
+        line = _fleet_health_line(
+            {
+                "goodputQps": 12.5,
+                "burnRate": 0.8,
+                "replicas": {
+                    "b": {"stale": True, "residentBytes": 3 * 2**20},
+                    "a": {
+                        "stale": False,
+                        "hbmUsedBytes": 600.0,
+                        "hbmLimitBytes": 1000.0,
+                        "hbmHeadroomBytes": 400.0,
+                    },
+                },
+            }
+        )
+        assert line.startswith("health: goodput=12.5qps burn=0.8")
+        assert "a[hbmFree=400B]" in line
+        assert "b[rss=3.00MiB stale]" in line
+        assert _fleet_health_line(None) is None
+
+    def test_status_router_url_prints_health_and_federation(self, cli):
+        from predictionio_tpu.obs import MetricRegistry
+        from predictionio_tpu.serving.router import ServingRouter
+
+        router = ServingRouter(
+            probe_interval_s=999.0, registry=MetricRegistry()
+        )
+        http = router.serve(host="127.0.0.1", port=0)
+        http.start()
+        try:
+            code, out, _ = cli(
+                "status", "--router-url",
+                f"http://127.0.0.1:{http.port}",
+            )
+            assert code == 0
+            assert "health: goodput=" in out
+            assert "burn=" in out
+            # the metrics scrape prints the federated shape
+            assert "federation: replicas=none" in out
+            assert "pio_slo_burn_rate" in out
+        finally:
+            router.close()
+            http.shutdown()
+
+    def test_profile_parser_flags(self):
+        from predictionio_tpu.cli.main import build_parser
+
+        args = build_parser().parse_args([
+            "profile", "--url", "http://h:8000", "--out", "prof",
+            "--duration-ms", "2500", "--access-key", "k",
+        ])
+        assert args.url == "http://h:8000"
+        assert args.out == "prof"
+        assert args.duration_ms == 2500.0
+        assert args.access_key == "k"
+        assert args.func.__name__ == "cmd_profile"
+
+    @pytest.fixture()
+    def profile_server(self):
+        """A /debug/profile-shaped endpoint answering a tiny bundle."""
+        import base64
+        import io
+        import tarfile
+
+        from predictionio_tpu.serving.http import (
+            HTTPServer,
+            Response,
+            Router,
+        )
+
+        manifest = {
+            "id": "abc123",
+            "durationS": 0.25,
+            "files": ["manifest.json", "spans.json"],
+        }
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+            for name, payload in (
+                ("manifest.json", json.dumps(manifest)),
+                ("spans.json", '{"traceEvents": []}'),
+            ):
+                data = payload.encode()
+                info = tarfile.TarInfo(f"profile-abc123/{name}")
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+        bundle = base64.b64encode(buf.getvalue()).decode()
+        seen = {}
+
+        def handler(request):
+            seen["body"] = json.loads(request.body)
+            seen["key"] = request.headers.get("X-PIO-Server-Key")
+            return Response(
+                200, {"profile": manifest, "bundle": bundle}
+            )
+
+        router = Router()
+        router.route("POST", "/debug/profile", handler)
+        http = HTTPServer(router, host="127.0.0.1", port=0)
+        http.start()
+        yield f"http://127.0.0.1:{http.port}", seen
+        http.shutdown()
+
+    def test_profile_pulls_and_extracts_bundle(
+        self, cli, profile_server, tmp_path
+    ):
+        base, seen = profile_server
+        out = tmp_path / "prof"
+        code, stdout, _ = cli(
+            "profile", "--url", base, "--out", str(out),
+            "--duration-ms", "250", "--access-key", "sekrit",
+        )
+        assert code == 0
+        assert seen["body"] == {"durationMs": 250.0}
+        assert seen["key"] == "sekrit"
+        assert "Wrote profile artifact abc123" in stdout
+        extracted = out / "profile-abc123"
+        assert json.loads((extracted / "manifest.json").read_text())[
+            "id"
+        ] == "abc123"
+        assert (extracted / "spans.json").exists()
+
+    def test_profile_rejects_non_bundle_payload(self, cli):
+        from predictionio_tpu.serving.http import (
+            HTTPServer,
+            Response,
+            Router,
+        )
+
+        router = Router()
+        router.route(
+            "POST", "/debug/profile", lambda r: Response(200, {})
+        )
+        http = HTTPServer(router, host="127.0.0.1", port=0)
+        http.start()
+        try:
+            code, _, err = cli(
+                "profile", "--url",
+                f"http://127.0.0.1:{http.port}", "--out", "prof",
+            )
+            assert code == 1
+            assert "did not answer a profile bundle" in err
+        finally:
+            http.shutdown()
+
+    def test_safe_extract_rejects_traversal(self, tmp_path):
+        import io
+        import tarfile
+
+        from predictionio_tpu.cli.main import _safe_extract
+
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+            data = b"evil"
+            info = tarfile.TarInfo("../escaped.txt")
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+        buf.seek(0)
+        dest = tmp_path / "out"
+        dest.mkdir()
+        with tarfile.open(fileobj=buf, mode="r:gz") as tar:
+            with pytest.raises((ValueError, tarfile.TarError)):
+                _safe_extract(tar, str(dest))
+        assert not (tmp_path / "escaped.txt").exists()

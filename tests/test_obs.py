@@ -125,6 +125,63 @@ class TestRegistry:
         assert sample["p50"] is not None
         assert sample["p99"] <= 0.1
 
+    def test_histogram_snapshot_carries_per_bucket_counts(self):
+        """ISSUE 16: JSON snapshots expose raw bucket counts with an
+        explicit +Inf key so federation can merge bucket-wise (and a
+        merged histogram's percentiles match the union exactly)."""
+        from predictionio_tpu.obs.federation import (
+            merge_histogram_samples,
+        )
+
+        def snap(values):
+            reg = MetricRegistry()
+            h = reg.histogram("t_m", buckets=(0.1, 0.5, 1.0))
+            for v in values:
+                h.observe(v)
+            return reg.to_dict()["t_m"]["samples"][0]
+
+        a = snap([0.05, 0.3, 2.0])
+        assert a["buckets"] == {"0.1": 1, "0.5": 1, "1": 0, "+Inf": 1}
+        # existing keys stay intact (backward compatibility)
+        assert a["count"] == 3 and "p95" in a and "sum" in a
+        b = snap([0.05] * 10 + [0.7] * 3)
+        merged = merge_histogram_samples([a, b])
+        union = snap([0.05, 0.3, 2.0] + [0.05] * 10 + [0.7] * 3)
+        assert merged["buckets"] == union["buckets"]
+        assert (merged["p50"], merged["p95"], merged["p99"]) == (
+            union["p50"],
+            union["p95"],
+            union["p99"],
+        )
+
+    def test_process_gauges_exported(self):
+        """pio_process_resident_bytes / pio_process_open_fds read
+        /proc at scrape time on every registry."""
+        import os
+
+        if not os.path.isdir("/proc/self"):
+            pytest.skip("no procfs")
+        from predictionio_tpu.obs.registry import (
+            _install_process_metrics,
+        )
+
+        reg = MetricRegistry()
+        _install_process_metrics(reg)  # default registry gets this
+        data = reg.to_dict()
+        rss = data["pio_process_resident_bytes"]["samples"][0]["value"]
+        fds = data["pio_process_open_fds"]["samples"][0]["value"]
+        assert rss > 1024 * 1024  # a python process holds > 1 MiB
+        assert fds >= 3  # stdio at minimum
+        # scrape-time evaluation: opening a file moves the fd gauge
+        with open("/proc/self/status"):
+            fds2 = reg.to_dict()["pio_process_open_fds"]["samples"][
+                0
+            ]["value"]
+        assert fds2 >= fds + 1
+        text = reg.render_prometheus()
+        assert "pio_process_resident_bytes" in text
+        assert "pio_process_open_fds" in text
+
     def test_get_or_create_is_idempotent_and_type_safe(self):
         reg = MetricRegistry()
         a = reg.counter("t_x", "h")
@@ -578,3 +635,45 @@ class TestOtherServerScrapes:
                 assert "pio_http_request_seconds" in json.loads(body)
             finally:
                 http.shutdown()
+
+    def test_dashboard_key_gates_debug_traces(self, memory_storage):
+        """ISSUE 16 satellite: the dashboard mounts the shared
+        telemetry surface — /metrics stays open (aggregates only) but
+        /debug/traces carries per-request data and honors the server
+        key like every other server."""
+        from predictionio_tpu.serving.config import ServerConfig
+        from predictionio_tpu.serving.dashboard import create_dashboard
+
+        http = create_dashboard(
+            host="127.0.0.1",
+            port=0,
+            storage=memory_storage,
+            registry=MetricRegistry(),
+            server_config=ServerConfig(
+                key_auth_enforced=True, access_key="dash-key"
+            ),
+        )
+        http.start()
+        try:
+            base = f"http://127.0.0.1:{http.port}"
+            # the dashboard enforces its key server-wide (reference
+            # KeyAuthentication mixes into the whole route tree)
+            for path in ("/metrics", "/metrics.json", "/debug/traces"):
+                status, _, _ = _call(f"{base}{path}")
+                assert status == 401, path
+            key = {"X-PIO-Server-Key": "dash-key"}
+            status, text, _ = _call(f"{base}/metrics", headers=key)
+            assert status == 200
+            assert b"pio_http_requests_total" in text
+            status, body, _ = _call(
+                f"{base}/metrics.json", headers=key
+            )
+            assert status == 200
+            assert "pio_http_request_seconds" in json.loads(body)
+            status, body, _ = _call(
+                f"{base}/debug/traces", headers=key
+            )
+            assert status == 200
+            assert b"traceEvents" in body
+        finally:
+            http.shutdown()
