@@ -202,6 +202,48 @@ def _model_summary_line(data: dict) -> str | None:
     return " ".join(parts)
 
 
+def _pool_summary_line(data: dict) -> str | None:
+    """One-line model-pool summary (multi-tenant serving): tenants
+    resident vs budget, aggregate hit rate, evictions. Only rendered
+    when the scraped server runs a pool (pio_pool_* series present)."""
+
+    def first_value(name):
+        family = data.get(name)
+        if not isinstance(family, dict):
+            return None
+        samples = family.get("samples") or []
+        if not samples or "value" not in samples[0]:
+            return None
+        return samples[0]["value"]
+
+    def labeled_sum(name):
+        family = data.get(name)
+        if not isinstance(family, dict):
+            return 0.0
+        return sum(
+            s.get("value", s.get("count", 0)) or 0
+            for s in family.get("samples") or []
+        )
+
+    budget = first_value("pio_pool_budget_bytes")
+    if budget is None:
+        return None
+    resident = first_value("pio_pool_tenants_resident") or 0
+    resident_bytes = labeled_sum("pio_pool_resident_bytes")
+    hits = labeled_sum("pio_pool_hits_total")
+    misses = labeled_sum("pio_pool_misses_total")
+    evictions = labeled_sum("pio_pool_evictions_total")
+    parts = [
+        f"pool: tenantsResident={int(resident)}",
+        f"bytes={int(resident_bytes)}/{int(budget)}",
+    ]
+    lookups = hits + misses
+    if lookups:
+        parts.append(f"hitRate={hits / lookups:.2f}")
+    parts.append(f"evictions={int(evictions)}")
+    return " ".join(parts)
+
+
 def _fleet_summary_line(status: dict) -> str:
     """One-line fleet summary from a router's GET / status payload:
     replica count + health bands, serving generation, in-flight swap
@@ -351,6 +393,9 @@ def _print_metrics(url: str, access_key: str = "") -> int:
         summary = _model_summary_line(data)
         if summary:
             print(summary)
+        pool = _pool_summary_line(data)
+        if pool:
+            print(pool)
         _print_families(data)
     except (AttributeError, KeyError, TypeError) as e:
         print(
@@ -989,6 +1034,32 @@ def cmd_deploy(args) -> int:
         )
         return 1
 
+    tenants = None
+    if getattr(args, "tenant", None):
+        tenants = {}
+        for spec in args.tenant:
+            name, sep, tenant_variant = spec.partition("=")
+            if not (sep and name and tenant_variant):
+                print(
+                    f"error: --tenant expects NAME=VARIANT, got {spec!r}",
+                    file=sys.stderr,
+                )
+                return 1
+            tenants[name] = tenant_variant
+        if args.canary:
+            print(
+                "error: --canary and --tenant are mutually exclusive "
+                "(per-tenant /reload replaces the canary gate)",
+                file=sys.stderr,
+            )
+            return 1
+        if args.pool_budget_bytes:
+            # env rather than an explicit ModelPool so the server owns
+            # (and closes) the pool it builds
+            os.environ["PIO_POOL_BUDGET_BYTES"] = str(
+                args.pool_budget_bytes
+            )
+
     engine, params, engine_id, variant, variant_dict = _resolve(args)
     feedback_app_id = None
     if args.feedback:
@@ -1018,6 +1089,8 @@ def cmd_deploy(args) -> int:
         adaptive_wait=not args.no_adaptive_wait,
         admission=not args.no_admission,
         canary=args.canary,
+        tenants=tenants,
+        quantize=args.quantize,
     )
     multi = args.workers > 1
     if multi and (err := _reuseport_unsupported()):
@@ -2001,6 +2074,22 @@ def build_parser() -> argparse.ArgumentParser:
         help="guard /reload with shadow-scored canary promotion + "
              "automatic rollback (PIO_CANARY_* env tunes the gate; "
              "docs/training.md)",
+    )
+    p.add_argument(
+        "--tenant", action="append", default=[], metavar="NAME=VARIANT",
+        help="serve engine variant VARIANT as tenant NAME through the "
+             "device model pool (repeatable; docs/serving.md). "
+             "Mutually exclusive with --canary",
+    )
+    p.add_argument(
+        "--pool-budget-bytes", dest="pool_budget_bytes", type=int,
+        default=0,
+        help="model-pool HBM byte budget for --tenant mode (0 = "
+             "PIO_POOL_BUDGET_BYTES env, else a device-HBM fraction)",
+    )
+    p.add_argument(
+        "--quantize", choices=("int8", "bf16"), default=None,
+        help="quantize pooled factor tables (overrides PIO_POOL_QUANT)",
     )
     p.add_argument(
         "--workers", type=int, default=1,

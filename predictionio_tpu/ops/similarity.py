@@ -68,6 +68,14 @@ def _use_pallas(batch: int, n_items: int) -> bool:
     )
 
 
+def _quantized(x) -> bool:
+    """True when ``x`` is an ``ops.quantize.QuantizedFactors`` table
+    (lazy import: quantize imports this module at top level)."""
+    from predictionio_tpu.ops import quantize
+
+    return isinstance(x, quantize.QuantizedFactors)
+
+
 def top_k_dot(
     queries: jax.Array,
     items: jax.Array,
@@ -79,7 +87,16 @@ def top_k_dot(
     Large batch×catalog products on TPU take the fused Pallas path
     (:func:`predictionio_tpu.ops.pallas_topk.fused_top_k_dot`), which
     streams item blocks through VMEM instead of writing the [B, I]
-    score matrix to HBM. ``PIO_PALLAS_TOPK=0/1`` overrides the choice."""
+    score matrix to HBM. ``PIO_PALLAS_TOPK=0/1`` overrides the choice.
+
+    ``items`` may be a quantized table
+    (:class:`predictionio_tpu.ops.quantize.QuantizedFactors`): the
+    pooled multi-tenant server stores int8/bf16 catalogs and every
+    serving entry point here accepts them in place of f32 arrays."""
+    if _quantized(items):
+        from predictionio_tpu.ops import quantize
+
+        return quantize.top_k_dot_quantized(queries, items, num, mask)
     num = min(num, items.shape[0])  # same clamp on both paths
     if _use_pallas(queries.shape[0], items.shape[0]):
         from predictionio_tpu.ops.pallas_topk import fused_top_k_dot
@@ -99,7 +116,18 @@ def top_k_cosine(
     num: int,
     mask: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
-    """Top-``num`` by cosine similarity (similar-product scoring)."""
+    """Top-``num`` by cosine similarity (similar-product scoring).
+
+    A quantized ``items`` table stays quantized: the symmetric per-row
+    scale cancels under l2 normalization, so cosine runs on the same
+    int8/bf16 data with a ``1/‖row‖`` scale vector
+    (:func:`predictionio_tpu.ops.quantize.normalized`)."""
+    if _quantized(items):
+        from predictionio_tpu.ops import quantize
+
+        return quantize.top_k_dot_quantized(
+            l2_normalize(queries), quantize.normalized(items), num, mask
+        )
     return top_k_dot(
         l2_normalize(queries), l2_normalize(items), num, mask
     )
@@ -147,7 +175,16 @@ def gather_top_k_dot(
     """Fused row-gather + dot scores + top-``num``: one device dispatch,
     uploading only ``idx``. ``factors``/``items`` may be host arrays
     (evaluation path) — they are uploaded per call then; staged serving
-    passes resident ``jax.Array``s."""
+    passes resident ``jax.Array``s. Either side may also be a
+    quantized table: gathered user rows dequantize to f32 (a handful
+    of rows), the item catalog stays int8/bf16 end to end."""
+    if _quantized(factors) or _quantized(items):
+        from predictionio_tpu.ops import quantize
+
+        vecs = quantize.gather_rows(factors, idx)
+        if _quantized(items):
+            return quantize.top_k_dot_quantized(vecs, items, num, mask)
+        return top_k_dot(vecs, jnp.asarray(items), num, mask)
     factors, items = jnp.asarray(factors), jnp.asarray(items)
     num = min(num, items.shape[0])
     idx = jnp.asarray(idx, jnp.int32)
@@ -188,6 +225,22 @@ def gather_mean_top_k_cosine(
     ``mask`` ([I] bool, True = exclude) drops rows from the ranking —
     the phantom padding rows of a model-sharded catalog score -inf.
     Returns ([1, num] scores, [1, num] indices)."""
+    if _quantized(items_f):
+        from predictionio_tpu.ops import quantize
+
+        idx = jnp.asarray(idx, jnp.int32)
+        valid = idx >= 0
+        rows = quantize.gather_rows(items_f, jnp.clip(idx, 0, None))
+        w = valid.astype(rows.dtype)[:, None]
+        q = (rows * w).sum(axis=0, keepdims=True) / jnp.maximum(
+            w.sum(), 1.0
+        )
+        return quantize.top_k_dot_quantized(
+            l2_normalize(q),
+            quantize.normalized(items_f),
+            min(num, items_f.shape[0]),
+            mask,
+        )
     items_f = jnp.asarray(items_f)
     return _gather_mean_top_k_cosine_xla(
         items_f,

@@ -32,6 +32,7 @@ code.
 from __future__ import annotations
 
 import base64
+import contextlib
 import dataclasses
 import datetime as _dt
 import html as _html
@@ -60,6 +61,7 @@ from predictionio_tpu.obs.device import CompileTracker, DeviceSampler
 from predictionio_tpu.parallel.mesh import ComputeContext
 from predictionio_tpu.serving import admission as admission_mod
 from predictionio_tpu.serving import canary as canary_mod
+from predictionio_tpu.serving import modelpool as modelpool_mod
 from predictionio_tpu.serving import resilience
 from predictionio_tpu.serving.batching import (
     BatcherOverloaded,
@@ -94,6 +96,9 @@ class _StagedGeneration:
     serving: Any
     batchers: list
     warmed: bool
+    #: device bytes the generation's models hold (model pool budget
+    #: accounting; 0 when the models expose no measurable arrays)
+    nbytes: int = 0
 
 
 class EngineServer:
@@ -123,6 +128,9 @@ class EngineServer:
         tracer: tracing.Tracer | None = None,
         admission: bool | admission_mod.AdmissionController = True,
         canary: bool | canary_mod.CanaryConfig = False,
+        tenants: dict[str, str] | None = None,
+        pool: modelpool_mod.ModelPool | None = None,
+        quantize: str | None = None,
     ):
         self._engine = engine
         self._params = params
@@ -194,16 +202,51 @@ class EngineServer:
             self._canary_config = None
         self._canary: canary_mod.ShadowCanary | None = None
         self._last_canary: dict | None = None
+        # multi-tenant mode (docs/serving.md "Multi-tenant serving"):
+        # one process serves N engine variants through a byte-budgeted
+        # device model pool keyed by accessKey/X-PIO-Tenant. Tables
+        # quantize per PIO_POOL_QUANT (int8|bf16|"" = off) so many
+        # catalogs fit one chip's HBM.
+        self._tenants = dict(tenants) if tenants else None
+        self._quantize = (
+            quantize
+            if quantize is not None
+            else os.environ.get("PIO_POOL_QUANT", "").strip()
+        )
+        if self._quantize and self._quantize not in ("int8", "bf16"):
+            raise ValueError(
+                f"unknown quantize mode {self._quantize!r} "
+                "(expected int8, bf16, or empty)"
+            )
+        if self._tenants is not None and self._canary_config is not None:
+            # per-tenant reload is immediate; shadow-canary promotion
+            # assumes ONE serving generation per process
+            raise ValueError(
+                "canary and multi-tenant mode are mutually exclusive"
+            )
+        self._pool: modelpool_mod.ModelPool | None = None
+        self._owns_pool = False
+        #: tenant → monotonic reload count / latest instance (guarded
+        #: by self._lock; the labeled generation/age gauges read these)
+        self._tenant_generations: dict[str, int] = {}
+        self._tenant_instances: dict[str, Any] = {}
         # serializes /reload handling (staging can take seconds of
         # warmup; two concurrent reloads must not both stage, and a
         # manual reload must deterministically supersede a live canary)
         self._reload_mutex = threading.Lock()
         self._generation = 0
+        # per-tenant labeled series: a pooled server swaps models for
+        # MANY tenants, and unlabeled gauges would silently overwrite
+        # each other across tenants. Single-tenant mode publishes the
+        # same series under the empty tenant label, so scrapers sum/
+        # first-sample identically in both modes.
         self._generation_gauge = self._registry.gauge(
             "pio_model_generation",
             "Monotonic count of model hot-swaps this process served "
             "(promotions AND rollbacks each advance it — every serving-"
-            "model transition is scrape-visible)",
+            "model transition is scrape-visible; labeled per tenant in "
+            "multi-tenant mode, empty label otherwise)",
+            ("tenant",),
         )
         self._warmed_gauge = self._registry.gauge(
             "pio_warmup_complete",
@@ -211,11 +254,17 @@ class EngineServer:
             "attempted bucket; 0 while cold (warmup running, disabled, "
             "or every compile failed)",
         )
-        self._registry.gauge(
+        self._age_gauge = self._registry.gauge(
             "pio_model_age_seconds",
             "Seconds since the serving generation finished training "
-            "(freshness of the model users are hitting)",
-        ).set_function(self._model_age_seconds)
+            "(freshness of the model users are hitting; labeled per "
+            "tenant in multi-tenant mode, empty label otherwise)",
+            ("tenant",),
+        )
+        if self._tenants is None:
+            self._age_gauge.labels("").set_function(
+                self._model_age_seconds
+            )
         # device runtime telemetry (docs/observability.md "Device
         # telemetry"): HBM/live-array sampler started by serve(), and
         # compile counters the warmup path records into. CPU backends
@@ -227,7 +276,19 @@ class EngineServer:
         #: capture window itself
         self._profile_active = False
         self._batchers: list[MicroBatcher] = []
-        self._load()
+        if self._tenants is None:
+            self._load()
+        else:
+            self._instance = None
+            self._serving = None
+            if pool is not None:
+                self._pool = pool
+            else:
+                self._pool = modelpool_mod.ModelPool(
+                    registry=self._registry
+                )
+                self._owns_pool = True
+            self._preload_tenants()
 
         self.router = Router()
         self.router.route("GET", "/", self._status)
@@ -283,6 +344,125 @@ class EngineServer:
         unguarded path: initial load, and /reload without canary)."""
         self._activate(self._stage())
 
+    # -- multi-tenant pool plumbing ---------------------------------------
+    def _tenant_age_seconds(self, tenant: str) -> float:
+        with self._lock:
+            instance = self._tenant_instances.get(tenant)
+        if instance is None:
+            return 0.0
+        age = (
+            _dt.datetime.now(_dt.timezone.utc) - instance.end_time
+        ).total_seconds()
+        return max(0.0, age)
+
+    def _tenant_loader(self, tenant: str):
+        """Pool loader for one tenant: stage the tenant's engine
+        variant (host load + device promotion + warmup, all on the
+        pool's loader thread — never a request thread), advance its
+        labeled generation/age series, and hand the pool the staged
+        generation with its measured device bytes."""
+
+        def load():
+            staged = self._stage(
+                engine_variant=self._tenants[tenant], tenant=tenant
+            )
+            first = False
+            with self._lock:
+                generation = self._tenant_generations.get(tenant, 0) + 1
+                first = tenant not in self._tenant_generations
+                self._tenant_generations[tenant] = generation
+                self._tenant_instances[tenant] = staged.instance
+            self._generation_gauge.labels(tenant).set(generation)
+            if first:
+                self._age_gauge.labels(tenant).set_function(
+                    lambda t=tenant: self._tenant_age_seconds(t)
+                )
+            logger.info(
+                "tenant %r serving instance %s (variant %r, "
+                "generation %d, %d bytes)",
+                tenant, staged.instance.id, self._tenants[tenant],
+                generation, staged.nbytes,
+            )
+
+            def close():
+                for b in staged.batchers:
+                    b.close()
+
+            return staged, staged.nbytes, close
+
+        return load
+
+    def _preload_tenants(self) -> None:
+        """Eager initial load of every tenant through the pool (LRU
+        keeps whatever fits the budget; the rest reload on first hit).
+        The replica only advertises warm once every tenant's warmup
+        compiled — matching the single-tenant contract the router's
+        admission gate reads."""
+        warmed_all = True
+        for tenant in self._tenants:
+            with self._pool.pin(
+                tenant, self._tenant_loader(tenant)
+            ) as staged:
+                warmed_all = warmed_all and staged.warmed
+        self._warmed_gauge.set(1 if warmed_all else 0)
+        logger.info(
+            "multi-tenant server preloaded %d tenant(s), %d resident",
+            len(self._tenants), len(self._pool.resident()),
+        )
+
+    def _resolve_tenant(self, request: Request) -> str:
+        """Tenant key for a request: ``accessKey`` query param, then
+        the ``X-PIO-Tenant`` header — the same resolution order the
+        admission controller's fair-share accounting uses."""
+        tenant = (
+            request.query.get("accessKey")
+            or request.headers.get(admission_mod.TENANT_HEADER)
+            or ""
+        )
+        if not tenant:
+            raise HTTPError(
+                400,
+                "multi-tenant server requires an accessKey query "
+                f"param or {admission_mod.TENANT_HEADER} header",
+            )
+        if tenant not in self._tenants:
+            raise HTTPError(404, f"unknown tenant {tenant!r}")
+        return tenant
+
+    @contextlib.contextmanager
+    def _serving_snapshot(self, request: Request):
+        """Yield ``(serving, batchers)`` for one request. Single-tenant:
+        the locked serving pointers. Multi-tenant: the tenant's pool
+        entry, PINNED for the scope — submit through collect — so an
+        eviction racing this in-flight query can never close the
+        generation under it."""
+        if self._tenants is None:
+            with self._lock:
+                serving = self._serving
+                batchers = self._batchers
+            yield serving, batchers
+            return
+        tenant = self._resolve_tenant(request)
+        try:
+            with self._pool.pin(
+                tenant,
+                self._tenant_loader(tenant),
+                timeout=self._predict_timeout_s,
+            ) as staged:
+                yield staged.serving, staged.batchers
+        except modelpool_mod.PoolLoadTimeout:
+            raise HTTPError(
+                503,
+                f"tenant {tenant!r} is still loading; retry",
+                headers={
+                    "Retry-After": admission_mod.format_retry_after(1.0)
+                },
+            ) from None
+        except modelpool_mod.PoolLoadError as exc:
+            raise HTTPError(
+                500, f"tenant {tenant!r} failed to load: {exc}"
+            ) from exc
+
     def _activate(self, staged: _StagedGeneration) -> None:
         with self._lock:
             old = self._batchers
@@ -291,7 +471,7 @@ class EngineServer:
             self._batchers = staged.batchers
             self._generation += 1
             generation = self._generation
-        self._generation_gauge.set(generation)
+        self._generation_gauge.labels("").set(generation)
         self._warmed_gauge.set(1 if staged.warmed else 0)
         for b in old:
             b.close()
@@ -301,11 +481,22 @@ class EngineServer:
             staged.instance.id, len(staged.batchers), generation,
         )
 
-    def _stage(self, for_canary: bool = False) -> _StagedGeneration:
+    def _stage(
+        self,
+        for_canary: bool = False,
+        engine_variant: str | None = None,
+        tenant: str | None = None,
+    ) -> _StagedGeneration:
         """Load + warm the latest generation WITHOUT touching the
         serving pointers — the canary path evaluates the result beside
-        live traffic before :meth:`_activate` ever runs."""
-        if not for_canary:
+        live traffic before :meth:`_activate` ever runs.
+
+        ``tenant`` stages one pooled tenant's variant: batcher/compile
+        sites are named per tenant (the fair-share plumbing keys
+        batches on those names) and the global warm gauge is left
+        alone — a cold tenant loading mid-traffic must not flap the
+        replica's router admission."""
+        if not for_canary and tenant is None:
             # the gauge describes the NEWEST generation: an immediate
             # reload makes the incoming (cold) generation newest, so it
             # reads 0 through the compile window. Canary staging keeps
@@ -317,12 +508,40 @@ class EngineServer:
             self._params,
             engine_id=self._engine_id,
             engine_version=self._engine_version,
-            engine_variant=self._engine_variant,
+            engine_variant=(
+                engine_variant
+                if engine_variant is not None
+                else self._engine_variant
+            ),
             ctx=self._ctx,
             storage=self._storage,
         )
+        nbytes = 0
+        if self._quantize or self._tenants is not None:
+            # quantized tables (int8/bf16) + byte accounting: the pool
+            # charges each tenant the measured device residency. Lazy
+            # import: quantize pulls in jax kernels the single-tenant
+            # f32 path never needs.
+            from predictionio_tpu.ops import quantize as quantize_mod
+
+            if self._quantize:
+                models = [
+                    quantize_mod.quantize_model_factors(
+                        m, self._quantize
+                    )
+                    for m in models
+                ]
+            nbytes = sum(
+                quantize_mod.model_resident_bytes(m) for m in models
+            )
+        name_prefix = (
+            f"{self._engine_id}/{tenant}/"
+            if tenant is not None
+            else f"{self._engine_id}/"
+        )
         warmed = bool(
-            self._warmup and self._precompile(algorithms, models)
+            self._warmup
+            and self._precompile(algorithms, models, name_prefix)
         )
 
         def batch_fn(a, m):
@@ -378,7 +597,7 @@ class EngineServer:
                 pipeline_depth=self._pipeline_depth,
                 adaptive_wait=self._adaptive_wait,
                 registry=self._registry,
-                name=f"{self._engine_id}/algo{i}",
+                name=f"{name_prefix}algo{i}",
             )
             for i, (algo, model) in enumerate(zip(algorithms, models))
         ]
@@ -387,9 +606,12 @@ class EngineServer:
             serving=serving,
             batchers=batchers,
             warmed=warmed,
+            nbytes=nbytes,
         )
 
-    def _precompile(self, algorithms, models) -> bool:
+    def _precompile(
+        self, algorithms, models, name_prefix: str | None = None
+    ) -> bool:
         """Compile every power-of-two batch bucket before traffic hits.
 
         XLA compiles per static shape; without this, each new bucket
@@ -420,9 +642,11 @@ class EngineServer:
             ("batcher", "bucket"),
         )
         total_failures = 0
+        if name_prefix is None:
+            name_prefix = f"{self._engine_id}/"
         for i, (algo, model) in enumerate(zip(algorithms, models)):
             name = type(algo).__name__
-            batcher_name = f"{self._engine_id}/algo{i}"
+            batcher_name = f"{name_prefix}algo{i}"
             query = getattr(algo, "warmup_query", lambda: {})()
             if query is None:
                 # the algorithm declares no neutral query exists (e.g.
@@ -486,7 +710,7 @@ class EngineServer:
     # -- routes -----------------------------------------------------------
     def _status_data(self) -> dict:
         with self._lock:
-            return {
+            data = {
                 "status": "alive",
                 # which SO_REUSEPORT worker answered (ops parity with
                 # the event server's status route)
@@ -494,8 +718,6 @@ class EngineServer:
                 "engineId": self._engine_id,
                 "engineVersion": self._engine_version,
                 "engineVariant": self._engine_variant,
-                "engineInstanceId": self._instance.id,
-                "generation": self._generation,
                 # serving mesh topology: a model axis > 1 means the
                 # factor catalog is row-sharded across devices — one
                 # instance serving a catalog bigger than one chip's
@@ -512,8 +734,6 @@ class EngineServer:
                         "state", canary_mod.IDLE
                     )
                 ),
-                "trainingStartTime": self._instance.start_time.isoformat(),
-                "trainingEndTime": self._instance.end_time.isoformat(),
                 "startTime": self._start_time.isoformat(),
                 "requestCount": self._request_count,
                 "avgServingSec": round(self._avg_serving_sec, 6),
@@ -522,6 +742,26 @@ class EngineServer:
                     self._last_batch_per_query_sec, 6
                 ),
             }
+            if self._tenants is None:
+                data["engineInstanceId"] = self._instance.id
+                data["generation"] = self._generation
+                data["trainingStartTime"] = (
+                    self._instance.start_time.isoformat()
+                )
+                data["trainingEndTime"] = (
+                    self._instance.end_time.isoformat()
+                )
+            else:
+                data["multiTenant"] = True
+                data["tenants"] = sorted(self._tenants)
+                data["tenantGenerations"] = dict(
+                    self._tenant_generations
+                )
+        if self._tenants is not None:
+            # pool.stats() takes the pool's own lock — never nest it
+            # inside ours
+            data["pool"] = self._pool.stats()
+        return data
 
     def _status(self, request: Request) -> Response:
         data = self._status_data()
@@ -576,10 +816,11 @@ class EngineServer:
        ({e(self._engine_variant)})</p>
     <h2>Engine Information</h2>
     {table([
-        ("Training Start Time", data["trainingStartTime"]),
-        ("Training End Time", data["trainingEndTime"]),
+        ("Training Start Time", data.get("trainingStartTime", "-")),
+        ("Training End Time", data.get("trainingEndTime", "-")),
         ("Variant ID", data["engineVariant"]),
-        ("Instance ID", data["engineInstanceId"]),
+        ("Instance ID", data.get("engineInstanceId", "-")),
+        ("Tenants", ", ".join(data.get("tenants", [])) or "-"),
     ])}
     <h2>Server Information</h2>
     {table([
@@ -608,7 +849,7 @@ class EngineServer:
         shed marker is safe here: a shed query produced no prediction
         and recorded no feedback — nothing externally visible ran."""
         with self._lock:
-            batchers = self._batchers
+            batchers = self._batchers or ()
         hint = max(
             (b.retry_after_s() for b in batchers), default=0.05
         )
@@ -689,71 +930,81 @@ class EngineServer:
         if not isinstance(query, dict):
             raise HTTPError(400, "query must be a JSON object")
         for _attempt in range(2):
-            with self._lock:
-                serving = self._serving
-                batchers = self._batchers
-            supplemented = serving.supplement(query)
-            futures = []
-            try:
-                for b in batchers:
-                    futures.append(b.submit(supplemented))
-            except BatcherOverloaded:
-                # queue-depth bound hit: shed immediately instead of
-                # queueing into a predict-timeout hang. Earlier
-                # algorithms' accepted submits must not run for nothing.
-                self._abandon(futures)
-                raise HTTPError(
-                    503, "server overloaded; retry later",
-                    headers=self._shed_headers(),
-                )
-            except resilience.DeadlineExceeded:
-                self._abandon(futures)
-                raise HTTPError(504, "deadline expired before dispatch")
-            except RuntimeError:
-                # /reload swapped+closed the batchers between our snapshot
-                # and submit — retry once against the fresh set
-                self._abandon(futures)
-                continue
-            break
-        else:
-            raise HTTPError(503, "server is reloading; retry")
-        try:
-            prediction = self._serve_one(
-                serving, query, supplemented, futures
-            )
-        except resilience.DeadlineExceeded:
-            # the batcher dropped the slot pre-dispatch: the client's
-            # budget ran out while the query was queued
-            raise HTTPError(504, "deadline expired before device dispatch")
-        except BatcherOverloaded:
-            # a queued slot was evicted by a higher-criticality
-            # submission while we waited — a shed, not a fault. The
-            # sibling algorithms' still-live slots are abandoned (the
-            # evicted future is already done; only pending peers are
-            # cancelled, so the wasted-dispatch counter stays honest)
-            self._abandon([f for f in futures if not f.done()])
-            raise HTTPError(
-                503, "shed under overload; retry later",
-                headers=self._shed_headers(),
-            )
-        except Exception:
-            # a genuine serving error feeds the post-promotion watch
-            # (sheds/expiries above don't: they indict load, not the
-            # model) before surfacing to the client untouched
-            self._canary_observe(
-                supplemented, None, time.perf_counter() - t0, ok=False
-            )
-            raise
+            # the snapshot holds the tenant's pool pin (multi-tenant)
+            # for the WHOLE submit→collect span, so eviction can't
+            # close the generation under an in-flight query
+            with self._serving_snapshot(request) as (serving, batchers):
+                supplemented = serving.supplement(query)
+                futures = []
+                try:
+                    for b in batchers:
+                        futures.append(b.submit(supplemented))
+                except BatcherOverloaded:
+                    # queue-depth bound hit: shed immediately instead of
+                    # queueing into a predict-timeout hang. Earlier
+                    # algorithms' accepted submits must not run for
+                    # nothing.
+                    self._abandon(futures)
+                    raise HTTPError(
+                        503, "server overloaded; retry later",
+                        headers=self._shed_headers(),
+                    )
+                except resilience.DeadlineExceeded:
+                    self._abandon(futures)
+                    raise HTTPError(
+                        504, "deadline expired before dispatch"
+                    )
+                except RuntimeError:
+                    # /reload swapped+closed the batchers between our
+                    # snapshot and submit — retry once against the
+                    # fresh set (a re-pin in multi-tenant mode)
+                    self._abandon(futures)
+                    continue
+                try:
+                    prediction = self._serve_one(
+                        serving, query, supplemented, futures
+                    )
+                except resilience.DeadlineExceeded:
+                    # the batcher dropped the slot pre-dispatch: the
+                    # client's budget ran out while the query was queued
+                    raise HTTPError(
+                        504, "deadline expired before device dispatch"
+                    )
+                except BatcherOverloaded:
+                    # a queued slot was evicted by a higher-criticality
+                    # submission while we waited — a shed, not a fault.
+                    # The sibling algorithms' still-live slots are
+                    # abandoned (the evicted future is already done;
+                    # only pending peers are cancelled, so the
+                    # wasted-dispatch counter stays honest)
+                    self._abandon([f for f in futures if not f.done()])
+                    raise HTTPError(
+                        503, "shed under overload; retry later",
+                        headers=self._shed_headers(),
+                    )
+                except Exception:
+                    # a genuine serving error feeds the post-promotion
+                    # watch (sheds/expiries above don't: they indict
+                    # load, not the model) before surfacing to the
+                    # client untouched
+                    self._canary_observe(
+                        supplemented, None,
+                        time.perf_counter() - t0, ok=False,
+                    )
+                    raise
 
-        elapsed = time.perf_counter() - t0
-        with self._lock:
-            self._request_count += 1
-            self._last_serving_sec = elapsed
-            self._avg_serving_sec += (
-                elapsed - self._avg_serving_sec
-            ) / self._request_count
-        self._canary_observe(supplemented, prediction, elapsed, ok=True)
-        return Response(200, prediction)
+                elapsed = time.perf_counter() - t0
+                with self._lock:
+                    self._request_count += 1
+                    self._last_serving_sec = elapsed
+                    self._avg_serving_sec += (
+                        elapsed - self._avg_serving_sec
+                    ) / self._request_count
+                self._canary_observe(
+                    supplemented, prediction, elapsed, ok=True
+                )
+                return Response(200, prediction)
+        raise HTTPError(503, "server is reloading; retry")
 
     def _serve_one(self, serving, query, supplemented, futures,
                    deadline: float | None = None):
@@ -823,20 +1074,45 @@ class EngineServer:
         if not payload:
             return Response(200, [])
         for _attempt in range(2):
-            with self._lock:
-                serving = self._serving
-                batchers = self._batchers
-            entries, any_submitted = self._submit_batch(
-                serving, batchers, payload
-            )
-            if any_submitted or not any(
-                e[0] == "reloading" for e in entries
-            ):
+            # pin (multi-tenant) spans submit AND collection, same as
+            # the single-query route
+            with self._serving_snapshot(request) as (serving, batchers):
+                entries, any_submitted = self._submit_batch(
+                    serving, batchers, payload
+                )
+                if _attempt == 0 and not any_submitted and any(
+                    e[0] == "reloading" for e in entries
+                ):
+                    # a /reload raced us before ANY submit was accepted
+                    # (not even a partial multi-algorithm one): nothing
+                    # was dispatched, so retrying against the fresh
+                    # batchers is safe (mirrors the single-query retry)
+                    continue
+                results = self._collect_batch(
+                    serving, entries, payload, request
+                )
                 break
-            # a /reload raced us before ANY submit was accepted (not
-            # even a partial multi-algorithm one): nothing was
-            # dispatched, so retrying against the fresh batchers is
-            # safe (mirrors the single-query path's retry)
+
+        elapsed = time.perf_counter() - t0
+        n = len(payload)
+        with self._lock:
+            self._request_count += n
+            # wall clock here, per-query mean in its OWN field — the
+            # old code stored elapsed/n into lastServingSec while the
+            # single route stored wall clock (ADVICE r5 semantics mix)
+            self._last_serving_sec = elapsed
+            self._last_batch_per_query_sec = elapsed / n
+            self._avg_serving_sec += (
+                elapsed / n - self._avg_serving_sec
+            ) * n / self._request_count
+        return Response(200, results)
+
+    def _collect_batch(
+        self, serving, entries, payload, request
+    ) -> list[dict]:
+        """Collect a submitted batch's slots into per-query statuses
+        (runs inside the serving snapshot so multi-tenant pins cover
+        the waits)."""
         # one deadline for the WHOLE batch: a hung dispatch must not
         # hold the connection for N sequential predict timeouts
         deadline = time.monotonic() + self._predict_timeout_s
@@ -895,20 +1171,7 @@ class EngineServer:
                     self._post_remote_log(exc, request)
                     logged = True
                 results.append({"status": 500, "message": str(exc)})
-
-        elapsed = time.perf_counter() - t0
-        n = len(payload)
-        with self._lock:
-            self._request_count += n
-            # wall clock here, per-query mean in its OWN field — the
-            # old code stored elapsed/n into lastServingSec while the
-            # single route stored wall clock (ADVICE r5 semantics mix)
-            self._last_serving_sec = elapsed
-            self._last_batch_per_query_sec = elapsed / n
-            self._avg_serving_sec += (
-                elapsed / n - self._avg_serving_sec
-            ) * n / self._request_count
-        return Response(200, results)
+        return results
 
     def _abandon(self, futures) -> None:
         """A slot's accepted per-algorithm submits are being discarded
@@ -984,13 +1247,17 @@ class EngineServer:
             pr_id = prediction.get("prId")
         pr_id = pr_id or secrets.token_hex(16)
         try:
+            with self._lock:
+                instance = self._instance
             event = Event(
                 event="predict",
                 entity_type="pio_pr",
                 entity_id=pr_id,
                 properties=DataMap(
                     {
-                        "engineInstanceId": self._instance.id,
+                        "engineInstanceId": (
+                            instance.id if instance is not None else ""
+                        ),
                         "query": query,
                         "prediction": prediction,
                     }
@@ -1019,6 +1286,8 @@ class EngineServer:
                 raise HTTPError(400, "reload body must be JSON") from None
         if not isinstance(body, dict):
             raise HTTPError(400, "reload body must be a JSON object")
+        if self._tenants is not None:
+            return self._reload_tenant(request, body)
         want_canary = body.get("canary")
         if want_canary is None:
             want_canary = self._canary_config is not None
@@ -1042,6 +1311,44 @@ class EngineServer:
                     },
                 )
             return self._start_canary()
+
+    def _reload_tenant(self, request: Request, body: dict) -> Response:
+        """Per-tenant /reload in multi-tenant mode: restage ONE
+        tenant's variant through the pool. In-flight queries keep the
+        old generation pinned until they drain; everything else is
+        untouched."""
+        tenant = (
+            body.get("tenant")
+            or request.query.get("accessKey")
+            or request.headers.get(admission_mod.TENANT_HEADER)
+            or ""
+        )
+        if not tenant:
+            raise HTTPError(
+                400,
+                'multi-tenant reload requires a tenant (body '
+                '{"tenant": ...}, accessKey param, or '
+                f"{admission_mod.TENANT_HEADER} header)",
+            )
+        if tenant not in self._tenants:
+            raise HTTPError(404, f"unknown tenant {tenant!r}")
+        with self._reload_mutex:
+            try:
+                self._pool.replace(tenant, self._tenant_loader(tenant))
+            except Exception as exc:  # noqa: BLE001 - surfaced as 500
+                raise HTTPError(
+                    500, f"tenant {tenant!r} reload failed: {exc}"
+                ) from exc
+            with self._lock:
+                generation = self._tenant_generations.get(tenant, 0)
+        return Response(
+            200,
+            {
+                "message": "reloaded",
+                "tenant": tenant,
+                "generation": generation,
+            },
+        )
 
     def _cancel_active_canary(self, reason: str) -> None:
         """Resolve a live canary in favor of the CURRENT serving state:
@@ -1223,7 +1530,7 @@ class EngineServer:
                 self._batchers = staged.batchers
                 self._generation += 1
                 generation = self._generation
-            self._generation_gauge.set(generation)
+            self._generation_gauge.labels("").set(generation)
             self._warmed_gauge.set(1 if staged.warmed else 0)
             canary.promoted(retained)
             logger.info(
@@ -1249,7 +1556,7 @@ class EngineServer:
                 self._batchers = retained.batchers
                 self._generation += 1
                 generation = self._generation
-            self._generation_gauge.set(generation)
+            self._generation_gauge.labels("").set(generation)
             self._warmed_gauge.set(1 if retained.warmed else 0)
             canary.finished(canary_mod.ROLLED_BACK)
             self._close_batchers_async(rolled_back.batchers)
@@ -1409,8 +1716,12 @@ class EngineServer:
                     continue
                 for b in gen.batchers:
                     b.close()
-        for b in batchers:
+        for b in batchers or ():
             b.close()
+        if self._pool is not None and self._owns_pool:
+            # pool close drains the loader thread and closes every
+            # resident generation's batchers
+            self._pool.close()
         self._device_sampler.stop()
         self._plugins.close()
         if self._log_queue is not None:

@@ -1164,6 +1164,17 @@ class ServingRouter:
         explicit = request.headers.get(AFFINITY_HEADER)
         if explicit:
             return explicit.encode("utf-8", "replace")
+        # tenant-keyed routing for pooled multi-tenant replicas: one
+        # tenant's traffic lands on one replica (plus ring neighbors on
+        # failover), so each tenant's model stays HOT in ONE pool
+        # instead of faulting into every replica's budget. Resolution
+        # order matches the engine server's (_resolve_tenant).
+        tenant = (
+            request.query.get("accessKey")
+            or request.headers.get(admission.TENANT_HEADER)
+        )
+        if tenant:
+            return f"tenant:{tenant}".encode("utf-8", "replace")
         if request.body:
             return request.body
         return (getattr(request, "client_addr", "") or "").encode()

@@ -168,6 +168,30 @@ if ! timeout -k 10 240 env JAX_PLATFORMS=cpu \
     rc=1
 fi
 
+echo "== serving density bench (multi-tenant model pool, docs/serving.md) =="
+# models-resident x QPS per chip, int8 vs f32 under one byte budget:
+# int8 must hold >= 2x the tenants at goodput parity with the recall
+# gate met — recorded to SERVING_BENCH.json as serving-density/v1.
+# QPS parity is recorded-not-gated when the f32 baseline is degenerate
+# on the runner (< 5 QPS); capacity and recall always gate
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python scripts/serving_bench.py --density --smoke; then
+    echo "serving density bench FAILED"
+    rc=1
+fi
+
+echo "== density smoke test (pooled multi-tenant serving, docs/serving.md) =="
+# 2 pooled 3-tenant replicas behind the router under a budget that
+# forces LRU thrash: tenant-keyed answers stay correct through
+# evictions racing in-flight queries, a SIGKILL'd pooled replica
+# rides through losslessly, and per-tenant /reload bumps only its
+# tenant's generation
+if ! timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    python scripts/density_smoke.py; then
+    echo "density smoke test FAILED"
+    rc=1
+fi
+
 echo "== trainer smoke test (crash-safe continuous training, docs/training.md) =="
 # supervised trainer killed -9 mid-epoch resumes from checkpoint;
 # fold-in freshness recorded to SERVING_BENCH.json; corrupt artifact

@@ -1,0 +1,372 @@
+"""Multi-tenant pool smoke: pooled replicas survive kill -9 and LRU
+eviction racing in-flight queries without losing a request.
+
+Topology: two REAL pooled multi-tenant engine-server replicas
+(tests/pool_replica_child.py — three tenants through a ModelPool whose
+byte budget fits ~ONE tenant table, so every tenant alternation evicts)
+behind an in-process ServingRouter with tenant-keyed affinity. The
+script proves, in order:
+
+1. tenant routing end-to-end: accessKey-keyed queries answer with the
+   RIGHT tenant's model through the router, and the replicas' pool
+   metrics show evictions happening WHILE traffic flows — the
+   eviction-vs-in-flight-query race runs continuously and loses
+   nothing (pins hold the serving generation until the query drains);
+2. SIGKILL of one pooled replica mid-traffic: the tenant-keyed ring
+   fails the dead replica's tenants over to the survivor (which cold-
+   faults them into its own pool), the worker supervisor respawns the
+   victim, and the victim is readmitted once its tenants preload —
+   zero non-200s end to end;
+3. per-tenant /reload through the router path: one tenant's generation
+   advances on one replica, other tenants keep serving.
+
+Run by ``scripts/check.sh`` next to router_smoke.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["PIO_BREAKER_FAILURES"] = "2"
+os.environ["PIO_BREAKER_RESET_S"] = "0.5"
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)  # the package itself (no install required)
+
+from predictionio_tpu.serving import workers  # noqa: E402
+from predictionio_tpu.serving.config import ServerConfig  # noqa: E402
+from predictionio_tpu.serving.router import ServingRouter  # noqa: E402
+
+ADMIN_KEY = "density-smoke-key"
+CHILD = os.path.join(REPO, "tests", "pool_replica_child.py")
+#: tenant → expected algo id (pool_replica_child.ALGO_IDS via TENANTS)
+TENANT_ALGO = {"alice": 1, "bob": 2, "carol": 3}
+
+failures: list[str] = []
+
+
+def check(cond: bool, label: str) -> None:
+    print(("ok   " if cond else "FAIL ") + label, flush=True)
+    if not cond:
+        failures.append(label)
+
+
+def http_json(url, body=None, headers=None, timeout=20, method=None):
+    """(status, parsed body); no raise on 4xx/5xx."""
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(body).encode() if body is not None else None,
+        method=method or ("POST" if body is not None else "GET"),
+        headers=headers or {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+def spawn_replica(name: str, port: int = 0) -> tuple:
+    """(proc, port): a pooled replica child, banner-parsed."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYTHONUNBUFFERED"] = "1"
+    proc = subprocess.Popen(
+        [sys.executable, CHILD, "--port", str(port),
+         "--generation", name, "--delay-ms", "5"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    bound: list[int] = []
+
+    def _scan():
+        for line in proc.stdout:
+            if "listening on" in line and not bound:
+                bound.append(
+                    int(line.split("pid=")[0].rsplit(":", 1)[1])
+                )
+        # keep draining so request logs can't block the child
+
+    threading.Thread(target=_scan, daemon=True).start()
+    deadline = time.monotonic() + 120
+    while not bound and time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(f"replica {name} died at startup")
+        time.sleep(0.1)
+    if not bound:
+        proc.kill()
+        raise RuntimeError(f"replica {name} never printed its port")
+    return proc, bound[0]
+
+
+def wait_states(base: str, want: dict, deadline_s: float = 120) -> bool:
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        _, status = http_json(f"{base}/")
+        states = {
+            r["id"]: r["state"] for r in status.get("replicas", [])
+        }
+        if all(states.get(rid) == s for rid, s in want.items()):
+            return True
+        time.sleep(0.2)
+    return False
+
+
+def pool_evictions(replica_base: str) -> int:
+    """Sum of pio_pool_evictions_total across tenants on one replica."""
+    try:
+        _, data = http_json(f"{replica_base}/metrics.json", timeout=5)
+    except OSError:
+        return 0
+    samples = data.get("pio_pool_evictions_total", {}).get(
+        "samples", ()
+    )
+    return int(
+        sum(s.get("value", s.get("count", 0)) for s in samples)
+    )
+
+
+def metric_value(base: str, name: str, **labels):
+    _, data = http_json(f"{base}/metrics.json")
+    if "federation" in data:
+        data = data.get("local", {})
+    for sample in data.get(name, {}).get("samples", ()):
+        if all(
+            sample["labels"].get(k) == v for k, v in labels.items()
+        ):
+            return sample.get("value", sample.get("count"))
+    return None
+
+
+class Traffic:
+    """Closed-loop tenant-keyed query generators; every outcome is
+    recorded with the tenant that issued it so answers are provable."""
+
+    def __init__(self, base: str, threads: int = 3):
+        self.base = base
+        self.stop = threading.Event()
+        self.outcomes: list[tuple[str, int, dict | None]] = []
+        self._lock = threading.Lock()
+        self._threads = [
+            threading.Thread(target=self._run, args=(i,), daemon=True)
+            for i in range(threads)
+        ]
+
+    def _run(self, seed: int) -> None:
+        tenants = list(TENANT_ALGO)
+        i = seed
+        while not self.stop.is_set():
+            i += 1
+            tenant = tenants[i % len(tenants)]
+            try:
+                status, body = http_json(
+                    f"{self.base}/queries.json?accessKey={tenant}",
+                    {"x": i % 100},
+                    headers={"X-PIO-Deadline": "15000"},
+                    timeout=20,
+                )
+            except OSError as e:
+                status, body = -1, {"error": str(e)}
+            with self._lock:
+                self.outcomes.append((tenant, status, body))
+
+    def start(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def finish(self) -> list:
+        self.stop.set()
+        for t in self._threads:
+            t.join(timeout=30)
+        with self._lock:
+            return list(self.outcomes)
+
+
+def wrong_answers(outcomes) -> list:
+    """Outcomes whose status or tenant-model pairing is wrong."""
+    bad = []
+    for tenant, status, body in outcomes:
+        if status != 200:
+            bad.append((tenant, status, body))
+            continue
+        expected = TENANT_ALGO[tenant] * 1000
+        result = (body or {}).get("result", -1)
+        if result // 1000 * 1000 != expected:
+            bad.append((tenant, status, body))
+    return bad
+
+
+def spawn_and_adopt(name: str, port: int, procs: dict):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYTHONUNBUFFERED"] = "1"
+    proc = subprocess.Popen(
+        [sys.executable, CHILD, "--port", str(port),
+         "--generation", "a2", "--delay-ms", "5"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    procs[name] = proc
+    return proc
+
+
+def main() -> int:
+    procs: dict[str, subprocess.Popen] = {}
+    stopping = threading.Event()
+    router = None
+    http = None
+    try:
+        print("starting 2 pooled multi-tenant replicas...", flush=True)
+        proc_a, port_a = spawn_replica("a1")
+        proc_b, port_b = spawn_replica("b1")
+        procs["a"], procs["b"] = proc_a, proc_b
+        rep_a = f"http://127.0.0.1:{port_a}"
+        rep_b = f"http://127.0.0.1:{port_b}"
+
+        config = ServerConfig(
+            key_auth_enforced=True, access_key=ADMIN_KEY
+        )
+        router = ServingRouter(
+            probe_interval_s=0.2,
+            probe_timeout_s=2.0,
+            unhealthy_after=1,
+            failover_retries=1,
+            proxy_timeout_s=20.0,
+            server_config=config,
+        )
+        http = router.serve(host="127.0.0.1", port=0)
+        http.start()
+        base = f"http://127.0.0.1:{http.port}"
+        key_hdr = {"X-PIO-Server-Key": ADMIN_KEY}
+        for rid, url in (("a", rep_a), ("b", rep_b)):
+            status, _ = http_json(
+                f"{base}/admin/replicas",
+                {"id": rid, "url": url, "generation": "g1"},
+                headers=key_hdr,
+            )
+            check(status == 201, f"replica {rid} registered")
+        check(
+            wait_states(base, {"a": "healthy", "b": "healthy"}),
+            "both pooled replicas admitted after tenant preload",
+        )
+
+        # -- 1: eviction races in-flight queries, losslessly ----------
+        ev_before = pool_evictions(rep_a) + pool_evictions(rep_b)
+        traffic = Traffic(base).start()
+        time.sleep(3.0)
+        outcomes = traffic.finish()
+        bad = wrong_answers(outcomes)
+        check(
+            len(outcomes) > 10,
+            f"tenant traffic flowed ({len(outcomes)} requests; "
+            "most fault a cold tenant stage, which is the point)",
+        )
+        check(
+            not bad,
+            f"all {len(outcomes)} tenant-keyed answers correct "
+            f"(bad={bad[:3]})",
+        )
+        ev_during = (
+            pool_evictions(rep_a) + pool_evictions(rep_b) - ev_before
+        )
+        check(
+            ev_during > 0,
+            f"pool evicted WHILE traffic flowed ({ev_during} "
+            "evictions) — the eviction/in-flight race ran",
+        )
+
+        # -- 2: SIGKILL a pooled replica mid-traffic -------------------
+        slot = workers.WorkerSlot(
+            lambda: spawn_and_adopt("a-respawn", port_a, procs),
+            proc=proc_a,
+        )
+        supervisor = threading.Thread(
+            target=workers.supervise_children,
+            args=([slot], stopping),
+            kwargs={"poll_interval_s": 0.2},
+            daemon=True,
+        )
+        supervisor.start()
+        traffic = Traffic(base).start()
+        time.sleep(1.5)
+        print(f"SIGKILL pooled replica a (pid {proc_a.pid})", flush=True)
+        os.kill(proc_a.pid, signal.SIGKILL)
+        time.sleep(4.0)  # traffic rides through the outage + respawn
+        outcomes = traffic.finish()
+        bad = wrong_answers(outcomes)
+        check(
+            len(outcomes) > 10,
+            f"traffic flowed through the kill ({len(outcomes)})",
+        )
+        check(
+            not bad,
+            f"zero lost/wrong answers through SIGKILL "
+            f"({len(outcomes)} requests, bad={bad[:3]})",
+        )
+        failovers = metric_value(base, "pio_router_failovers_total")
+        check(
+            (failovers or 0) > 0,
+            f"pio_router_failovers_total > 0 (={failovers})",
+        )
+        check(
+            wait_states(base, {"a": "healthy"}, deadline_s=120),
+            "killed pooled replica respawned and readmitted once its "
+            "tenants preloaded",
+        )
+        stopping.set()
+        supervisor.join(timeout=5)
+
+        # -- 3: per-tenant reload keeps the other tenants serving ------
+        status, body = http_json(
+            f"{rep_b}/reload", {"tenant": "bob"}
+        )
+        check(
+            status == 200 and body.get("generation", 0) >= 2,
+            f"per-tenant reload advanced bob's generation ({body})",
+        )
+        status, body = http_json(
+            f"{rep_b}/queries.json?accessKey=alice", {"x": 3}
+        )
+        check(
+            status == 200 and body["result"] == 1003,
+            "alice unaffected by bob's reload",
+        )
+        _, rep_status = http_json(f"{rep_b}/")
+        check(
+            rep_status.get("multiTenant") is True
+            and rep_status.get("pool", {}).get("budgetBytes", 0) > 0,
+            "replica status reports the pool "
+            f"(pool={rep_status.get('pool')})",
+        )
+    finally:
+        stopping.set()
+        if http is not None:
+            http.shutdown()
+        if router is not None:
+            router.close()
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+
+    if failures:
+        print(
+            f"density_smoke: FAILED ({len(failures)}): "
+            + "; ".join(failures),
+            file=sys.stderr,
+        )
+        return 1
+    print("density_smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,8 +57,23 @@ kept) so serving-tier scaling claims cite recorded numbers, not one-off
 stdout. ``--smoke`` shrinks the run for CI (scripts/check.sh wires it
 in); ``--out ''`` disables persistence.
 
-No jax import — this exercises the batcher pipeline itself, so it
-runs in seconds on any CPU-only runner.
+**Density mode** (``--density``): the multi-tenant model-pool proof,
+measured as models-resident × aggregate QPS per chip. N synthetic
+tenants' factor tables are served through a byte-budgeted
+:class:`~predictionio_tpu.serving.modelpool.ModelPool` twice — f32
+tables, then per-row int8 (``ops/quantize``) — under a skewed tenant
+mix. Gates: int8 fits ≥2× the f32 tenant count in the SAME byte budget
+(deterministic byte math, hard), int8 recall@k against the f32 ranking
+stays above the floor (hard), and aggregate QPS holds goodput parity
+(gated with a recorded-not-gated degenerate escape when the runner
+itself collapses). The dequantizing Pallas kernel is timed against the
+jitted XLA fallback and recorded labeled with ``interpret`` — on CPU
+the kernel runs in interpreter mode, so that latency is recorded for
+trend only, never gated. Lands in SERVING_BENCH.json as a
+``serving-density/v1`` record.
+
+No jax import outside ``--density`` — the pipeline modes exercise the
+batcher itself, so they run in seconds on any CPU-only runner.
 """
 
 from __future__ import annotations
@@ -734,6 +749,242 @@ def ramp_main(args) -> int:
     return 0
 
 
+def density_main(args) -> int:
+    """``--density``: models-resident × aggregate QPS under one pool
+    byte budget, f32 vs int8 — the multi-tenant capacity claim as a
+    recorded number (serving-density/v1)."""
+    import numpy as np  # noqa: PLC0415 - density-only deps
+    import jax
+    import jax.numpy as jnp
+
+    from predictionio_tpu.ops import quantize, similarity
+    from predictionio_tpu.ops.pallas_topk import fused_top_k_dot
+    from predictionio_tpu.serving.modelpool import ModelPool
+
+    n_tenants = args.density_tenants or (12 if args.smoke else 16)
+    n_items = args.density_items or (3000 if args.smoke else 20000)
+    k_dim = 32
+    topk = 10
+    batch = 8
+    requests = args.requests or (240 if args.smoke else 1200)
+    min_capacity = args.density_min_capacity
+    recall_floor = args.density_recall_floor
+    parity_floor = args.density_parity_floor
+
+    rng = np.random.default_rng(0)
+    tables = {
+        f"t{i}": rng.standard_normal((n_items, k_dim)).astype(
+            np.float32
+        )
+        for i in range(n_tenants)
+    }
+    f32_bytes = n_items * k_dim * 4
+    # a budget that fits ~2.5 f32 tenants: small enough that f32
+    # thrashes under the mix, big enough that int8 (~0.26x) holds most
+    # of the tenant set resident
+    budget = int(2.5 * f32_bytes)
+    # skewed tenant mix (weight ∝ 1/rank): the shape multi-tenant
+    # traffic actually has — LRU keeps the head hot, the tail faults
+    weights = 1.0 / (1.0 + np.arange(n_tenants))
+    weights /= weights.sum()
+    sequence = rng.choice(n_tenants, size=requests, p=weights)
+    queries = jnp.asarray(
+        rng.standard_normal((batch, k_dim)).astype(np.float32)
+    )
+
+    def loader_for(name: str, mode: str):
+        def load():
+            t = tables[name]
+            if mode == "f32":
+                staged = similarity.stage_factors(jnp.asarray(t))
+                return staged, int(staged.size) * 4, None
+            qf = quantize.stage_quantized(
+                quantize.quantize_factors(t, mode)
+            )
+            return qf, qf.nbytes, None
+
+        return load
+
+    def run_pass(mode: str) -> dict:
+        pool = ModelPool(budget_bytes=budget)
+        try:
+            # capacity: cycle every tenant once; what stays resident
+            # is the budget's tenant count for this precision
+            for name in tables:
+                with pool.pin(name, loader_for(name, mode)):
+                    pass
+            resident = pool.stats()["tenantsResident"]
+            # warm the jitted top-k (compile outside the timed window)
+            with pool.pin("t0", loader_for("t0", mode)) as table:
+                jax.block_until_ready(
+                    similarity.top_k_dot(queries, table, topk)[1]
+                )
+            t0 = time.perf_counter()
+            for idx in sequence:
+                name = f"t{int(idx)}"
+                with pool.pin(name, loader_for(name, mode)) as table:
+                    jax.block_until_ready(
+                        similarity.top_k_dot(queries, table, topk)[1]
+                    )
+            elapsed = time.perf_counter() - t0
+            stats = pool.stats()
+            qps = round(requests / elapsed, 1)
+            return {
+                "mode": mode,
+                "tenants_resident": resident,
+                "per_tenant_bytes": (
+                    stats["residentBytes"] // max(1, resident)
+                ),
+                "qps": qps,
+                "density": round(resident * qps, 1),
+                "evictions": stats["evictions"],
+                "elapsed_s": round(elapsed, 3),
+            }
+        finally:
+            pool.close()
+
+    print(
+        f"serving_bench --density: {n_tenants} tenants x "
+        f"[{n_items}, {k_dim}] f32, budget {budget} B "
+        f"(~2.5 f32 tables), {requests} requests, batch {batch}"
+    )
+    f32 = run_pass("f32")
+    print(f"  f32 : {f32}")
+    int8 = run_pass("int8")
+    print(f"  int8: {int8}")
+
+    # recall@k of the int8 ranking against the f32 ranking on the
+    # hottest tenant, over a bigger probe batch for a stable estimate
+    probe = jnp.asarray(
+        rng.standard_normal((64, k_dim)).astype(np.float32)
+    )
+    t0_table = jnp.asarray(tables["t0"])
+    qf0 = quantize.quantize_factors(tables["t0"], "int8")
+    _, i_ref = similarity.top_k_dot(probe, t0_table, topk)
+    _, i_q = similarity.top_k_dot(probe, qf0, topk)
+    recall = round(quantize.recall_at_k(i_ref, i_q), 4)
+
+    # dequantizing Pallas kernel vs the jitted XLA fallback, recorded
+    # labeled with interpret: on CPU the kernel runs interpreted
+    # (orders slower — trend data, never a gate); on TPU it's the real
+    # fused path
+    backend = jax.default_backend()
+    interpret = backend != "tpu"
+    kernel_items = min(n_items, 1024) if interpret else n_items
+    kq = qf0.data[:kernel_items]
+    kscale = qf0.scale[:kernel_items]
+
+    def timed(fn, iters):
+        jax.block_until_ready(fn())  # warm/compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            jax.block_until_ready(fn())
+        return round((time.perf_counter() - t0) / iters * 1000.0, 3)
+
+    kernel_ms = timed(
+        lambda: fused_top_k_dot(
+            queries, kq, topk, block=512, interpret=interpret,
+            scale=kscale,
+        )[1],
+        2 if interpret else 20,
+    )
+    xla_ms = timed(
+        lambda: quantize._top_k_dot_quant_xla(
+            queries, kq, kscale, topk
+        )[1],
+        20,
+    )
+    kernel_vs_jit = {
+        "pallas_ms": kernel_ms,
+        "xla_ms": xla_ms,
+        "interpret": interpret,
+        "backend": backend,
+        "n_items": kernel_items,
+    }
+    print(f"  recall@{topk}: {recall}  kernel_vs_jit: {kernel_vs_jit}")
+
+    capacity_ratio = round(
+        int8["tenants_resident"] / max(1, f32["tenants_resident"]), 3
+    )
+    parity = round(int8["qps"] / max(1e-9, f32["qps"]), 3)
+    failures: list[str] = []
+    degenerate = ""
+    if f32["qps"] < 5.0:
+        # the runner itself collapsed (shared-CI noise): the parity
+        # comparison would measure the harness, not the pool. The
+        # capacity and recall gates are deterministic and still hold.
+        degenerate = (
+            f"f32 pass served only {f32['qps']} req/s — runner, not "
+            "pool, saturated; parity gate skipped"
+        )
+        print(
+            f"serving_bench --density: degenerate run ({degenerate})",
+            file=sys.stderr,
+        )
+    if capacity_ratio < min_capacity:
+        failures.append(
+            f"int8 fit only {capacity_ratio}x the f32 tenant count "
+            f"in the same budget (< {min_capacity}x)"
+        )
+    if recall < recall_floor:
+        failures.append(
+            f"int8 recall@{topk} {recall} below the "
+            f"{recall_floor} floor against the f32 ranking"
+        )
+    if not degenerate and parity < parity_floor:
+        failures.append(
+            f"int8 aggregate QPS {int8['qps']} is {parity}x f32's "
+            f"{f32['qps']} (< {parity_floor}x: goodput parity lost)"
+        )
+
+    record = {
+        "metric": "serving_density",
+        "record": "serving-density/v1",
+        "value": capacity_ratio,
+        "unit": "x",
+        "extra": {
+            "f32": f32,
+            "int8": int8,
+            "budget_bytes": budget,
+            "capacity_ratio": capacity_ratio,
+            "qps_parity": parity,
+            "recall_at_k": recall,
+            "topk": topk,
+            "kernel_vs_jit": kernel_vs_jit,
+            "params": {
+                "tenants": n_tenants,
+                "n_items": n_items,
+                "k_dim": k_dim,
+                "batch": batch,
+                "requests": requests,
+                "min_capacity": min_capacity,
+                "recall_floor": recall_floor,
+                "parity_floor": parity_floor,
+                "smoke": args.smoke,
+            },
+        },
+    }
+    if degenerate:
+        record["extra"]["degenerate"] = degenerate
+    if failures:
+        record["error"] = failures
+    if args.out:
+        persist_record(record, args.out)
+    print(json.dumps(record))
+    if failures:
+        print(
+            "serving_bench --density: FAILED: " + "; ".join(failures),
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"serving_bench --density: int8 holds {capacity_ratio}x the "
+        f"f32 tenant count (recall@{topk} {recall}, QPS parity "
+        f"{parity}x) — ok"
+    )
+    return 0
+
+
 def persist_record(record: dict, out_path: str) -> None:
     """Append the run to the stable serving-bench trajectory file
     (schema serving-bench/v1), mirroring how the training bench's
@@ -795,6 +1046,28 @@ def main() -> int:
     ap.add_argument("--ramp-phase-s", dest="ramp_phase_s", type=float,
                     default=None,
                     help="seconds per ramp phase (default 6 smoke, 12)")
+    ap.add_argument("--density", action="store_true",
+                    help="run ONLY the multi-tenant model-pool density "
+                         "bench: models-resident x aggregate QPS under "
+                         "one byte budget, f32 vs int8 quantized "
+                         "tables (docs/serving.md 'Multi-tenant "
+                         "serving')")
+    ap.add_argument("--density-tenants", type=int, default=None,
+                    help="synthetic tenant count (default 12 smoke, "
+                         "16)")
+    ap.add_argument("--density-items", type=int, default=None,
+                    help="catalog rows per tenant (default 3000 "
+                         "smoke, 20000)")
+    ap.add_argument("--density-min-capacity", type=float, default=2.0,
+                    help="hard floor on int8/f32 resident-tenant "
+                         "ratio in the same byte budget")
+    ap.add_argument("--density-recall-floor", type=float, default=0.9,
+                    help="hard floor on int8 recall@k against the f32 "
+                         "ranking")
+    ap.add_argument("--density-parity-floor", type=float, default=0.6,
+                    help="int8 aggregate QPS as a fraction of f32's "
+                         "(goodput parity; skipped on a degenerate "
+                         "runner, recorded either way)")
     ap.add_argument("--out", default=os.path.join(
                         REPO, "SERVING_BENCH.json"),
                     help="append the run record to this trajectory "
@@ -803,6 +1076,8 @@ def main() -> int:
 
     if args.ramp:
         return ramp_main(args)
+    if args.density:
+        return density_main(args)
 
     total = args.requests or (2000 if args.smoke else 8000)
     idle_n = args.idle_requests or (80 if args.smoke else 200)

@@ -1,0 +1,182 @@
+"""A multi-tenant POOLED engine-server replica for density smoke/tests.
+
+Like :mod:`router_replica_child`, but one process serves THREE tenants
+(``alice``/``bob``/``carol`` → distinct engine variants) through a
+byte-budgeted :class:`~predictionio_tpu.serving.modelpool.ModelPool`.
+Each tenant's model carries a real numpy table so ``--budget`` bites:
+a small budget forces LRU evictions DURING traffic, which is exactly
+the race the smoke proves lossless (pins hold the in-flight
+generation; a faulted tenant reloads on its next query).
+
+Predictions carry the tenant's algo id, the replica ``generation``,
+and ``pid`` so a caller can prove which replica and which tenant model
+answered.
+
+Usage (spawned by scripts/density_smoke.py):
+
+    python tests/pool_replica_child.py --port 0 --generation g1 \
+        [--budget BYTES] [--delay-ms 5] [--no-warmup]
+
+Prints ``replica listening on 127.0.0.1:<port> pid=<pid>`` once bound.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+
+import numpy as np  # noqa: E402
+
+from fake_engine import (  # noqa: E402
+    FakeAlgorithm,
+    FakeDataSource,
+    FakeParams,
+    FakePreparator,
+)
+from predictionio_tpu.core import Engine, EngineParams, Serving  # noqa: E402
+from predictionio_tpu.core.workflow import run_train  # noqa: E402
+from predictionio_tpu.data.storage import Storage  # noqa: E402
+from predictionio_tpu.parallel.mesh import ComputeContext  # noqa: E402
+from predictionio_tpu.serving import resilience  # noqa: E402
+from predictionio_tpu.serving.engine_server import EngineServer  # noqa: E402
+
+#: tenant → engine variant; algo ids make answers tenant-provable
+TENANTS = {"alice": "va", "bob": "vb", "carol": "vc"}
+ALGO_IDS = {"va": 1, "vb": 2, "vc": 3}
+#: bytes each tenant's model table occupies (the pool charges these)
+TABLE_BYTES = 16 * 1024
+
+
+@dataclasses.dataclass
+class PooledModel:
+    algo_id: int
+    table: np.ndarray  # nonzero nbytes so the pool budget bites
+
+
+def build_replica(
+    generation: str,
+    budget_bytes: int,
+    delay_ms: float = 0.0,
+    warmup: bool = True,
+    registry=None,
+) -> EngineServer:
+    """A pooled multi-tenant EngineServer over the fake pipeline;
+    importable in-process by tests too."""
+
+    class PooledAlgorithm(FakeAlgorithm):
+        def train(self, ctx, pd):
+            return PooledModel(
+                algo_id=self.params.id,
+                table=np.zeros(TABLE_BYTES // 4, np.float32),
+            )
+
+        def predict(self, model, query):
+            if delay_ms:
+                time.sleep(delay_ms / 1000.0)
+            q = query if isinstance(query, dict) else {}
+            return {
+                "result": model.algo_id * 1000 + int(q.get("x", 0))
+            }
+
+        def batch_predict(self, model, queries):
+            return [self.predict(model, q) for q in queries]
+
+    class PooledServing(Serving):
+        params_class = FakeParams
+
+        def serve(self, query, predictions):
+            return {
+                **predictions[0],
+                "generation": generation,
+                "pid": os.getpid(),
+            }
+
+    storage = Storage(
+        env={
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        }
+    )
+    engine = Engine(
+        FakeDataSource, FakePreparator, PooledAlgorithm, PooledServing
+    )
+    ctx = ComputeContext.create(batch=f"pool-replica-{generation}")
+
+    def params(algo_id: int) -> EngineParams:
+        return EngineParams(
+            data_source=("", FakeParams(id=1)),
+            preparator=("", FakeParams(id=2)),
+            algorithms=[("", FakeParams(id=algo_id))],
+            serving=("", FakeParams()),
+        )
+
+    for variant, algo_id in ALGO_IDS.items():
+        run_train(
+            engine, params(algo_id), engine_id="pool-replica",
+            ctx=ctx, storage=storage, engine_variant=variant,
+        )
+    from predictionio_tpu.serving.modelpool import ModelPool
+
+    kwargs = {}
+    if registry is not None:
+        kwargs["registry"] = registry
+        kwargs["pool"] = ModelPool(
+            budget_bytes=budget_bytes, registry=registry
+        )
+    else:
+        os.environ["PIO_POOL_BUDGET_BYTES"] = str(budget_bytes)
+    return EngineServer(
+        engine,
+        params(1),
+        engine_id="pool-replica",
+        storage=storage,
+        ctx=ctx,
+        warmup=warmup,
+        tenants=TENANTS,
+        max_wait_ms=1.0,
+        **kwargs,
+    )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--generation", default="g1")
+    # budget fits ~1.2 tenant tables: alternating tenants evict
+    ap.add_argument("--budget", type=int, default=20_000)
+    ap.add_argument("--delay-ms", type=float, default=0.0)
+    ap.add_argument("--no-warmup", action="store_true")
+    args = ap.parse_args()
+
+    server = build_replica(
+        args.generation,
+        budget_bytes=args.budget,
+        delay_ms=args.delay_ms,
+        warmup=not args.no_warmup,
+    )
+    http = server.serve(host="127.0.0.1", port=args.port)
+    print(
+        f"replica listening on 127.0.0.1:{http.port} pid={os.getpid()}",
+        flush=True,
+    )
+    resilience.install_signal_drain(http)
+    try:
+        http.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -659,6 +659,30 @@ class TestDeployFlags:
         )
         assert code != 0 and "max-wait-ms" in err
 
+    def test_tenant_parser_flags(self):
+        from predictionio_tpu.cli.main import build_parser
+
+        args = build_parser().parse_args([
+            "deploy", "--tenant", "alice=va", "--tenant", "bob=vb",
+            "--pool-budget-bytes", "1048576", "--quantize", "int8",
+        ])
+        assert args.tenant == ["alice=va", "bob=vb"]
+        assert args.pool_budget_bytes == 1048576
+        assert args.quantize == "int8"
+
+    def test_tenant_bad_spec_rejected(self, cli):
+        code, _out, err = cli(
+            "deploy", "--variant", "nope.json", "--tenant", "noequals"
+        )
+        assert code != 0 and "NAME=VARIANT" in err
+
+    def test_tenant_canary_mutually_exclusive(self, cli):
+        code, _out, err = cli(
+            "deploy", "--variant", "nope.json",
+            "--tenant", "alice=va", "--canary",
+        )
+        assert code != 0 and "mutually exclusive" in err
+
 
 class TestFleetCLI:
     def test_router_parser_fleet_flags(self):
@@ -893,3 +917,88 @@ class TestObservabilityCLI:
             with pytest.raises((ValueError, tarfile.TarError)):
                 _safe_extract(tar, str(dest))
         assert not (tmp_path / "escaped.txt").exists()
+
+
+class TestPoolCLI:
+    """ISSUE 17: the multi-tenant model-pool status line."""
+
+    def test_pool_summary_line_formats(self):
+        from predictionio_tpu.cli.main import _pool_summary_line
+
+        line = _pool_summary_line(
+            {
+                "pio_pool_budget_bytes": {
+                    "samples": [{"labels": {}, "value": 20000}]
+                },
+                "pio_pool_tenants_resident": {
+                    "samples": [{"labels": {}, "value": 1}]
+                },
+                "pio_pool_resident_bytes": {
+                    "samples": [
+                        {"labels": {"tenant": "alice"}, "value": 16384}
+                    ]
+                },
+                "pio_pool_hits_total": {
+                    "samples": [
+                        {"labels": {"tenant": "alice"}, "value": 7},
+                        {"labels": {"tenant": "bob"}, "value": 0},
+                    ]
+                },
+                "pio_pool_misses_total": {
+                    "samples": [
+                        {"labels": {"tenant": "alice"}, "value": 1}
+                    ]
+                },
+                "pio_pool_evictions_total": {
+                    "samples": [
+                        {"labels": {"tenant": "bob"}, "value": 19}
+                    ]
+                },
+            }
+        )
+        assert line == (
+            "pool: tenantsResident=1 bytes=16384/20000 "
+            "hitRate=0.88 evictions=19"
+        )
+        # no pool series scraped → no line (single-tenant server)
+        assert _pool_summary_line({}) is None
+        # a pool with no lookups yet omits the hit rate
+        cold = _pool_summary_line(
+            {
+                "pio_pool_budget_bytes": {
+                    "samples": [{"labels": {}, "value": 100}]
+                }
+            }
+        )
+        assert cold == "pool: tenantsResident=0 bytes=0/100 evictions=0"
+
+    def test_status_metrics_url_prints_pool_line(self, cli):
+        import sys as _sys
+
+        _sys.path.insert(
+            0, str(__import__("pathlib").Path(__file__).parent)
+        )
+        from pool_replica_child import build_replica
+
+        from predictionio_tpu.obs import MetricRegistry
+
+        server = build_replica(
+            "gcli", budget_bytes=200_000, warmup=False,
+            registry=MetricRegistry(),
+        )
+        http = server.serve(host="127.0.0.1", port=0)
+        http.start()
+        try:
+            code, out, _ = cli(
+                "status", "--metrics-url",
+                f"http://127.0.0.1:{http.port}",
+            )
+            assert code == 0
+            assert "pool: tenantsResident=" in out
+            assert "pio_pool_budget_bytes" in out
+        finally:
+            server.close()
+            # build_replica hands the server an externally-owned pool;
+            # close it here or its loader + batcher threads outlive us
+            server._pool.close()
+            http.shutdown()

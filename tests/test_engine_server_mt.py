@@ -1,0 +1,316 @@
+"""Multi-tenant engine server: one process, N engine variants behind
+the device model pool — tenant routing (accessKey / X-PIO-Tenant),
+per-tenant reload generations, eviction racing in-flight queries, the
+pool-backed status surface, and labeled freshness gauges."""
+
+import dataclasses
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from fake_engine import (
+    FakeAlgorithm,
+    FakeDataSource,
+    FakeParams,
+    FakePreparator,
+    FakeServing,
+)
+from predictionio_tpu.core import Engine, EngineParams
+from predictionio_tpu.core.workflow import run_train
+from predictionio_tpu.obs.registry import MetricRegistry
+from predictionio_tpu.parallel.mesh import ComputeContext
+from predictionio_tpu.serving.engine_server import EngineServer
+from predictionio_tpu.serving.modelpool import ModelPool
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return ComputeContext.create(batch="srv-mt-test")
+
+
+def _call(url, method="GET", body=None, headers=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method, headers=headers or {}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+class DictQueryAlgorithm(FakeAlgorithm):
+    def predict(self, model, query):
+        return {"result": model.algo_id * 10 + int(query.get("x", 0))}
+
+    def batch_predict(self, model, queries):
+        return [self.predict(model, q) for q in queries]
+
+
+class DictServing(FakeServing):
+    def serve(self, query, predictions):
+        return predictions[0]
+
+
+def _engine():
+    return Engine(
+        FakeDataSource, FakePreparator, DictQueryAlgorithm, DictServing
+    )
+
+
+def _params(algo_id):
+    return EngineParams(
+        data_source=("", FakeParams(id=1)),
+        preparator=("", FakeParams(id=2)),
+        algorithms=[("", FakeParams(id=algo_id))],
+        serving=("", FakeParams()),
+    )
+
+
+TENANTS = {"alice": "va", "bob": "vb"}
+ALGO_IDS = {"va": 3, "vb": 7}
+
+
+def _train_variants(ctx, storage):
+    for variant, algo_id in ALGO_IDS.items():
+        run_train(
+            _engine(), _params(algo_id), engine_id="srv-mt", ctx=ctx,
+            storage=storage, engine_variant=variant,
+        )
+
+
+@pytest.fixture()
+def mt_server(ctx, memory_storage):
+    _train_variants(ctx, memory_storage)
+    registry = MetricRegistry()
+    es = EngineServer(
+        _engine(),
+        # params here are the single-tenant fallback config; each
+        # tenant's stage loads its own trained variant
+        _params(3),
+        engine_id="srv-mt",
+        storage=memory_storage,
+        ctx=ctx,
+        registry=registry,
+        tenants=TENANTS,
+    )
+    http = es.serve(host="127.0.0.1", port=0)
+    http.start()
+    yield f"http://127.0.0.1:{http.port}", es, registry, memory_storage
+    http.shutdown()
+    es.close()
+
+
+class TestTenantRouting:
+    def test_access_key_param_routes_to_variant(self, mt_server):
+        base, _, _, _ = mt_server
+        status, body = _call(
+            f"{base}/queries.json?accessKey=alice", "POST", {"x": 2}
+        )
+        assert status == 200
+        assert body["result"] == 32  # variant va: algo_id 3
+        status, body = _call(
+            f"{base}/queries.json?accessKey=bob", "POST", {"x": 2}
+        )
+        assert status == 200
+        assert body["result"] == 72  # variant vb: algo_id 7
+
+    def test_tenant_header_routes(self, mt_server):
+        base, _, _, _ = mt_server
+        status, body = _call(
+            f"{base}/queries.json", "POST", {"x": 5},
+            headers={"X-PIO-Tenant": "bob"},
+        )
+        assert status == 200
+        assert body["result"] == 75
+
+    def test_missing_tenant_400_unknown_404(self, mt_server):
+        base, _, _, _ = mt_server
+        status, body = _call(f"{base}/queries.json", "POST", {"x": 1})
+        assert status == 400
+        assert "X-PIO-Tenant" in body["message"]
+        status, body = _call(
+            f"{base}/queries.json?accessKey=mallory", "POST", {"x": 1}
+        )
+        assert status == 404
+
+    def test_batch_queries_per_tenant(self, mt_server):
+        base, _, _, _ = mt_server
+        status, body = _call(
+            f"{base}/batch/queries.json?accessKey=alice",
+            "POST",
+            [{"x": 0}, {"x": 1}, "bogus"],
+        )
+        assert status == 200
+        assert [r["status"] for r in body] == [200, 200, 400]
+        assert body[0]["prediction"]["result"] == 30
+        assert body[1]["prediction"]["result"] == 31
+
+
+class TestStatusAndMetrics:
+    def test_status_shows_pool_and_tenants(self, mt_server):
+        base, _, _, _ = mt_server
+        # touch one tenant so the pool has stats to show
+        _call(f"{base}/queries.json?accessKey=alice", "POST", {"x": 0})
+        status, body = _call(f"{base}/")
+        assert status == 200
+        assert body["multiTenant"] is True
+        assert body["tenants"] == ["alice", "bob"]
+        assert "engineInstanceId" not in body
+        assert body["pool"]["budgetBytes"] > 0
+        assert "alice" in body["pool"]["tenants"]
+        assert body["tenantGenerations"]["alice"] >= 1
+
+    def test_status_html_renders_without_instance(self, mt_server):
+        base, _, _, _ = mt_server
+        req = urllib.request.Request(
+            f"{base}/", headers={"Accept": "text/html"}
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            page = resp.read().decode()
+        assert "alice, bob" in page
+
+    def test_labeled_generation_and_age_gauges(self, mt_server):
+        base, _, registry, _ = mt_server
+        _call(f"{base}/queries.json?accessKey=bob", "POST", {"x": 0})
+        text = registry.render_prometheus()
+        assert 'pio_model_generation{tenant="alice"} 1' in text
+        assert 'pio_model_generation{tenant="bob"} 1' in text
+        assert 'pio_model_age_seconds{tenant="bob"}' in text
+        assert 'pio_pool_misses_total{tenant="alice"} 1' in text
+
+    def test_per_tenant_reload_advances_generation(self, mt_server):
+        base, _, registry, storage = mt_server
+        # retrain alice's variant, then reload just her
+        run_train(
+            _engine(), _params(ALGO_IDS["va"]), engine_id="srv-mt",
+            ctx=mt_server[1]._ctx, storage=storage,
+            engine_variant="va",
+        )
+        status, body = _call(
+            f"{base}/reload", "POST", {"tenant": "alice"}
+        )
+        assert status == 200
+        assert body["tenant"] == "alice"
+        assert body["generation"] == 2
+        text = registry.render_prometheus()
+        assert 'pio_model_generation{tenant="alice"} 2' in text
+        assert 'pio_model_generation{tenant="bob"} 1' in text
+        # alice still serves after the swap
+        status, resp = _call(
+            f"{base}/queries.json?accessKey=alice", "POST", {"x": 4}
+        )
+        assert status == 200
+        assert resp["result"] == 34
+
+    def test_reload_requires_known_tenant(self, mt_server):
+        base, _, _, _ = mt_server
+        status, _ = _call(f"{base}/reload", "POST", {})
+        assert status == 400
+        status, _ = _call(
+            f"{base}/reload", "POST", {"tenant": "mallory"}
+        )
+        assert status == 404
+
+
+@dataclasses.dataclass
+class HeavyModel:
+    algo_id: int
+    table: np.ndarray  # nonzero nbytes so the pool budget bites
+
+
+class HeavyAlgorithm(FakeAlgorithm):
+    def train(self, ctx, pd):
+        return HeavyModel(
+            algo_id=self.params.id,
+            table=np.zeros(4096, np.float32),  # 16 KiB resident
+        )
+
+    def predict(self, model, query):
+        return {"result": model.algo_id * 10 + int(query.get("x", 0))}
+
+    def batch_predict(self, model, queries):
+        return [self.predict(model, q) for q in queries]
+
+
+class TestEvictionUnderTraffic:
+    def test_eviction_racing_in_flight_queries_lossless(
+        self, ctx, memory_storage
+    ):
+        """A pool too small for both tenants: every alternating query
+        evicts the other tenant's model, while queries are in flight.
+        All answers must stay correct and lossless — pins make
+        eviction wait for the in-flight generation to drain."""
+        engine = Engine(
+            FakeDataSource, FakePreparator, HeavyAlgorithm, DictServing
+        )
+        for variant, algo_id in ALGO_IDS.items():
+            run_train(
+                engine, _params(algo_id), engine_id="srv-mt-heavy",
+                ctx=ctx, storage=memory_storage,
+                engine_variant=variant,
+            )
+        registry = MetricRegistry()
+        # one 16 KiB model fits, two don't: every alternation evicts
+        pool = ModelPool(budget_bytes=20_000, registry=registry)
+        es = EngineServer(
+            engine, _params(3), engine_id="srv-mt-heavy",
+            storage=memory_storage, ctx=ctx, registry=registry,
+            tenants=TENANTS, pool=pool, warmup=False,
+        )
+        http = es.serve(host="127.0.0.1", port=0)
+        http.start()
+        base = f"http://127.0.0.1:{http.port}"
+        errors = []
+
+        def hammer(tenant, algo_id):
+            for i in range(8):
+                status, body = _call(
+                    f"{base}/queries.json?accessKey={tenant}",
+                    "POST", {"x": i},
+                )
+                if status != 200 or body["result"] != algo_id * 10 + i:
+                    errors.append((tenant, i, status, body))
+
+        try:
+            threads = [
+                threading.Thread(target=hammer, args=("alice", 3)),
+                threading.Thread(target=hammer, args=("bob", 7)),
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert errors == []
+            assert pool.stats()["evictions"] >= 1
+            text = registry.render_prometheus()
+            assert "pio_pool_evictions_total" in text
+        finally:
+            http.shutdown()
+            es.close()
+            pool.close()
+
+
+class TestModeValidation:
+    def test_canary_and_tenants_mutually_exclusive(
+        self, ctx, memory_storage
+    ):
+        _train_variants(ctx, memory_storage)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            EngineServer(
+                _engine(), _params(3), engine_id="srv-mt",
+                storage=memory_storage, ctx=ctx, tenants=TENANTS,
+                canary=True,
+            )
+
+    def test_bad_quantize_mode_rejected(self, ctx, memory_storage):
+        with pytest.raises(ValueError, match="quantize mode"):
+            EngineServer(
+                _engine(), _params(3), engine_id="srv-mt",
+                storage=memory_storage, ctx=ctx, quantize="fp4",
+            )

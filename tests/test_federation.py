@@ -910,12 +910,15 @@ class TestProfileEndpoint:
                 timeout=60,
             )
             codes.add(status)
-            if 409 in codes:
+            # either side may lose the race: if a 60 ms poll capture
+            # reached the server first, the background 1500 ms request
+            # is the one that draws the 409
+            if 409 in codes or 409 in results:
                 break
             time.sleep(0.05)
         t.join()
-        assert 409 in codes
-        assert results == [200]
+        assert 409 in codes or 409 in results
+        assert 200 in codes or results == [200]
 
     def test_profile_duration_clamped_to_max(self, server, monkeypatch):
         monkeypatch.setenv("PIO_PROFILE_MAX_MS", "80")

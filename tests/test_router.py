@@ -432,6 +432,40 @@ class TestSelection:
         finally:
             router.close()
 
+    def test_tenant_keyed_affinity(self):
+        """Pooled multi-tenant serving: accessKey/X-PIO-Tenant pins a
+        tenant's traffic to one replica so its model stays hot in ONE
+        pool; an explicit affinity header still wins."""
+        from predictionio_tpu.serving.http import Request
+
+        def req(query=None, headers=None, body=b""):
+            return Request(
+                "POST", "/queries.json", query or {}, headers or {},
+                body, {},
+            )
+
+        router = self._router()
+        try:
+            key = router._affinity_key(
+                req(query={"accessKey": "alice"}, body=b"{'x': 1}")
+            )
+            assert key == b"tenant:alice"
+            # header spelling resolves identically → same ring point
+            assert router._affinity_key(
+                req(headers={"X-PIO-Tenant": "alice"}, body=b"other")
+            ) == key
+            # explicit affinity beats the tenant
+            assert router._affinity_key(
+                req(
+                    query={"accessKey": "alice"},
+                    headers={"X-PIO-Affinity": "u9"},
+                )
+            ) == b"u9"
+            # no tenant → body hash fallback unchanged
+            assert router._affinity_key(req(body=b"abc")) == b"abc"
+        finally:
+            router.close()
+
     def test_ring_stability_across_membership_change(self):
         """Removing one tied replica only remaps keys that hashed to
         it — every other key keeps its replica (consistent hashing,
