@@ -244,6 +244,51 @@ def _pool_summary_line(data: dict) -> str | None:
     return " ".join(parts)
 
 
+def _tenant_cost_line(data: dict, top_n: int = 3) -> str | None:
+    """One-line per-tenant cost rollup (cost attribution): the top-N
+    tenants by attributed device-seconds, each with its share of total
+    device time, resident byte-seconds, and a ``noisy`` marker when the
+    noisy-neighbor gauge is raised. Only rendered when the scraped
+    server (or fleet merge) carries ``pio_tenant_*`` series."""
+
+    def by_tenant(name, value_key="value"):
+        family = data.get(name)
+        out: dict[str, float] = {}
+        if not isinstance(family, dict):
+            return out
+        for s in family.get("samples") or []:
+            tenant = (s.get("labels") or {}).get("tenant")
+            if tenant is None:
+                continue
+            try:
+                out[tenant] = out.get(tenant, 0.0) + float(
+                    s.get(value_key, 0) or 0
+                )
+            except (TypeError, ValueError):
+                continue
+        return out
+
+    device = by_tenant("pio_tenant_device_seconds_total")
+    if not device:
+        return None
+    total = sum(device.values())
+    resident = by_tenant("pio_tenant_resident_byte_seconds_total")
+    noisy = by_tenant("pio_tenant_noisy")
+    parts = [f"tenants: deviceSeconds={total:.3f}"]
+    ranked = sorted(device.items(), key=lambda kv: -kv[1])[:top_n]
+    for tenant, dev_s in ranked:
+        share = dev_s / total if total > 0 else 0.0
+        bits = [f"dev={dev_s:.3f}s({share:.0%})"]
+        if resident.get(tenant):
+            bits.append(f"res={_fmt_bytes(resident[tenant])}·s")
+        if noisy.get(tenant):
+            bits.append("noisy")
+        parts.append(f"{tenant or '(none)'}[{' '.join(bits)}]")
+    if len(device) > top_n:
+        parts.append(f"(+{len(device) - top_n} more)")
+    return " ".join(parts)
+
+
 def _fleet_summary_line(status: dict) -> str:
     """One-line fleet summary from a router's GET / status payload:
     replica count + health bands, serving generation, in-flight swap
@@ -387,6 +432,9 @@ def _print_metrics(url: str, access_key: str = "") -> int:
             if stale:
                 line += " stale=" + ",".join(stale)
             print(line)
+            tenants = _tenant_cost_line(data.get("fleet") or {})
+            if tenants:
+                print(tenants)
             _print_families(data.get("fleet") or {})
             _print_families(data.get("local") or {})
             return 0
@@ -396,6 +444,9 @@ def _print_metrics(url: str, access_key: str = "") -> int:
         pool = _pool_summary_line(data)
         if pool:
             print(pool)
+        tenants = _tenant_cost_line(data)
+        if tenants:
+            print(tenants)
         _print_families(data)
     except (AttributeError, KeyError, TypeError) as e:
         print(
@@ -494,6 +545,88 @@ def cmd_trace(args) -> int:
     print(f"Wrote {summary} to {args.out}")
     if not args.raw:
         print("Open it at https://ui.perfetto.dev (or chrome://tracing).")
+    return 0
+
+
+#: event keys rendered in dedicated columns; everything else in an
+#: event dict is an emitter-specific field, appended as key=value
+_TIMELINE_CORE_KEYS = frozenset(
+    ("kind", "message", "severity", "mono", "wall", "seq", "replica")
+)
+
+
+def _render_timeline_event(event: dict) -> str:
+    import datetime as _dt
+
+    wall = float(event.get("wall", 0.0) or 0.0)
+    stamp = _dt.datetime.fromtimestamp(
+        wall, _dt.timezone.utc
+    ).isoformat(timespec="milliseconds")
+    severity = str(event.get("severity", "info")).upper()
+    parts = [stamp, f"{severity:<5}"]
+    replica = event.get("replica")
+    if replica:
+        parts.append(f"[{replica}]")
+    parts.append(
+        f"{event.get('kind', '?')}: {event.get('message', '')}"
+    )
+    extras = [
+        f"{k}={event[k]}"
+        for k in sorted(event)
+        if k not in _TIMELINE_CORE_KEYS and event[k] not in ("", None)
+    ]
+    if extras:
+        parts.append("(" + " ".join(extras) + ")")
+    return " ".join(parts)
+
+
+def cmd_timeline(args) -> int:
+    """Pull the incident timeline from a live server (or the fleet-
+    merged one from a router) and render a human-readable incident
+    narrative — one line per lifecycle event, oldest first. Pure HTTP,
+    never imports jax (mirrors ``trace``/``status --metrics-url``)."""
+    target = args.url.rstrip("/") + "/debug/timeline.json"
+    data = _fetch_json(target, access_key=args.access_key)
+    if data is None:
+        return 1
+    if not isinstance(data, dict) or not isinstance(
+        data.get("events"), list
+    ):
+        print(
+            f"[ERROR] {redact_keys(target)} is not a pio timeline "
+            "payload",
+            file=sys.stderr,
+        )
+        return 1
+    events = [e for e in data["events"] if isinstance(e, dict)]
+    if args.tenant:
+        events = [e for e in events if e.get("tenant") == args.tenant]
+    if args.since and events:
+        # the cutoff is relative to the newest event's own wall stamp,
+        # not this machine's clock — the server's clock is the one the
+        # stamps came from, and the two need not agree
+        newest = max(float(e.get("wall", 0.0) or 0.0) for e in events)
+        cutoff = newest - args.since
+        events = [
+            e for e in events if float(e.get("wall", 0.0) or 0.0) >= cutoff
+        ]
+    header = [f"timeline: events={len(events)}"]
+    replicas = data.get("replicas")
+    if isinstance(replicas, list) and replicas:
+        header.append("replicas=" + ",".join(str(r) for r in replicas))
+    stale = data.get("stale")
+    if isinstance(stale, list) and stale:
+        header.append("stale=" + ",".join(str(r) for r in stale))
+    dropped = data.get("dropped")
+    if dropped:
+        header.append(f"dropped={dropped}")
+    if args.tenant:
+        header.append(f"tenant={args.tenant}")
+    if args.since:
+        header.append(f"since={args.since:g}s")
+    print(" ".join(header))
+    for event in events:
+        print(_render_timeline_event(event))
     return 0
 
 
@@ -1859,6 +1992,28 @@ def build_parser() -> argparse.ArgumentParser:
         help="server access key (servers that key-auth every route)",
     )
     p.set_defaults(func=cmd_trace)
+
+    p = sub.add_parser("timeline")
+    p.add_argument(
+        "--url", required=True,
+        help="base URL of a live server, or a router for the "
+             "fleet-merged timeline",
+    )
+    p.add_argument(
+        "--since", type=float, default=0.0,
+        help="only events within the last S seconds, measured back "
+             "from the newest event (default: all)",
+    )
+    p.add_argument(
+        "--tenant", default="",
+        help="only events correlated with this tenant",
+    )
+    p.add_argument(
+        "--access-key", dest="access_key", default="",
+        help="server access key (/debug/timeline.json is key-gated "
+             "when the server has one configured)",
+    )
+    p.set_defaults(func=cmd_timeline)
 
     p = sub.add_parser("profile")
     p.add_argument(

@@ -36,6 +36,12 @@ from predictionio_tpu.obs.registry import (
     get_registry,
 )
 from predictionio_tpu.obs.slo import Objective, SLOMonitor
+from predictionio_tpu.obs.timeline import (
+    Timeline,
+    get_timeline,
+    merge_timelines,
+    set_timeline,
+)
 from predictionio_tpu.obs.tracing import (
     Span,
     Tracer,
@@ -56,16 +62,20 @@ __all__ = [
     "SLOMonitor",
     "Span",
     "TRAIN_STEP_BUCKETS",
+    "Timeline",
     "Tracer",
     "combine_families",
     "counter_total",
     "current_span",
     "get_registry",
     "get_request_id",
+    "get_timeline",
     "get_tracer",
     "merge_payloads",
+    "merge_timelines",
     "new_request_id",
     "render_prometheus_families",
     "set_request_id",
+    "set_timeline",
     "span",
 ]

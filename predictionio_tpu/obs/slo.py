@@ -33,7 +33,12 @@ import time
 from dataclasses import dataclass
 from typing import Callable
 
+from predictionio_tpu.obs import timeline
 from predictionio_tpu.obs.registry import MetricRegistry
+
+#: short-window burn rate at which the incident timeline records an
+#: ``slo_burn_alert`` — the classic page-now threshold
+PAGE_BURN_RATE = 14.0
 
 #: criticality classes tracked, mirroring ``serving.admission``
 #: (admission is not imported: obs/ stays dependency-free)
@@ -146,6 +151,10 @@ class SLOMonitor:
         self._buckets: dict[str, dict[int, list[float]]] = {
             cls: {} for cls in self._objectives
         }
+        #: classes currently past the page-now burn threshold — the
+        #: incident-timeline alert fires on the crossing, not per
+        #: request, and clears with hysteresis at half the threshold
+        self._alerting: set[str] = set()
         self._requests = None
         if registry is not None:
             if export_counter:
@@ -224,6 +233,38 @@ class SLOMonitor:
                 self._requests.labels(cls, "good").inc(good)
             if bad > 0.0:
                 self._requests.labels(cls, "bad").inc(bad)
+        self._check_burn(cls)
+
+    def _check_burn(self, cls: str) -> None:
+        """Emit an incident-timeline event when the class's
+        short-window burn rate crosses the classic page-now threshold
+        (burn 14 ~= the budget gone in <2 days at a 30-day window);
+        clears with hysteresis at half the threshold so a rate
+        hovering at the line doesn't flap events."""
+        burn = self.burn_rate(cls, "short")
+        fire = 0
+        with self._lock:
+            if burn >= PAGE_BURN_RATE and cls not in self._alerting:
+                self._alerting.add(cls)
+                fire = 1
+            elif cls in self._alerting and burn < PAGE_BURN_RATE / 2.0:
+                self._alerting.discard(cls)
+                fire = -1
+        if fire > 0:
+            timeline.get_timeline().record(
+                "slo_burn_alert",
+                f"class {cls!r} short-window burn rate {burn:.1f}x is "
+                f"past the page threshold ({PAGE_BURN_RATE:.0f}x)",
+                severity=timeline.ERROR,
+                **{"class": cls, "burn": round(burn, 2)},
+            )
+        elif fire < 0:
+            timeline.get_timeline().record(
+                "slo_burn_alert",
+                f"class {cls!r} burn rate recovered "
+                f"({burn:.1f}x, below {PAGE_BURN_RATE / 2.0:.0f}x)",
+                **{"class": cls, "burn": round(burn, 2)},
+            )
 
     def _prune(self, cls: str, now_idx: int) -> None:
         horizon = now_idx - int(self._windows["long"] / _BUCKET_S) - 1

@@ -134,6 +134,34 @@ def criticality(value: str):
         _criticality.reset(token)
 
 
+_tenant: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "pio_tenant", default=""
+)
+
+
+def set_tenant(value: str) -> None:
+    """Install the request's tenant identity for the current context.
+    Like :func:`set_criticality`, the HTTP layer calls this once per
+    request — unconditionally, so a stale tenant cannot leak into the
+    next request on a reused keep-alive handler thread. Empty string
+    means "no tenant" (single-tenant servers, unkeyed traffic)."""
+    _tenant.set(value or "")
+
+
+def get_tenant() -> str:
+    return _tenant.get()
+
+
+@contextlib.contextmanager
+def tenant(value: str):
+    """Scope a tenant identity over a block (client SDK sugar)."""
+    token = _tenant.set(value or "")
+    try:
+        yield
+    finally:
+        _tenant.reset(token)
+
+
 def format_retry_after(seconds: float) -> str:
     """The Retry-After wire value: decimal seconds, two places, never
     below 0.05 (the contract documented in docs/robustness.md — our

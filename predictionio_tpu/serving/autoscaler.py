@@ -58,6 +58,7 @@ import time
 from typing import Callable
 
 from predictionio_tpu.obs import MetricRegistry, get_registry
+from predictionio_tpu.obs import timeline as timeline_mod
 from predictionio_tpu.obs.context import log_json
 from predictionio_tpu.serving.resilience import _env_float
 from predictionio_tpu.serving.workers import (
@@ -484,6 +485,11 @@ class ReplicaAutoscaler:
             logger.warning("autoscaler grow failed: %s", e)
             return "idle"
         self._actions.labels("grow").inc()
+        timeline_mod.get_timeline().record(
+            "autoscaler_action",
+            f"autoscaler grew the fleet toward target {self.target}",
+            action="grow", target=self.target,
+        )
         return "grow"
 
     def _shrink(self) -> str:
@@ -509,6 +515,12 @@ class ReplicaAutoscaler:
         slot.retire()
         self._router.retire(victim)
         self._actions.labels("shrink").inc()
+        timeline_mod.get_timeline().record(
+            "autoscaler_action",
+            f"autoscaler retired replica {victim} toward target "
+            f"{self.target}",
+            action="shrink", target=self.target, replica_id=victim,
+        )
         log_json(
             logger, logging.INFO, "autoscaler_shrink", replica=victim,
         )

@@ -56,6 +56,7 @@ from __future__ import annotations
 
 import logging
 import math
+import os
 import queue
 import threading
 import time
@@ -63,6 +64,7 @@ from concurrent.futures import Future
 from typing import Any, Callable, NamedTuple, Sequence
 
 from predictionio_tpu.obs import MetricRegistry, get_request_id
+from predictionio_tpu.obs import timeline as timeline_mod
 from predictionio_tpu.obs import tracing
 from predictionio_tpu.obs.context import log_json
 from predictionio_tpu.obs.registry import LATENCY_BUCKETS, OCCUPANCY_BUCKETS
@@ -118,6 +120,7 @@ class _Slot(NamedTuple):
     submitted_mono: float
     deadline: Any  # resilience.Deadline | None
     criticality: str = admission.DEFAULT
+    tenant: str = ""
 
 
 class _Inflight(NamedTuple):
@@ -161,13 +164,110 @@ class _NullMetrics:
     def leaked(self) -> None:
         pass
 
+    def attributed(
+        self, tenant: str, device_s: float, wait_s: float, status: str
+    ) -> None:
+        pass
+
+
+#: queue-wait budget a tenant's requests must beat for the tenant to
+#: count as UNHARMED in the noisy-neighbor check; default is half the
+#: default-class SLO latency (obs/slo.py). Override with
+#: PIO_TENANT_WAIT_SLO_MS.
+_DEFAULT_WAIT_SLO_S = 0.5
+
+#: a tenant is a noisy-neighbor CANDIDATE when its device-seconds over
+#: the rollup window exceed this multiple of the fair per-tenant share
+_NOISY_SHARE_FACTOR = 1.5
+
+#: noisy-neighbor rollup window (seconds): device share and queue-wait
+#: breaches accumulate per window, the gauge updates at rollover
+_NOISY_WINDOW_S = 15.0
+
+
+def _wait_slo_s() -> float:
+    raw = os.environ.get("PIO_TENANT_WAIT_SLO_MS")
+    if not raw:
+        return _DEFAULT_WAIT_SLO_S
+    try:
+        value = float(raw)
+    except ValueError:
+        return _DEFAULT_WAIT_SLO_S
+    return value / 1000.0 if value > 0 else _DEFAULT_WAIT_SLO_S
+
+
+class _NoisyRollup:
+    """Per-window noisy-neighbor detection over the attribution stream.
+
+    A tenant is flagged when BOTH hold over one window: it consumed
+    more than ``_NOISY_SHARE_FACTOR`` x the fair per-tenant device
+    share, and some OTHER tenant's queue wait breached the wait SLO —
+    i.e. the overuse visibly harmed a neighbor. Advisory only (a gauge
+    + timeline event beside the fair-share admission path, never an
+    enforcement input). Callers hold no lock; all state is guarded by
+    the owning ``_BatcherMetrics``' attribution lock."""
+
+    __slots__ = (
+        "noisy_gauge", "window_end", "device_s", "breached", "flagged",
+        "wait_slo_s",
+    )
+
+    def __init__(self, noisy_gauge):
+        self.noisy_gauge = noisy_gauge
+        self.window_end = time.monotonic() + _NOISY_WINDOW_S
+        self.device_s: dict[str, float] = {}
+        self.breached: set[str] = set()
+        self.flagged: set[str] = set()
+        self.wait_slo_s = _wait_slo_s()
+
+    def observe(self, tenant: str, device_s: float, wait_s: float) -> None:
+        self.device_s[tenant] = (
+            self.device_s.get(tenant, 0.0) + device_s
+        )
+        if wait_s > self.wait_slo_s:
+            self.breached.add(tenant)
+        now = time.monotonic()
+        if now >= self.window_end:
+            self._roll(now)
+
+    def _roll(self, now: float) -> None:
+        total = sum(self.device_s.values())
+        tenants = set(self.device_s)
+        fair = total / max(1, len(tenants))
+        noisy = {
+            t
+            for t, used in self.device_s.items()
+            if len(tenants) > 1
+            and used > _NOISY_SHARE_FACTOR * fair
+            and (self.breached - {t})
+        }
+        for t in noisy - self.flagged:
+            self.noisy_gauge.labels(t).set(1)
+            timeline_mod.get_timeline().record(
+                "noisy_neighbor", f"tenant {t!r} over fair device share "
+                "while neighbors breached their queue-wait SLO",
+                severity=timeline_mod.WARN, tenant=t,
+            )
+        for t in self.flagged - noisy:
+            self.noisy_gauge.labels(t).set(0)
+            timeline_mod.get_timeline().record(
+                "noisy_neighbor", f"tenant {t!r} back within fair share",
+                tenant=t,
+            )
+        self.flagged = noisy
+        self.device_s = {}
+        self.breached = set()
+        self.window_end = now + _NOISY_WINDOW_S
+
 
 class _BatcherMetrics:
     """Bound registry children for one named batcher."""
 
     __slots__ = ("_depth", "_shed", "_shed_class", "_name", "_occupancy",
                  "_dispatch", "_enqueue", "_sync", "_batches",
-                 "_cancelled", "_expired", "_leaked")
+                 "_cancelled", "_expired", "_leaked",
+                 "_tenant_device", "_tenant_wait", "_tenant_requests",
+                 "_attr_lock", "_noisy")
 
     def __init__(self, registry: MetricRegistry, name: str):
         self._name = name
@@ -238,6 +338,38 @@ class _BatcherMetrics:
             "joining them",
             ("batcher",),
         ).labels(name)
+        # tenant cost attribution: families are UNBOUND (labelled per
+        # settle) and shared across batchers — the registry get-or-create
+        # makes repeat registration from every batcher/pool safe, and
+        # fleet federation sums them per tenant across replicas
+        self._tenant_device = registry.counter(
+            "pio_tenant_device_seconds_total",
+            "Measured device time (enqueue + sync) apportioned to the "
+            "tenant's slots, by slot count per coalesced batch",
+            ("tenant",),
+        )
+        self._tenant_wait = registry.histogram(
+            "pio_tenant_queue_wait_seconds",
+            "Per-slot wait between batch submit and device dispatch, "
+            "by tenant",
+            ("tenant",),
+            buckets=LATENCY_BUCKETS,
+        )
+        self._tenant_requests = registry.counter(
+            "pio_tenant_requests_total",
+            "Batch slots settled per tenant, by outcome",
+            ("tenant", "status"),
+        )
+        self._attr_lock = threading.Lock()
+        self._noisy = _NoisyRollup(
+            registry.gauge(
+                "pio_tenant_noisy",
+                "1 while the tenant exceeds its fair device share AND "
+                "other tenants' queue waits breach the wait SLO "
+                "(advisory; see docs/observability.md)",
+                ("tenant",),
+            )
+        )
 
     def queue_depth(self, n: int) -> None:
         self._depth.set(n)
@@ -265,6 +397,23 @@ class _BatcherMetrics:
 
     def leaked(self) -> None:
         self._leaked.inc()
+
+    def attributed(
+        self, tenant: str, device_s: float, wait_s: float, status: str
+    ) -> None:
+        """One slot's share of a settled batch. Conservation contract:
+        the settle paths call this for EVERY live slot with exactly
+        ``(enqueue_s + sync_s) / len(live)``, success and failure
+        alike, so the per-tenant sum equals the batcher's measured
+        device time (asserted in tests and scripts/metrics_smoke.py)."""
+        self._tenant_device.labels(tenant).inc(device_s)
+        self._tenant_wait.labels(tenant).observe(wait_s)
+        self._tenant_requests.labels(tenant, status).inc()
+        # settlement runs on the completer AND the collector (serial /
+        # dispatch-failure paths); the rollup's read-modify-write needs
+        # its own tiny guard
+        with self._attr_lock:
+            self._noisy.observe(tenant, device_s, wait_s)
 
 
 class MicroBatcher:
@@ -373,6 +522,7 @@ class MicroBatcher:
         # overload bound: doomed work must never trigger an eviction.
         deadline = resilience.get_deadline()
         criticality = admission.get_criticality()
+        tenant = admission.get_tenant()
         victim: _Slot | None = None
         # the cv orders submit against close(): once closed is set under
         # it, no new slot can slip into the buffer behind the drain
@@ -403,15 +553,19 @@ class MicroBatcher:
             # it coalesced. With tracing off the extra cost is exactly
             # the current_span() contextvar read (parent is None).
             parent_span = tracing.current_span()
+            # submit time is stamped unconditionally (not just under a
+            # trace): per-tenant queue-wait attribution needs it for
+            # every slot
             self._buf.append(
                 _Slot(
                     item,
                     future,
                     get_request_id(),
                     parent_span,
-                    time.monotonic() if parent_span is not None else 0.0,
+                    time.monotonic(),
                     deadline,
                     criticality,
+                    tenant,
                 )
             )
             self._metrics.queue_depth(len(self._buf))
@@ -632,7 +786,9 @@ class MicroBatcher:
         # submitted under an open trace — untraced traffic pays nothing
         traced = any(slot.parent_span is not None for slot in live)
         start_wall = tracing.now() if traced else 0.0
-        start_mono = time.monotonic() if traced else 0.0
+        # dispatch-start is stamped unconditionally: queue-wait
+        # attribution (submit -> dispatch) covers untraced traffic too
+        start_mono = time.monotonic()
         if self._completer is None:
             self._flush_serial(live, start_wall, start_mono, traced)
             return
@@ -672,10 +828,17 @@ class MicroBatcher:
                 return
             try:
                 t1 = time.perf_counter()
+                sync_s = 0.0
                 try:
-                    results = self._collect_fn(rec.handle)
-                    sync_s = time.perf_counter() - t1
-                    self._metrics.synced(sync_s)
+                    # sync time is observed in the finally so a failed
+                    # collect's device time lands in the histogram too
+                    # — attribution charges exactly what was observed,
+                    # success or failure (conservation)
+                    try:
+                        results = self._collect_fn(rec.handle)
+                    finally:
+                        sync_s = time.perf_counter() - t1
+                        self._metrics.synced(sync_s)
                     if len(results) != len(rec.live):
                         raise RuntimeError(
                             f"batch_fn returned {len(results)} results "
@@ -686,7 +849,7 @@ class MicroBatcher:
                         rec.live, e, time.perf_counter() - rec.t0,
                         rec.start_wall, rec.start_mono, rec.traced,
                         enqueue_s=rec.enqueue_s,
-                        sync_s=time.perf_counter() - t1,
+                        sync_s=sync_s,
                         phase="collect",
                     )
                     continue
@@ -749,12 +912,29 @@ class MicroBatcher:
                 else 0.8 * self._batch_ewma_s + 0.2 * elapsed
             )
 
+    def _attribute(
+        self, live, start_mono: float, enqueue_s: float, sync_s: float,
+        status: str,
+    ) -> None:
+        """Apportion the batch's measured device time across its slots
+        by slot count — every live slot, on success AND failure paths,
+        so per-tenant sums conserve the batcher's total device time."""
+        share = (enqueue_s + sync_s) / len(live)
+        for slot in live:
+            self._metrics.attributed(
+                slot.tenant,
+                share,
+                max(0.0, start_mono - slot.submitted_mono),
+                status,
+            )
+
     def _settle_success(
         self, live, results, elapsed: float, start_wall: float,
         start_mono: float, traced: bool, enqueue_s: float, sync_s: float,
     ) -> None:
         self._observe_batch_time(elapsed)
         self._metrics.dispatched(len(live), elapsed)
+        self._attribute(live, start_mono, enqueue_s, sync_s, "ok")
         if traced:
             self._record_dispatch_spans(
                 live, start_wall, start_mono, elapsed,
@@ -777,6 +957,7 @@ class MicroBatcher:
     ) -> None:
         self._observe_batch_time(elapsed)
         self._metrics.dispatched(len(live), elapsed)
+        self._attribute(live, start_mono, enqueue_s, sync_s, "error")
         if traced:
             self._record_dispatch_spans(
                 live, start_wall, start_mono, elapsed,

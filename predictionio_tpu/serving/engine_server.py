@@ -56,6 +56,7 @@ from predictionio_tpu.data.datamap import DataMap
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage import Storage, get_storage
 from predictionio_tpu.obs import MetricRegistry, get_registry
+from predictionio_tpu.obs import timeline as timeline_mod
 from predictionio_tpu.obs import tracing
 from predictionio_tpu.obs.device import CompileTracker, DeviceSampler
 from predictionio_tpu.parallel.mesh import ComputeContext
@@ -185,6 +186,19 @@ class EngineServer:
         self._start_time = _dt.datetime.now(_dt.timezone.utc)
         self._registry = registry if registry is not None else get_registry()
         self._tracer = tracer if tracer is not None else tracing.get_tracer()
+        # incident timeline (docs/observability.md "Incident
+        # timeline"): one bounded ring per process, served at
+        # /debug/timeline.json. Installed as the process-global ring
+        # too, so emitters with no constructor seam (breaker
+        # transitions, noisy-neighbor flags) land beside the pool and
+        # canary events.
+        self._timeline = timeline_mod.Timeline(registry=self._registry)
+        timeline_mod.set_timeline(self._timeline)
+        # every ring opens with a start marker: restarts are visible in
+        # the merged fleet narrative, and a scraped ring is never empty
+        self._timeline.record(
+            "server_start", f"engine server {engine_id!r} starting",
+        )
         self._shed_wasted = self._registry.counter(
             "pio_shed_wasted_dispatch_total",
             "Per-algorithm dispatches abandoned by partially-shed batch "
@@ -285,7 +299,8 @@ class EngineServer:
                 self._pool = pool
             else:
                 self._pool = modelpool_mod.ModelPool(
-                    registry=self._registry
+                    registry=self._registry,
+                    timeline=self._timeline,
                 )
                 self._owns_pool = True
             self._preload_tenants()
@@ -303,6 +318,7 @@ class EngineServer:
         install_metrics_routes(
             self.router, self._registry, self._tracer,
             server_config=self._server_config,
+            timeline=self._timeline,
         )
         install_plugin_routes(self.router, self._plugins, OUTPUT_SNIFFER)
         # adaptive overload control (docs/robustness.md "Overload &
@@ -1336,11 +1352,21 @@ class EngineServer:
             try:
                 self._pool.replace(tenant, self._tenant_loader(tenant))
             except Exception as exc:  # noqa: BLE001 - surfaced as 500
+                self._timeline.record(
+                    "tenant_reload",
+                    f"tenant {tenant!r} reload failed: {exc}",
+                    severity=timeline_mod.ERROR, tenant=tenant,
+                )
                 raise HTTPError(
                     500, f"tenant {tenant!r} reload failed: {exc}"
                 ) from exc
             with self._lock:
                 generation = self._tenant_generations.get(tenant, 0)
+            self._timeline.record(
+                "tenant_reload",
+                f"tenant {tenant!r} reloaded to generation {generation}",
+                tenant=tenant, generation=generation,
+            )
         return Response(
             200,
             {
@@ -1533,6 +1559,12 @@ class EngineServer:
             self._generation_gauge.labels("").set(generation)
             self._warmed_gauge.set(1 if staged.warmed else 0)
             canary.promoted(retained)
+            self._timeline.record(
+                "canary_verdict",
+                f"canary PROMOTED instance {staged.instance.id} "
+                f"(now generation {generation})",
+                generation=generation, decision="promote",
+            )
             logger.info(
                 "canary PROMOTED generation %s (now generation %d); "
                 "watching for regression, previous %s retained",
@@ -1542,6 +1574,12 @@ class EngineServer:
             canary.finished(canary_mod.REJECTED)
             self._close_batchers_async(canary.staged.batchers)
             self._finish_canary(canary)
+            self._timeline.record(
+                "canary_verdict",
+                f"canary REJECTED instance {canary.staged.instance.id}: "
+                f"{canary.reason}",
+                severity=timeline_mod.WARN, decision="reject",
+            )
             logger.warning(
                 "canary REJECTED generation %s: %s (still serving %s)",
                 canary.staged.instance.id, canary.reason,
@@ -1561,6 +1599,13 @@ class EngineServer:
             canary.finished(canary_mod.ROLLED_BACK)
             self._close_batchers_async(rolled_back.batchers)
             self._finish_canary(canary)
+            self._timeline.record(
+                "canary_verdict",
+                f"canary ROLLED BACK to instance {retained.instance.id}: "
+                f"{canary.reason}",
+                severity=timeline_mod.ERROR, generation=generation,
+                decision="rollback",
+            )
             logger.warning(
                 "canary ROLLED BACK to generation %s: %s",
                 retained.instance.id, canary.reason,
@@ -1569,6 +1614,12 @@ class EngineServer:
             canary.finished(canary_mod.STABLE)
             self._close_batchers_async(canary.retained.batchers)
             self._finish_canary(canary)
+            self._timeline.record(
+                "canary_verdict",
+                f"canary STABLE on instance {canary.staged.instance.id} "
+                f"({canary.reason})",
+                decision="stable",
+            )
             logger.info(
                 "canary STABLE on generation %s (%s)",
                 canary.staged.instance.id, canary.reason,

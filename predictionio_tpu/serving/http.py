@@ -182,6 +182,7 @@ def install_metrics_routes(
     tracer: tracing.Tracer | None = None,
     server_config=None,
     federation=None,
+    timeline=None,
 ) -> None:
     """The common telemetry surface every server mounts: Prometheus
     text at ``GET /metrics``, the same registry as JSON at
@@ -201,7 +202,13 @@ def install_metrics_routes(
     e.g. the serving router's fleet federation) replaces both metrics
     bodies with the fleet-wide view: every replica's series re-labeled
     ``replica=...`` plus exactly merged fleet counters/histograms —
-    one scrape sees the whole fleet (docs/observability.md)."""
+    one scrape sees the whole fleet (docs/observability.md).
+
+    ``timeline`` (an object with ``to_dict()`` — a
+    :class:`~predictionio_tpu.obs.Timeline` or the router's federated
+    merge view) mounts the incident-timeline ring at
+    ``GET /debug/timeline.json``, key-gated like the other ``/debug``
+    routes (events carry request IDs and tenants)."""
     tracer = tracer if tracer is not None else tracing.get_tracer()
 
     def _metrics(request: Request) -> Response:
@@ -244,10 +251,19 @@ def install_metrics_routes(
             server_config.check_key(request)
         return Response(200, json.dumps(tracer.to_dict(), default=str))
 
+    def _timeline_json(request: Request) -> Response:
+        if server_config is not None:
+            server_config.check_key(request)
+        # default=str for the same reason as traces: emitter-supplied
+        # correlation fields must not make the ring unscrapeable
+        return Response(200, json.dumps(timeline.to_dict(), default=str))
+
     router.route("GET", "/metrics", _metrics)
     router.route("GET", "/metrics.json", _metrics_json)
     router.route("GET", "/debug/traces", _traces)
     router.route("GET", "/debug/traces.json", _traces_json)
+    if timeline is not None:
+        router.route("GET", "/debug/timeline.json", _timeline_json)
     # same seam, one more cross-cutting behavior: every server that
     # mounts the telemetry surface also gains the env-driven fault
     # injector (no-op unless PIO_CHAOS is set; docs/robustness.md)
@@ -468,6 +484,16 @@ class HTTPServer:
                     self.headers.get(admission.CRITICALITY_HEADER)
                 )
                 admission.set_criticality(request.criticality)
+                # tenant identity, same discipline: installed
+                # unconditionally so the batcher downstream can
+                # attribute device time, and so a keep-alive thread
+                # cannot charge one tenant for the next request
+                tenant = (
+                    query.get("accessKey")
+                    or self.headers.get(admission.TENANT_HEADER)
+                    or ""
+                )
+                admission.set_tenant(tenant)
                 # the operator's window into a sick server: never
                 # drain-refused, never chaos-faulted
                 telemetry_path = parsed.path == "/healthz" or (
@@ -482,17 +508,11 @@ class HTTPServer:
                 # exactly one release below — including the chaos-reset
                 # early return.
                 admitted = False
-                tenant = ""
                 if (
                     early is None
                     and admission_ref is not None
                     and not telemetry_path
                 ):
-                    tenant = (
-                        query.get("accessKey")
-                        or self.headers.get(admission.TENANT_HEADER)
-                        or ""
-                    )
                     try:
                         admission_ref.try_acquire(
                             request.criticality, tenant

@@ -40,6 +40,8 @@ import threading
 import time
 from typing import Callable
 
+from predictionio_tpu.obs import timeline as timeline_mod
+
 logger = logging.getLogger(__name__)
 
 #: default budget when neither env nor device memory stats are
@@ -98,7 +100,7 @@ def default_budget_bytes() -> int:
 class _Entry:
     __slots__ = (
         "tenant", "value", "nbytes", "close_fn", "pins", "last_used",
-        "retired", "hits",
+        "retired", "hits", "charged_mono",
     )
 
     def __init__(self, tenant, value, nbytes, close_fn, last_used):
@@ -110,6 +112,9 @@ class _Entry:
         self.last_used = last_used
         self.retired = False
         self.hits = 0
+        #: residency charged up to this monotonic stamp — cost
+        #: attribution charges elapsed x nbytes at every transition
+        self.charged_mono = last_used
 
 
 class _Load:
@@ -143,6 +148,7 @@ class ModelPool:
         budget_bytes: int | None = None,
         *,
         registry=None,
+        timeline: "timeline_mod.Timeline | None" = None,
     ) -> None:
         self._budget = (
             int(budget_bytes)
@@ -166,6 +172,8 @@ class ModelPool:
         self._worker.start()
         self._hits = self._misses = self._evicted = None
         self._resident_gauge = None
+        self._byte_seconds = None
+        self._timeline = timeline
         if registry is not None:
             self._hits = registry.counter(
                 "pio_pool_hits_total",
@@ -188,6 +196,13 @@ class ModelPool:
                 "eviction)",
                 ("tenant",),
             )
+            self._byte_seconds = registry.counter(
+                "pio_tenant_resident_byte_seconds_total",
+                "HBM residency charged to the tenant: bytes x seconds "
+                "resident, accrued at touch/evict/replace/close "
+                "transitions and at stats() snapshots",
+                ("tenant",),
+            )
             registry.gauge(
                 "pio_pool_budget_bytes",
                 "Model-pool device byte budget",
@@ -200,6 +215,33 @@ class ModelPool:
     @property
     def budget_bytes(self) -> int:
         return self._budget
+
+    def _charge(self, entry, now: float | None = None) -> None:
+        """Accrue the entry's residency since its last charge (bytes x
+        seconds) to the tenant. The stamp advances with the charge, so
+        overlapping charge sites (touch, evict, replace, close, stats)
+        never double-count an interval."""
+        if self._byte_seconds is None:
+            return
+        if now is None:
+            now = time.monotonic()
+        elapsed = now - entry.charged_mono
+        if elapsed <= 0:
+            return
+        entry.charged_mono = now
+        self._byte_seconds.labels(entry.tenant).inc(
+            elapsed * entry.nbytes
+        )
+
+    def _emit(self, kind, message, *, severity=timeline_mod.INFO,
+              tenant="", **fields) -> None:
+        """Record a pool lifecycle event; a deque append, safe under
+        the pool lock."""
+        if self._timeline is not None:
+            self._timeline.record(
+                kind, message, severity=severity, tenant=tenant,
+                **fields,
+            )
 
     # -- hot path ----------------------------------------------------------
 
@@ -229,6 +271,7 @@ class ModelPool:
                 if entry is not None:
                     entry.pins += 1
                     entry.last_used = time.monotonic()
+                    self._charge(entry, entry.last_used)
                     if first_pass:
                         entry.hits += 1
                 else:
@@ -253,10 +296,22 @@ class ModelPool:
                 else deadline - time.monotonic()
             )
             if remaining is not None and remaining <= 0:
+                self._emit(
+                    "pool_load_timeout",
+                    f"cold load for tenant {tenant!r} missed the "
+                    "caller's deadline",
+                    severity=timeline_mod.ERROR, tenant=tenant,
+                )
                 raise PoolLoadTimeout(
                     f"timed out waiting for tenant {tenant!r} to load"
                 )
             if not load.done.wait(remaining):
+                self._emit(
+                    "pool_load_timeout",
+                    f"cold load for tenant {tenant!r} missed the "
+                    "caller's deadline",
+                    severity=timeline_mod.ERROR, tenant=tenant,
+                )
                 raise PoolLoadTimeout(
                     f"timed out waiting for tenant {tenant!r} to load"
                 )
@@ -293,6 +348,12 @@ class ModelPool:
         except BaseException as exc:  # surfaced to every waiter
             with self._lock:
                 self._loading.pop(load.tenant, None)
+            self._emit(
+                "pool_load_failed",
+                f"cold load for tenant {load.tenant!r} failed: "
+                f"{type(exc).__name__}: {exc}",
+                severity=timeline_mod.ERROR, tenant=load.tenant,
+            )
             load.error = exc
             load.done.set()
             return
@@ -343,12 +404,20 @@ class ModelPool:
             to_close.append(victim)
             reclaimed += victim.nbytes
             self._evictions += 1
+            self._charge(victim)
+            self._emit(
+                "pool_eviction",
+                f"evicted tenant {victim.tenant!r} "
+                f"({victim.nbytes} bytes) to fit the byte budget",
+                severity=timeline_mod.WARN, tenant=victim.tenant,
+            )
             if self._evicted is not None:
                 self._evicted.labels(victim.tenant).inc()
             if self._resident_gauge is not None:
                 self._resident_gauge.labels(victim.tenant).set(0.0)
 
     def _retire_locked(self, entry, to_close: list) -> None:
+        self._charge(entry)
         entry.retired = True
         if entry.pins == 0:
             to_close.append(entry)
@@ -363,6 +432,9 @@ class ModelPool:
                 entry.tenant,
             )
         with self._lock:
+            # the retired-but-pinned tail still held HBM: charge it
+            # through to the actual close
+            self._charge(entry)
             self._resident_bytes -= entry.nbytes
 
     # -- management --------------------------------------------------------
@@ -377,6 +449,13 @@ class ModelPool:
             del self._entries[tenant]
             entry.retired = True
             self._evictions += 1
+            self._charge(entry)
+        self._emit(
+            "pool_eviction",
+            f"explicit evict of tenant {tenant!r} "
+            f"({entry.nbytes} bytes)",
+            severity=timeline_mod.WARN, tenant=tenant,
+        )
         if self._evicted is not None:
             self._evicted.labels(tenant).inc()
         if self._resident_gauge is not None:
@@ -416,6 +495,11 @@ class ModelPool:
         """Status-route snapshot: budget, resident bytes, per-tenant
         residency (the CLI pool line renders the metric twins)."""
         with self._lock:
+            # settle residency on every snapshot so a long-idle
+            # resident keeps accruing byte-seconds between touches
+            now = time.monotonic()
+            for e in self._entries.values():
+                self._charge(e, now)
             tenants = {
                 t: {
                     "residentBytes": e.nbytes,

@@ -71,6 +71,7 @@ from dataclasses import dataclass
 from typing import Callable
 
 from predictionio_tpu.obs import MetricRegistry, get_registry
+from predictionio_tpu.obs import timeline as timeline_mod
 from predictionio_tpu.obs.context import log_json
 
 logger = logging.getLogger(__name__)
@@ -384,6 +385,20 @@ class CircuitBreaker:
             self._half_open_inflight = 0
         self._state_gauge.set(_STATE_VALUE[to])
         self._transitions.labels(self.target, to).inc()
+        # incident timeline: a breaker flip is exactly the kind of
+        # control-plane event that explains a goodput dip. record() is
+        # a deque append — safe under the breaker lock.
+        timeline_mod.get_timeline().record(
+            "breaker_transition",
+            f"breaker {self.target!r} -> {to}",
+            severity=(
+                timeline_mod.ERROR
+                if to == OPEN
+                else timeline_mod.INFO
+            ),
+            target=self.target,
+            to=to,
+        )
         log_json(
             logger,
             logging.WARNING if to == OPEN else logging.INFO,

@@ -77,6 +77,7 @@ from typing import Callable, Iterable
 
 from predictionio_tpu.obs import MetricRegistry, get_registry
 from predictionio_tpu.obs import federation as federation_mod
+from predictionio_tpu.obs import timeline as timeline_mod
 from predictionio_tpu.obs import tracing
 from predictionio_tpu.obs.context import log_json
 from predictionio_tpu.obs.slo import SLOMonitor
@@ -249,6 +250,11 @@ class Replica:
         #: one SIGKILLed process fail the whole fleet scrape
         self._metrics_snapshot: dict = {}
         self._metrics_stale = True
+        #: last successful ``/debug/timeline.json`` scrape — same
+        #: stale-not-absent semantics: a SIGKILLed replica's final
+        #: events stay in the merged fleet timeline
+        self._timeline_snapshot: dict = {}
+        self._timeline_stale = True
         # NOT the process-global get_breaker map: two routers (or a
         # test building many) must not share breaker state for
         # same-named targets
@@ -311,6 +317,19 @@ class Replica:
         with self._lock:
             return self._metrics_snapshot, self._metrics_stale
 
+    def store_timeline(self, payload: dict) -> None:
+        with self._lock:
+            self._timeline_snapshot = payload
+            self._timeline_stale = False
+
+    def mark_timeline_stale(self) -> None:
+        with self._lock:
+            self._timeline_stale = True
+
+    def timeline_state(self) -> tuple[dict, bool]:
+        with self._lock:
+            return self._timeline_snapshot, self._timeline_stale
+
     def to_dict(self) -> dict:
         return {
             "id": self.replica_id,
@@ -370,6 +389,18 @@ class _FleetFederation:
 
     def to_dict(self) -> dict:
         return self._router.federated_dict()
+
+
+class _FleetTimeline:
+    """Timeline surface handed to ``install_metrics_routes``: each
+    ``GET /debug/timeline.json`` on the router fans out to the fleet
+    and serves the time-merged incident narrative."""
+
+    def __init__(self, router: "ServingRouter"):
+        self._router = router
+
+    def to_dict(self) -> dict:
+        return self._router.federated_timeline()
 
 
 class ServingRouter:
@@ -517,6 +548,11 @@ class ServingRouter:
         self._fleet_slo = SLOMonitor(
             self._registry, export_counter=False
         )
+        #: router-local incident timeline (swap phases, breaker
+        #: transitions, burn alerts); installed process-global so the
+        #: breaker/SLO emitters with no constructor seam land here too
+        self._timeline = timeline_mod.Timeline(registry=self._registry)
+        timeline_mod.set_timeline(self._timeline)
         self._stale_gauge = self._registry.gauge(
             "pio_federation_stale",
             "1 while the replica's federated series come from its "
@@ -557,6 +593,7 @@ class ServingRouter:
             self.router, self._registry, self._tracer,
             server_config=self._server_config,
             federation=_FleetFederation(self),
+            timeline=_FleetTimeline(self),
         )
         self._http: HTTPServer | None = None
         self._prober = threading.Thread(
@@ -794,6 +831,12 @@ class ServingRouter:
             logger, logging.INFO, "router_replica_registered",
             replica=rid, url=replica.url, generation=replica.generation,
         )
+        # membership changes are incident-narrative events (and they
+        # guarantee the router's own ring is never empty in a merge)
+        self._timeline.record(
+            "replica_registered", f"replica {rid!r} registered",
+            generation=replica.generation or None, replica_id=rid,
+        )
 
     def add_replica(
         self,
@@ -892,6 +935,10 @@ class ServingRouter:
         log_json(
             logger, logging.INFO, "router_replica_draining",
             replica=replica_id,
+        )
+        self._timeline.record(
+            "replica_draining", f"replica {replica_id!r} draining out",
+            replica_id=replica_id,
         )
 
         def _finish():
@@ -1611,6 +1658,18 @@ class ServingRouter:
             if terminal:
                 self._swaps_completed_total += 1
                 self._gc_swaps_locked()
+        self._timeline.record(
+            "swap_phase",
+            f"swap {record['id']} -> {phase}",
+            severity=(
+                timeline_mod.ERROR
+                if phase == "failed"
+                else timeline_mod.INFO
+            ),
+            generation=record.get("generation"),
+            swap=record["id"],
+            phase=phase,
+        )
         self._persist_state()
 
     def _gc_swaps_locked(self) -> None:
@@ -2172,6 +2231,63 @@ class ServingRouter:
             self._registry.to_dict(), payloads
         )
         return federation_mod.render_prometheus_families(combined)
+
+    def _timeline_scrape(self) -> tuple[dict, dict]:
+        """Fan ``GET /debug/timeline.json`` out to the non-retired
+        fleet (same timeout/concurrency knobs as the metrics scrape).
+        An unreachable replica keeps its last snapshot and is reported
+        stale — a SIGKILLed replica's final events stay in the merged
+        narrative rather than vanishing with the process."""
+        with self._lock:
+            targets = [
+                r for r in self._replicas.values() if r.state != RETIRED
+            ]
+
+        def scrape(replica: Replica) -> None:
+            try:
+                with urllib.request.urlopen(
+                    urllib.request.Request(
+                        replica.url + "/debug/timeline.json"
+                    ),
+                    timeout=self._federation_timeout_s,
+                ) as resp:
+                    payload = json.loads(resp.read() or b"null")
+            except (OSError, ValueError):
+                replica.mark_timeline_stale()
+                return
+            if isinstance(payload, dict):
+                replica.store_timeline(payload)
+            else:
+                replica.mark_timeline_stale()
+
+        if targets:
+            with ThreadPoolExecutor(
+                max_workers=min(
+                    self._federation_concurrency, len(targets)
+                ),
+                thread_name_prefix="pio-timeline",
+            ) as pool:
+                list(pool.map(scrape, targets))
+        payloads: dict[str, dict] = {}
+        stale: dict[str, bool] = {}
+        for replica in targets:
+            snapshot, is_stale = replica.timeline_state()
+            if snapshot:
+                payloads[replica.replica_id] = snapshot
+                stale[replica.replica_id] = is_stale
+        return payloads, stale
+
+    def federated_timeline(self) -> dict:
+        """The router's ``/debug/timeline.json`` body: every replica's
+        ring plus the router's own, merged into one wall-clock-ordered
+        event stream with per-event ``replica`` provenance."""
+        payloads, stale = self._timeline_scrape()
+        merged = timeline_mod.merge_timelines(
+            [("router", self._timeline.to_dict())]
+            + sorted(payloads.items())
+        )
+        merged["stale"] = sorted(r for r, s in stale.items() if s)
+        return merged
 
     def fleet_health(self) -> dict:
         """The status/CLI fleet-health block: goodput, worst-class

@@ -87,6 +87,12 @@ if [ "${1:-}" != "--smoke-only" ]; then
 fi
 
 echo "== telemetry smoke test (live /metrics scrape) =="
+# also asserts per-tenant cost attribution under mixed-tenant traffic
+# (summed pio_tenant_device_seconds_total == the batcher's measured
+# device time within 1%, locally AND in the router's fleet merge) and
+# the federated incident timeline (/debug/timeline.json time-ordered
+# across 2 replicas with one SIGKILLed mid-run: stale, not absent) --
+# docs/observability.md "Cost attribution" / "Incident timeline"
 if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
     python scripts/metrics_smoke.py; then
     echo "telemetry smoke test FAILED"
@@ -171,7 +177,10 @@ fi
 echo "== serving density bench (multi-tenant model pool, docs/serving.md) =="
 # models-resident x QPS per chip, int8 vs f32 under one byte budget:
 # int8 must hold >= 2x the tenants at goodput parity with the recall
-# gate met — recorded to SERVING_BENCH.json as serving-density/v1.
+# gate met — recorded to SERVING_BENCH.json as serving-density/v1;
+# each pass also records per-tenant attributed device-seconds
+# (attributed_device_s + per_tenant) so the density record doubles as
+# a cost-attribution fixture.
 # QPS parity is recorded-not-gated when the f32 baseline is degenerate
 # on the runner (< 5 QPS); capacity and recall always gate
 if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \

@@ -190,6 +190,73 @@ def main() -> int:
             ),
             "/debug/traces.json retains the raw span tree",
         )
+
+        # mixed-tenant traffic: the X-PIO-Tenant identity must surface
+        # as per-tenant cost series, and the summed attribution must
+        # conserve the batcher's total measured device time (1%)
+        for i in range(12):
+            tenant = "tenant-a" if i % 3 else "tenant-b"
+            req = urllib.request.Request(
+                f"{base}/queries.json",
+                data=json.dumps({"x": i}).encode(),
+                method="POST",
+                headers={"X-PIO-Tenant": tenant},
+            )
+            with urllib.request.urlopen(req, timeout=10):
+                pass
+        with urllib.request.urlopen(
+            f"{base}/metrics.json", timeout=10
+        ) as resp:
+            data = json.load(resp)
+        tenant_dev = {
+            s["labels"]["tenant"]: s["value"]
+            for s in data.get("pio_tenant_device_seconds_total", {}).get(
+                "samples", ()
+            )
+        }
+        check(
+            {"tenant-a", "tenant-b"} <= set(tenant_dev),
+            "per-tenant device-seconds series surface per X-PIO-Tenant",
+        )
+        measured = sum(
+            s["sum"]
+            for name in (
+                "pio_device_enqueue_seconds",
+                "pio_device_sync_seconds",
+            )
+            for s in data.get(name, {}).get("samples", ())
+        )
+        attributed = sum(tenant_dev.values())
+        check(
+            measured > 0
+            and abs(attributed - measured) <= 0.01 * measured,
+            f"tenant attribution conserves device time "
+            f"({attributed:.6f}s vs {measured:.6f}s measured)",
+        )
+        tenant_req = {
+            (s["labels"]["tenant"], s["labels"]["status"])
+            for s in data.get("pio_tenant_requests_total", {}).get(
+                "samples", ()
+            )
+        }
+        check(
+            ("tenant-a", "ok") in tenant_req,
+            "pio_tenant_requests_total carries tenant+status labels",
+        )
+
+        # the incident timeline: every server serves its ring, opening
+        # with the server_start marker
+        with urllib.request.urlopen(
+            f"{base}/debug/timeline.json", timeout=10
+        ) as resp:
+            ring = json.load(resp)
+        check(
+            any(
+                e.get("kind") == "server_start"
+                for e in ring.get("events", ())
+            ),
+            "/debug/timeline.json serves the process ring",
+        )
     finally:
         http.shutdown()
         server.close()
@@ -274,10 +341,17 @@ def federation_section(failures: list[str]) -> None:
 
         served = 0
         for i in range(24):
+            # mixed tenants: the identity hops the router to the
+            # replicas, whose attribution series then federate
             req = urllib.request.Request(
                 f"{base}/queries.json",
                 data=json.dumps({"x": i}).encode(),
                 method="POST",
+                headers={
+                    "X-PIO-Tenant": (
+                        "tenant-a" if i % 3 else "tenant-b"
+                    )
+                },
             )
             with urllib.request.urlopen(req, timeout=20) as resp:
                 served += resp.status == 200
@@ -337,6 +411,68 @@ def federation_section(failures: list[str]) -> None:
             "fleet rollup gauges exported beside replica series",
         )
 
+        fleet_tenants = {
+            s["labels"]["tenant"]
+            for s in fed["fleet"]
+            .get("pio_tenant_device_seconds_total", {})
+            .get("samples", ())
+        }
+        check(
+            {"tenant-a", "tenant-b"} <= fleet_tenants,
+            "per-tenant cost series federate fleet-wide",
+        )
+        fleet_measured = sum(
+            s["sum"]
+            for name in (
+                "pio_device_enqueue_seconds",
+                "pio_device_sync_seconds",
+            )
+            for s in fed["fleet"].get(name, {}).get("samples", ())
+        )
+        fleet_attributed = sum(
+            s["value"]
+            for s in fed["fleet"]
+            .get("pio_tenant_device_seconds_total", {})
+            .get("samples", ())
+        )
+        check(
+            fleet_measured > 0
+            and abs(fleet_attributed - fleet_measured)
+            <= 0.01 * fleet_measured,
+            f"fleet tenant attribution conserves device time "
+            f"({fleet_attributed:.6f}s vs {fleet_measured:.6f}s)",
+        )
+
+        # merged incident timeline, both replicas live: the per-replica
+        # rings plus the router's own, one wall-ordered narrative
+        with urllib.request.urlopen(
+            f"{base}/debug/timeline.json", timeout=20
+        ) as resp:
+            tl1 = json.load(resp)
+        by_replica = {
+            e.get("replica")
+            for e in tl1.get("events", ())
+            if e.get("kind") == "server_start"
+        }
+        check(
+            {"a", "b"} <= by_replica,
+            "merged timeline carries both replicas' rings",
+        )
+        check(
+            "router" in tl1.get("replicas", ())
+            and any(
+                e.get("kind") == "replica_registered"
+                for e in tl1.get("events", ())
+            ),
+            "router's own membership events join the merge",
+        )
+        walls = [e.get("wall", 0.0) for e in tl1.get("events", ())]
+        check(
+            walls == sorted(walls) and len(walls) > 0,
+            "merged timeline events are wall-clock ordered",
+        )
+        check(tl1.get("stale") == [], "no timeline stale while both live")
+
         print(f"SIGKILL replica b (pid {proc_b.pid})", flush=True)
         os.kill(proc_b.pid, signal.SIGKILL)
         proc_b.wait(timeout=30)
@@ -361,6 +497,31 @@ def federation_section(failures: list[str]) -> None:
         check(
             stale_marker == 1.0,
             "pio_federation_stale{replica=b} == 1",
+        )
+
+        # the SIGKILLed replica's timeline: stale, not absent — its
+        # final events stay in the merged narrative, still in order
+        with urllib.request.urlopen(
+            f"{base}/debug/timeline.json", timeout=20
+        ) as resp:
+            tl2 = json.load(resp)
+        check(
+            "b" in tl2.get("stale", ())
+            and "b" in tl2.get("replicas", ()),
+            "SIGKILLed replica's timeline marked stale, not dropped",
+        )
+        check(
+            any(
+                e.get("replica") == "b"
+                and e.get("kind") == "server_start"
+                for e in tl2.get("events", ())
+            ),
+            "dead replica's last timeline snapshot still contributes",
+        )
+        walls2 = [e.get("wall", 0.0) for e in tl2.get("events", ())]
+        check(
+            walls2 == sorted(walls2),
+            "merged timeline stays wall-ordered across the kill",
         )
     finally:
         http.shutdown()

@@ -757,6 +757,7 @@ def density_main(args) -> int:
     import jax
     import jax.numpy as jnp
 
+    from predictionio_tpu.obs import MetricRegistry
     from predictionio_tpu.ops import quantize, similarity
     from predictionio_tpu.ops.pallas_topk import fused_top_k_dot
     from predictionio_tpu.serving.modelpool import ModelPool
@@ -806,7 +807,19 @@ def density_main(args) -> int:
         return load
 
     def run_pass(mode: str) -> dict:
-        pool = ModelPool(budget_bytes=budget)
+        # cost attribution rides the same shared tenant families the
+        # batcher registers (identical kind + labels): each request's
+        # timed device seconds are charged to the tenant it served,
+        # and pool residency accrues byte-seconds — the density record
+        # carries the per-tenant cost split, not just the aggregate
+        registry = MetricRegistry()
+        device_seconds = registry.counter(
+            "pio_tenant_device_seconds_total",
+            "Measured device time (enqueue + sync) apportioned to the "
+            "tenant's slots, by slot count per coalesced batch",
+            ("tenant",),
+        )
+        pool = ModelPool(budget_bytes=budget, registry=registry)
         try:
             # capacity: cycle every tenant once; what stays resident
             # is the budget's tenant count for this precision
@@ -822,13 +835,39 @@ def density_main(args) -> int:
             t0 = time.perf_counter()
             for idx in sequence:
                 name = f"t{int(idx)}"
+                req_t0 = time.perf_counter()
                 with pool.pin(name, loader_for(name, mode)) as table:
                     jax.block_until_ready(
                         similarity.top_k_dot(queries, table, topk)[1]
                     )
+                device_seconds.labels(name).inc(
+                    time.perf_counter() - req_t0
+                )
             elapsed = time.perf_counter() - t0
-            stats = pool.stats()
+            stats = pool.stats()  # settles residency byte-seconds too
             qps = round(requests / elapsed, 1)
+
+            def by_tenant(metric_name):
+                family = registry.to_dict().get(metric_name) or {}
+                return {
+                    s["labels"]["tenant"]: s["value"]
+                    for s in family.get("samples") or []
+                    if s.get("labels", {}).get("tenant")
+                }
+
+            attributed = by_tenant("pio_tenant_device_seconds_total")
+            byte_seconds = by_tenant(
+                "pio_tenant_resident_byte_seconds_total"
+            )
+            per_tenant = {
+                t: {
+                    "device_s": round(dev, 4),
+                    "byte_s": round(byte_seconds.get(t, 0.0), 1),
+                }
+                for t, dev in sorted(
+                    attributed.items(), key=lambda kv: -kv[1]
+                )[:5]
+            }
             return {
                 "mode": mode,
                 "tenants_resident": resident,
@@ -839,6 +878,10 @@ def density_main(args) -> int:
                 "density": round(resident * qps, 1),
                 "evictions": stats["evictions"],
                 "elapsed_s": round(elapsed, 3),
+                "attributed_device_s": round(
+                    sum(attributed.values()), 3
+                ),
+                "per_tenant": per_tenant,
             }
         finally:
             pool.close()

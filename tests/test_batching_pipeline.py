@@ -641,3 +641,148 @@ class TestBatchEwmaSettlement:
             assert 0.2 <= b._batch_ewma_s <= 0.4
         finally:
             b.close()
+
+
+class TestTenantAttribution:
+    """Per-tenant cost attribution (docs/observability.md "Cost
+    attribution"): each batch's measured device time is apportioned
+    across its slots by slot count, so the per-tenant counters sum to
+    exactly the batcher's total measured device time."""
+
+    def _attributed(self, data):
+        fam = data.get("pio_tenant_device_seconds_total") or {}
+        return {
+            s["labels"]["tenant"]: s["value"]
+            for s in fam.get("samples") or []
+        }
+
+    def _measured_total(self, data):
+        # the exported histogram sums round at 1e-6: slot the batch fn
+        # a couple ms of work so the 1% tolerance dominates rounding
+        return (
+            data["pio_device_enqueue_seconds"]["samples"][0]["sum"]
+            + data["pio_device_sync_seconds"]["samples"][0]["sum"]
+        )
+
+    def test_device_seconds_conserved_across_tenants(self):
+        from predictionio_tpu.serving import admission
+
+        def batch_fn(items):
+            time.sleep(0.002)
+            return [i * 2 for i in items]
+
+        reg = MetricRegistry()
+        b = MicroBatcher(
+            batch_fn, max_batch=4, max_wait_ms=2, registry=reg,
+        )
+        try:
+            futures = []
+            for i in range(24):
+                with admission.tenant(f"t{i % 3}"):
+                    futures.append(b.submit(i))
+            assert [f.result(5) for f in futures] == [
+                i * 2 for i in range(24)
+            ]
+        finally:
+            b.close()
+        data = reg.to_dict()
+        per_tenant = self._attributed(data)
+        assert set(per_tenant) == {"t0", "t1", "t2"}
+        # conservation: attribution is an exact partition of the
+        # measured device time, not a second measurement of it
+        assert sum(per_tenant.values()) == pytest.approx(
+            self._measured_total(data), rel=0.01
+        )
+        requests = {
+            (s["labels"]["tenant"], s["labels"]["status"]): s["value"]
+            for s in data["pio_tenant_requests_total"]["samples"]
+        }
+        assert sum(requests.values()) == 24.0
+        assert all(status == "ok" for _, status in requests)
+        waits = {
+            s["labels"]["tenant"]: s["count"]
+            for s in data["pio_tenant_queue_wait_seconds"]["samples"]
+        }
+        assert waits == {"t0": 8, "t1": 8, "t2": 8}
+
+    def test_failed_batches_still_attributed(self):
+        from predictionio_tpu.serving import admission
+
+        def boom(items):
+            time.sleep(0.002)
+            raise ValueError("injected batch failure")
+
+        reg = MetricRegistry()
+        b = MicroBatcher(boom, max_batch=4, max_wait_ms=2, registry=reg)
+        try:
+            with admission.tenant("t-err"):
+                futures = [b.submit(i) for i in range(4)]
+            for f in futures:
+                with pytest.raises(ValueError):
+                    f.result(5)
+        finally:
+            b.close()
+        data = reg.to_dict()
+        per_tenant = self._attributed(data)
+        # a failed batch burned real device/host time — it must be
+        # charged, or the books don't balance
+        assert set(per_tenant) == {"t-err"}
+        assert sum(per_tenant.values()) == pytest.approx(
+            self._measured_total(data), rel=0.01
+        )
+        requests = {
+            s["labels"]["status"]: s["value"]
+            for s in data["pio_tenant_requests_total"]["samples"]
+        }
+        assert requests == {"error": 4.0}
+
+    def test_anonymous_requests_charge_the_empty_tenant(self):
+        reg = MetricRegistry()
+        b = MicroBatcher(
+            lambda items: items, max_batch=2, max_wait_ms=2,
+            registry=reg,
+        )
+        try:
+            [f.result(5) for f in [b.submit(i) for i in range(2)]]
+        finally:
+            b.close()
+        per_tenant = self._attributed(reg.to_dict())
+        assert set(per_tenant) == {""}
+
+    def test_noisy_neighbor_requires_overuse_and_harm(self):
+        from predictionio_tpu.obs import timeline as timeline_mod
+        from predictionio_tpu.serving.batching import _NoisyRollup
+
+        reg = MetricRegistry()
+        gauge = reg.gauge("pio_tenant_noisy", "h", ("tenant",))
+        ring = timeline_mod.Timeline(capacity=16)
+        previous = timeline_mod.set_timeline(ring)
+        try:
+            roll = _NoisyRollup(gauge)
+            # hog takes ~5x the fair share AND the victim breaches its
+            # queue-wait SLO -> flagged at window rollover
+            roll.observe("hog", 5.0, 0.0)
+            roll.observe("victim", 1.0, roll.wait_slo_s * 2)
+            roll.window_end = 0.0  # force the rollover
+            roll.observe("victim", 0.0, 0.0)
+            flags = {
+                s["labels"]["tenant"]: s["value"]
+                for s in reg.to_dict()["pio_tenant_noisy"]["samples"]
+            }
+            assert flags.get("hog") == 1.0
+            assert "victim" not in flags or flags["victim"] == 0.0
+            kinds = [e["kind"] for e in ring.events()]
+            assert "noisy_neighbor" in kinds
+            # overuse with NO harmed neighbor (nobody breached the
+            # wait SLO) clears the flag at the next rollover
+            roll.observe("hog", 5.0, 0.0)
+            roll.observe("victim", 1.0, 0.0)
+            roll.window_end = 0.0
+            roll.observe("victim", 0.0, 0.0)
+            flags = {
+                s["labels"]["tenant"]: s["value"]
+                for s in reg.to_dict()["pio_tenant_noisy"]["samples"]
+            }
+            assert flags.get("hog") == 0.0
+        finally:
+            timeline_mod.set_timeline(previous)

@@ -15,6 +15,7 @@ import pytest
 
 from predictionio_tpu.obs import MetricRegistry
 from predictionio_tpu.obs import federation as fed
+from predictionio_tpu.obs import timeline as timeline_mod
 from predictionio_tpu.obs.device import CompileTracker, DeviceSampler
 from predictionio_tpu.obs.slo import (
     CRITICAL,
@@ -413,8 +414,10 @@ class MetricReplica:
             self.registry, short_window_s=60.0, long_window_s=600.0
         )
         self.registry.gauge("pio_device_hbm_used_bytes", "h", ("device",))
+        self.timeline = timeline_mod.Timeline(capacity=64)
         router = Router()
         router.route("GET", "/metrics.json", self._metrics)
+        router.route("GET", "/debug/timeline.json", self._timeline)
         self.http = HTTPServer(
             router, host="127.0.0.1", port=0, service=f"rep-{name}"
         )
@@ -431,6 +434,9 @@ class MetricReplica:
 
     def _metrics(self, request):
         return Response(200, self.registry.to_dict())
+
+    def _timeline(self, request):
+        return Response(200, self.timeline.to_dict())
 
     def close(self):
         self.http.shutdown()
@@ -961,3 +967,208 @@ class TestProfileEndpoint:
             timeout=60,
         )
         assert status == 200
+
+
+# -- tenant cost attribution federation ------------------------------------
+
+
+class TestTenantFederation:
+    """Tenant-labeled series federate like any other: counters sum per
+    tenant label set, histograms bucket-merge per tenant — the fleet
+    per-tenant cost rollup is exact, not re-estimated."""
+
+    def _charge(self, replica, tenant, device_s, waits):
+        replica.registry.counter(
+            "pio_tenant_device_seconds_total", "h", ("tenant",)
+        ).labels(tenant).inc(device_s)
+        hist = replica.registry.histogram(
+            "pio_tenant_queue_wait_seconds",
+            "h",
+            ("tenant",),
+            buckets=(0.1, 0.5, 1.0),
+        )
+        for w in waits:
+            hist.labels(tenant).observe(w)
+
+    def test_tenant_histograms_and_counters_merge_per_tenant(self):
+        a, b = MetricReplica("a"), MetricReplica("b")
+        self._charge(a, "t1", 2.5, [0.05, 0.3])
+        self._charge(a, "t2", 0.5, [0.05])
+        self._charge(b, "t1", 1.5, [0.7])
+        router = _make_router(a, b)
+        try:
+            fleet = router.federated_dict()["fleet"]
+            device = {
+                s["labels"]["tenant"]: s["value"]
+                for s in fleet["pio_tenant_device_seconds_total"][
+                    "samples"
+                ]
+            }
+            assert device == {"t1": 4.0, "t2": 0.5}
+            waits = {
+                s["labels"]["tenant"]: s
+                for s in fleet["pio_tenant_queue_wait_seconds"][
+                    "samples"
+                ]
+            }
+            # t1's histogram is the union of a's and b's observations
+            assert waits["t1"]["count"] == 3
+            assert waits["t1"]["buckets"]["0.1"] == 1
+            assert waits["t1"]["buckets"]["0.5"] == 1
+            assert waits["t1"]["buckets"]["1"] == 1
+            assert waits["t2"]["count"] == 1
+        finally:
+            router.close()
+            a.close()
+            b.close()
+
+
+# -- incident timeline -----------------------------------------------------
+
+
+class TestTimelineMerge:
+    """merge_timelines ordering semantics (unit level, controlled wall
+    stamps — cross-process ordering must use the wall clock, with seq
+    breaking ties within one replica)."""
+
+    def _payload(self, *events):
+        return {"dropped": 0, "events": [dict(e) for e in events]}
+
+    def test_events_order_by_wall_across_replicas(self):
+        a = self._payload(
+            {"kind": "k1", "wall": 10.0, "seq": 1},
+            {"kind": "k3", "wall": 30.0, "seq": 2},
+        )
+        b = self._payload({"kind": "k2", "wall": 20.0, "seq": 1})
+        merged = timeline_mod.merge_timelines([("a", a), ("b", b)])
+        assert [e["kind"] for e in merged["events"]] == [
+            "k1", "k2", "k3",
+        ]
+        assert [e["replica"] for e in merged["events"]] == [
+            "a", "b", "a",
+        ]
+        assert merged["replicas"] == ["a", "b"]
+
+    def test_seq_breaks_same_tick_ties_within_replica(self):
+        a = self._payload(
+            {"kind": "second", "wall": 10.0, "seq": 2},
+            {"kind": "first", "wall": 10.0, "seq": 1},
+        )
+        merged = timeline_mod.merge_timelines([("a", a)])
+        assert [e["kind"] for e in merged["events"]] == [
+            "first", "second",
+        ]
+
+    def test_none_payload_contributes_nothing(self):
+        a = self._payload({"kind": "k", "wall": 1.0, "seq": 1})
+        merged = timeline_mod.merge_timelines([("a", a), ("b", None)])
+        assert merged["replicas"] == ["a"]
+        assert len(merged["events"]) == 1
+
+    def test_limit_keeps_newest_and_counts_dropped(self):
+        a = self._payload(
+            *(
+                {"kind": f"k{i}", "wall": float(i), "seq": i}
+                for i in range(5)
+            )
+        )
+        merged = timeline_mod.merge_timelines([("a", a)], limit=2)
+        assert [e["kind"] for e in merged["events"]] == ["k3", "k4"]
+        assert merged["dropped"] == 3
+
+    def test_ring_capacity_drops_oldest(self):
+        ring = timeline_mod.Timeline(capacity=3)
+        for i in range(5):
+            ring.record(f"k{i}", "m")
+        payload = ring.to_dict()
+        assert payload["dropped"] == 2
+        assert [e["kind"] for e in payload["events"]] == [
+            "k2", "k3", "k4",
+        ]
+
+
+class TestRouterTimeline:
+    def test_federated_timeline_merges_and_orders(self):
+        a, b = MetricReplica("a"), MetricReplica("b")
+        a.timeline.record("pool_eviction", "evicted t9", tenant="t9")
+        time.sleep(0.01)
+        b.timeline.record("breaker_transition", "breaker -> open")
+        router = _make_router(a, b)
+        try:
+            merged = router.federated_timeline()
+            assert set(merged["replicas"]) >= {"a", "b"}
+            assert merged["stale"] == []
+            kinds = [e["kind"] for e in merged["events"]]
+            assert kinds.index("pool_eviction") < kinds.index(
+                "breaker_transition"
+            )
+            walls = [e["wall"] for e in merged["events"]]
+            assert walls == sorted(walls)
+        finally:
+            router.close()
+            a.close()
+            b.close()
+
+    def test_killed_replica_is_stale_not_absent(self):
+        a, b = MetricReplica("a"), MetricReplica("b")
+        b.timeline.record("pool_load_timeout", "t3 cold load timed out")
+        router = _make_router(a, b)
+        try:
+            first = router.federated_timeline()
+            assert first["stale"] == []
+            b.close()  # connection refused on the next scrape
+            a.timeline.record("autoscaler_action", "grow to 3")
+            second = router.federated_timeline()
+            assert second["stale"] == ["b"]
+            assert "b" in second["replicas"]
+            kinds_by_replica = {
+                (e["replica"], e["kind"]) for e in second["events"]
+            }
+            # the dead replica's LAST snapshot still contributes...
+            assert ("b", "pool_load_timeout") in kinds_by_replica
+            # ...beside events recorded after it died
+            assert ("a", "autoscaler_action") in kinds_by_replica
+            walls = [e["wall"] for e in second["events"]]
+            assert walls == sorted(walls)
+        finally:
+            router.close()
+            a.close()
+
+    def test_router_serves_merged_timeline_endpoint(self):
+        a = MetricReplica("a")
+        a.timeline.record("canary_verdict", "promote g2", generation=2)
+        router = _make_router(a)
+        http = router.serve(host="127.0.0.1", port=0)
+        http.start()
+        try:
+            status, body = _call(
+                f"http://127.0.0.1:{http.port}/debug/timeline.json"
+            )
+            assert status == 200
+            payload = json.loads(body)
+            assert any(
+                e["kind"] == "canary_verdict" and e["replica"] == "a"
+                for e in payload["events"]
+            )
+            # the router's own ring is in the merge (swap_phase etc.
+            # land there); its id is "router"
+            assert "router" in payload["replicas"]
+        finally:
+            http.shutdown()
+            router.close()
+            a.close()
+
+    def test_swap_phase_lands_in_router_timeline(self):
+        router = _make_router()
+        try:
+            record = {"id": "s1", "generation": "g2"}
+            router._set_swap_phase(record, "draining")
+            events = router._timeline.events()
+            assert any(
+                e["kind"] == "swap_phase"
+                and e["phase"] == "draining"
+                and e["generation"] == "g2"
+                for e in events
+            )
+        finally:
+            router.close()
