@@ -51,6 +51,34 @@ def _resolve(args) -> tuple:
     return engine, params, engine_id, variant.get("variant", "default"), variant
 
 
+def _apply_store_urls(urls: list[str], access_key: str = "") -> None:
+    """Point every repository at a replicated store-server set
+    (repeated ``--store-url``): quorum writes, failover reads, hinted
+    handoff — docs/storage.md "Replication & failover". One URL is the
+    degenerate W=1 case and behaves like a plain httpstore source."""
+    from predictionio_tpu.data.storage import Storage, set_storage
+
+    env = dict(os.environ)
+    env.update(
+        {
+            "PIO_STORAGE_SOURCES_REPLSET_TYPE": "replicated",
+            "PIO_STORAGE_SOURCES_REPLSET_URLS": ",".join(urls),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "REPLSET",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "REPLSET",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "REPLSET",
+        }
+    )
+    if access_key:
+        env["PIO_STORAGE_SOURCES_REPLSET_KEY"] = access_key
+    set_storage(Storage(env))
+
+
+def _store_urls_from_args(args) -> None:
+    urls = getattr(args, "store_urls", None)
+    if urls:
+        _apply_store_urls(urls, getattr(args, "store_access_key", ""))
+
+
 def _batched_insert(events_iter, backend, app_id, channel_id) -> int:
     """Insert an event stream in 500-event batches; returns the count."""
     batch, n = [], 0
@@ -458,10 +486,66 @@ def _print_metrics(url: str, access_key: str = "") -> int:
     return 0
 
 
+def _print_store_status(urls: list[str], access_key: str = "") -> int:
+    """``status --store-url`` (repeatable): one health line per store
+    node from its /healthz — role, peer count, replication lag, hint
+    queue depth, last anti-entropy sync. Pure HTTP, never imports jax
+    (mirrors ``status --metrics-url``)."""
+    import time as _time
+
+    failed = 0
+    for url in urls:
+        base = url.rstrip("/")
+        payload = _fetch_json(f"{base}/healthz", access_key=access_key)
+        if payload is None:
+            failed += 1
+            continue
+        state = payload.get("status", "?")
+        repl = payload.get("replication")
+        if not isinstance(repl, dict):
+            print(f"Store {base}: {state}, standalone (no replication)")
+            continue
+        peers = repl.get("peers") or []
+        parts = [
+            f"Store {base}: {state}",
+            f"role={repl.get('role', '?')}",
+            f"peers={len(peers)}",
+        ]
+        lags = [
+            p.get("lagSeconds")
+            for p in peers
+            if p.get("lagSeconds") is not None
+        ]
+        if lags:
+            parts.append(f"lag={max(lags):.1f}s")
+        hints = [p.get("hintsPending") for p in peers
+                 if p.get("hintsPending") is not None]
+        if hints:
+            parts.append(f"hints-pending={sum(hints)}")
+        last = repl.get("lastSync")
+        if last:
+            parts.append(f"last-sync={max(0.0, _time.time() - last):.1f}s ago")
+        down = [
+            p.get("url", "?") for p in peers
+            if p.get("error") or p.get("breaker") == "open"
+        ]
+        if down:
+            parts.append(f"unreachable={','.join(down)}")
+        print(" ".join(parts))
+        if state != "ok":
+            failed += 1
+    return 1 if failed else 0
+
+
 def cmd_status(args) -> int:
     """Reference Console.status:1035-1107: verify storage + compute.
     With ``--metrics-url`` it instead scrapes a running server's
     telemetry registry (any server: engine, event, store, dashboard)."""
+    if getattr(args, "store_urls", None):
+        # replicated-store health; pure HTTP like --metrics-url
+        return _print_store_status(
+            args.store_urls, getattr(args, "access_key", "")
+        )
     if getattr(args, "router_url", ""):
         # fleet summary + metrics; pure HTTP like --metrics-url
         return _print_router_status(
@@ -1103,6 +1187,7 @@ def cmd_train(args) -> int:
     from predictionio_tpu.core.engine import WorkflowParams
     from predictionio_tpu.core.workflow import run_train
 
+    _store_urls_from_args(args)
     engine, params, engine_id, variant, variant_dict = _resolve(args)
     workflow = WorkflowParams(
         batch=_variant_batch(args, variant_dict),
@@ -1144,6 +1229,7 @@ def cmd_eval(args) -> int:
 def cmd_deploy(args) -> int:
     from predictionio_tpu.serving.engine_server import EngineServer
 
+    _store_urls_from_args(args)
     if args.max_batch < 1:
         # 0 would also zero the derived queue bound, silently disabling
         # overload shedding — refuse at deploy time
@@ -1262,6 +1348,7 @@ def cmd_trainer(args) -> int:
     import signal as _signal
     import threading
 
+    _store_urls_from_args(args)
     base_dir = args.checkpoint_dir or os.path.join(
         os.environ.get(
             "PIO_FS_BASEDIR",
@@ -1478,6 +1565,7 @@ def cmd_undeploy(args) -> int:
 def cmd_eventserver(args) -> int:
     from predictionio_tpu.serving.event_server import create_event_server
 
+    _store_urls_from_args(args)
     multi = args.workers > 1
     if multi and (err := _reuseport_unsupported()):
         print(err, file=sys.stderr)
@@ -1543,9 +1631,16 @@ def cmd_storeserver(args) -> int:
             file=sys.stderr,
         )
     http = create_store_server(
-        host=args.ip, port=args.port, server_config=config
+        host=args.ip, port=args.port, server_config=config,
+        peers=getattr(args, "peers", None) or None,
+        role=getattr(args, "role", "replica"),
     )
     print(f"Store server is listening on {args.ip}:{http.port}")
+    if getattr(args, "peers", None):
+        print(
+            f"Replication: role={args.role}, anti-entropy against "
+            f"{len(args.peers)} peer(s)"
+        )
     return _serve_foreground(http)
 
 
@@ -1942,6 +2037,19 @@ def cmd_daemon(args) -> int:
 # -- parser ----------------------------------------------------------------
 
 
+def _store_url_args(p) -> None:
+    p.add_argument(
+        "--store-url", dest="store_urls", action="append", default=None,
+        help="replicated store-server base URL (repeat once per peer): "
+             "writes need a W-of-N quorum, reads fail over between "
+             "peers (docs/storage.md)",
+    )
+    p.add_argument(
+        "--store-access-key", dest="store_access_key", default="",
+        help="access key the store-server peers require",
+    )
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="pio-tpu",
@@ -1970,6 +2078,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--access-key", dest="access_key", default="",
         help="server access key for key-authed scrape targets "
              "(sent as the X-PIO-Server-Key header)",
+    )
+    p.add_argument(
+        "--store-url", dest="store_urls", action="append", default=None,
+        help="print one store-health line per URL (role, peer count, "
+             "replication lag, hint-queue depth, last anti-entropy "
+             "sync) instead of checking local storage/compute",
     )
     p.set_defaults(func=cmd_status)
 
@@ -2175,6 +2289,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--stop-after-prepare", action="store_true")
     p.add_argument("--no-save-model", action="store_true")
     _checkpoint_args(p)
+    _store_url_args(p)
     p.set_defaults(func=cmd_train)
 
     p = sub.add_parser("eval")
@@ -2254,6 +2369,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--reuse-port", action="store_true", help=argparse.SUPPRESS
     )
+    _store_url_args(p)
     p.set_defaults(func=cmd_deploy)
 
     p = sub.add_parser("undeploy")
@@ -2321,6 +2437,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="run the training loop directly instead of supervising a "
              "respawned child (the child mode of the supervisor)",
     )
+    _store_url_args(p)
     p.set_defaults(func=cmd_trainer)
 
     p = sub.add_parser("router")
@@ -2404,6 +2521,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--reuse-port", action="store_true", help=argparse.SUPPRESS
     )
+    _store_url_args(p)
     p.set_defaults(func=cmd_eventserver)
 
     p = sub.add_parser("dashboard")
@@ -2495,6 +2613,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--access-key", dest="access_key", default="",
         help="require this key on every request (Bearer/accessKey)",
+    )
+    p.add_argument(
+        "--peer", dest="peers", action="append", default=None,
+        help="replica-set sibling base URL (repeat once per peer): "
+             "turns on the background anti-entropy loop that pulls "
+             "missed events/models/metadata from the named peers",
+    )
+    p.add_argument(
+        "--role", default="replica", choices=("primary", "replica"),
+        help="reported in /healthz and `pio-tpu status --store-url` "
+             "(informational; every node accepts writes)",
     )
     p.set_defaults(func=cmd_storeserver)
 

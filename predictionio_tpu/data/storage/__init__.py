@@ -157,9 +157,11 @@ def _register_builtins() -> None:
     register_backend(
         "mysql", BackendSpec(client=_mysql_client, **_sql_daos)
     )
-    # networked store server (metadata + models, like the reference's
-    # elasticsearch + hdfs backend family); events stay with a local or
-    # postgres source — the same split the reference runs in production
+    # networked store server (metadata + models + events, like the
+    # reference's elasticsearch + hdfs + hbase backend family); the
+    # event routes exist primarily so the replicated tier below can
+    # quorum-write and anti-entropy-pull them, but a single remote
+    # store server works as a plain event source too
     def _httpstore_client(config: dict):
         from predictionio_tpu.data.storage import httpstore
 
@@ -184,6 +186,33 @@ def _register_builtins() -> None:
             engine_manifests=_http_dao("HTTPEngineManifests"),
             evaluation_instances=_http_dao("HTTPEvaluationInstances"),
             models=_http_dao("HTTPModels"),
+            events=_http_dao("HTTPEvents"),
+        ),
+    )
+    # replicated tier over N store servers: quorum writes, failover
+    # reads with read-repair, hinted handoff (docs/storage.md
+    # "Replication & failover"); one client owns the peer pool, every
+    # DAO is a fan-out wrapper
+    def _replicated_client(config: dict):
+        from predictionio_tpu.data.storage import replicated
+
+        return replicated.ReplicatedStoreClient(config)
+
+    def _repl_dao(name: str):
+        return lambda client: client.dao(name)
+
+    register_backend(
+        "replicated",
+        BackendSpec(
+            client=_replicated_client,
+            apps=_repl_dao("apps"),
+            access_keys=_repl_dao("access_keys"),
+            channels=_repl_dao("channels"),
+            engine_instances=_repl_dao("engine_instances"),
+            engine_manifests=_repl_dao("engine_manifests"),
+            evaluation_instances=_repl_dao("evaluation_instances"),
+            models=_repl_dao("models"),
+            events=_repl_dao("events"),
         ),
     )
     # native C++ event log (events only, like the reference's hbase

@@ -315,6 +315,14 @@ class ModelsBackend(abc.ABC):
     @abc.abstractmethod
     def delete(self, model_id: str) -> bool: ...
 
+    def list_ids(self) -> list[str] | None:
+        """Enumerate stored blob ids, or ``None`` when the backend
+        cannot (a plain KV store with no scan). Anti-entropy
+        (:mod:`predictionio_tpu.data.storage.replicated`) uses this to
+        diff model sets between peers; ``None`` just disables the
+        model-repair pass for that backend, it is not an error."""
+        return None
+
     def quarantine(self, model_id: str) -> bool:
         """Move a corrupt blob aside so no later read can pick it up,
         keeping the bytes for forensics. Default emulation re-inserts

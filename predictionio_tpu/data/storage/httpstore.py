@@ -39,6 +39,7 @@ from typing import Any
 from predictionio_tpu.obs import tracing
 from predictionio_tpu.obs.context import get_request_id
 from predictionio_tpu.serving import admission, resilience
+from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage.base import (
     AccessKey,
     AccessKeysBackend,
@@ -52,11 +53,34 @@ from predictionio_tpu.data.storage.base import (
     EngineManifestsBackend,
     EvaluationInstance,
     EvaluationInstancesBackend,
+    EventsBackend,
     Model,
     ModelsBackend,
+    PartialBatchError,
     StorageError,
 )
 from predictionio_tpu.data.storage.sql_common import from_iso, iso
+
+#: write-sequencing header for event inserts: ``<writer-id>:<seq>``.
+#: A torn send (connection died after the request left, before the
+#: response arrived) is ambiguous for a POST — the server may or may
+#: not have committed. A replay carrying the SAME sequence token lets
+#: the server answer from its dedupe cache instead of inserting twice,
+#: which matters for the append-only eventlog backend where a duplicate
+#: id would otherwise land as a second record. Used by the replicated
+#: store tier (docs/storage.md "Replication & failover").
+STORE_SEQ_HEADER = "X-PIO-Store-Seq"
+#: marks a hinted-handoff replay: the server must fall back to an
+#: id-existence check even when it already knows the writer — by replay
+#: time, anti-entropy may have pulled the same events from a sibling,
+#: and the monotonic-seq shortcut alone would append them twice
+STORE_REPLAY_HEADER = "X-PIO-Store-Replay"
+
+#: wire encoding for the tri-state target-entity filters
+#: (``Option[Option[String]]`` semantics, base.EventsBackend.find):
+#: param absent = no filter (Ellipsis), this sentinel = "must be
+#: absent" (None), anything else = "must match".
+TRI_NULL = "__null__"
 
 # --------------------------------------------------------------------------
 # record ↔ JSON codecs (single wire-shape definition, used by both sides)
@@ -844,3 +868,203 @@ class HTTPModels(ModelsBackend):
             "DELETE", f"/models/{_q(model_id)}"
         )
         return bool(out.get("ok"))
+
+    def list_ids(self) -> list[str] | None:
+        out = self._c.json("GET", "/models")
+        ids = (out or {}).get("ids")
+        return list(ids) if ids is not None else None
+
+
+class HTTPEvents(EventsBackend):
+    """Event DAO over the store server's ``/events`` routes.
+
+    Completes the httpstore backend family for the replicated tier:
+    a ``ReplicatedStore`` peer IS a store server, so event replication
+    needs the event DAO to speak the same wire as metadata and models.
+    Events are stamped with their UUID *client-side* before the POST —
+    the server upserts by id on sqlite/memory and dedupes replays by
+    ``X-PIO-Store-Seq`` on the append-only eventlog, so a retried send
+    can never double-insert.
+    """
+
+    def __init__(self, client: HTTPStoreClient):
+        self._c = client
+
+    @staticmethod
+    def _chan(channel_id: int | None) -> dict:
+        return {} if channel_id is None else {"channel_id": channel_id}
+
+    def init(self, app_id: int, channel_id: int | None = None) -> bool:
+        out = self._c.json(
+            "PUT", f"/events/{_q(app_id)}", params=self._chan(channel_id)
+        )
+        return bool(out.get("ok"))
+
+    def remove(self, app_id: int, channel_id: int | None = None) -> bool:
+        out = self._c.json(
+            "DELETE", f"/events/{_q(app_id)}", params=self._chan(channel_id)
+        )
+        return bool(out.get("ok"))
+
+    def close(self) -> None:
+        self._c.close()
+
+    def _post(
+        self,
+        path: str,
+        params: dict,
+        json_body,
+        store_seq: str | None,
+        replay: bool = False,
+    ) -> tuple[int, bytes]:
+        headers = {}
+        if store_seq:
+            headers[STORE_SEQ_HEADER] = store_seq
+        if replay:
+            headers[STORE_REPLAY_HEADER] = "1"
+        return self._c.request(
+            "POST",
+            path,
+            params=params,
+            json_body=json_body,
+            extra_headers=headers or None,
+        )
+
+    def insert(
+        self,
+        event: Event,
+        app_id: int,
+        channel_id: int | None = None,
+        *,
+        store_seq: str | None = None,
+        replay: bool = False,
+    ) -> str:
+        stamped = event.with_id(event.event_id)
+        status, data = self._post(
+            f"/events/{_q(app_id)}",
+            self._chan(channel_id),
+            stamped.to_json_dict(),
+            store_seq,
+            replay,
+        )
+        if not 200 <= status < 300:
+            raise StorageError(
+                f"store server: event insert -> HTTP {status}: "
+                f"{data[:200].decode('utf-8', 'replace')}"
+            )
+        out = json.loads(data) if data else {}
+        return out.get("id") or stamped.event_id
+
+    def insert_batch(
+        self,
+        events,
+        app_id: int,
+        channel_id: int | None = None,
+        *,
+        store_seq: str | None = None,
+        replay: bool = False,
+    ) -> list[str]:
+        if not events:
+            return []
+        stamped = [e.with_id(e.event_id) for e in events]
+        status, data = self._post(
+            f"/events/{_q(app_id)}/batch",
+            self._chan(channel_id),
+            [e.to_json_dict() for e in stamped],
+            store_seq,
+            replay,
+        )
+        out = json.loads(data) if data else {}
+        if status == 409 and "insertedIds" in out:
+            # the server's durable-prefix report (a PartialBatchError on
+            # its backend) rides a 409 — 5xx would be swallowed by the
+            # transport layer before the body could be parsed
+            raise PartialBatchError(
+                out.get("error", "partial batch insert"),
+                list(out["insertedIds"]),
+            )
+        if not 200 <= status < 300:
+            raise StorageError(
+                f"store server: event batch insert -> HTTP {status}: "
+                f"{data[:200].decode('utf-8', 'replace')}"
+            )
+        return list(out.get("ids") or [e.event_id for e in stamped])
+
+    def get(
+        self, event_id: str, app_id: int, channel_id: int | None = None
+    ) -> Event | None:
+        d = self._c.json(
+            "GET",
+            f"/events/{_q(app_id)}/one/{_q(event_id)}",
+            params=self._chan(channel_id),
+            not_found_ok=True,
+        )
+        return Event.from_json_dict(d) if d else None
+
+    def delete(
+        self, event_id: str, app_id: int, channel_id: int | None = None
+    ) -> bool:
+        out = self._c.json(
+            "DELETE",
+            f"/events/{_q(app_id)}/one/{_q(event_id)}",
+            params=self._chan(channel_id),
+        )
+        return bool(out.get("ok"))
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        start_time: _dt.datetime | None = None,
+        until_time: _dt.datetime | None = None,
+        entity_type: str | None = None,
+        entity_id: str | None = None,
+        event_names=None,
+        target_entity_type=...,
+        target_entity_id=...,
+        limit: int | None = None,
+        reversed: bool = False,
+    ):
+        params: dict[str, Any] = dict(self._chan(channel_id))
+        if start_time is not None:
+            params["start_time"] = start_time.isoformat()
+        if until_time is not None:
+            params["until_time"] = until_time.isoformat()
+        if entity_type is not None:
+            params["entity_type"] = entity_type
+        if entity_id is not None:
+            params["entity_id"] = entity_id
+        if event_names is not None:
+            # JSON-encoded so names containing separators round-trip
+            params["event_names"] = json.dumps(list(event_names))
+        if target_entity_type is not ...:
+            params["target_entity_type"] = (
+                TRI_NULL if target_entity_type is None
+                else target_entity_type
+            )
+        if target_entity_id is not ...:
+            params["target_entity_id"] = (
+                TRI_NULL if target_entity_id is None else target_entity_id
+            )
+        if limit is not None:
+            params["limit"] = limit
+        if reversed:
+            params["reversed"] = 1
+        out = self._c.json(
+            "GET", f"/events/{_q(app_id)}", params=params
+        )
+        for d in out or []:
+            yield Event.from_json_dict(d)
+
+    def watermark(
+        self, app_id: int, channel_id: int | None = None
+    ) -> dict:
+        """The server's event-set summary for one (app, channel) —
+        ``{"count", "checksum", "latest"}``. Anti-entropy compares the
+        order-independent checksum between peers; a mismatch triggers a
+        full pull (docs/storage.md "Replication & failover")."""
+        return self._c.json(
+            "GET",
+            f"/events/{_q(app_id)}/watermark",
+            params=self._chan(channel_id),
+        )

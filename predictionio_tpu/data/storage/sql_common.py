@@ -663,6 +663,10 @@ class SQLModels(ModelsBackend):
             "DELETE FROM models WHERE id=?", (model_id,)
         ) > 0
 
+    def list_ids(self) -> list[str] | None:
+        rows = self._c.query("SELECT id FROM models ORDER BY id")
+        return [r[0] for r in rows]
+
 
 EVENT_COLS = (
     "id event entity_type entity_id target_entity_type target_entity_id "

@@ -33,6 +33,7 @@ from predictionio_tpu.data.event import Event, EventValidationError
 from predictionio_tpu.data.storage import Storage, get_storage
 from predictionio_tpu.data.storage.base import PartialBatchError
 from predictionio_tpu.obs import MetricRegistry, get_registry
+from predictionio_tpu.obs import timeline as timeline_mod
 from predictionio_tpu.obs import tracing
 from predictionio_tpu.serving import admission as admission_mod
 from predictionio_tpu.serving.http import (
@@ -100,8 +101,14 @@ class EventServer:
         self.router = Router()
         r = self.router
         install_metrics_routes(
-            r, self.registry, self.tracer, server_config=server_config
+            r, self.registry, self.tracer, server_config=server_config,
+            # the process-global ring (NOT a private one): the
+            # replicated-store client emits failover / hinted-handoff
+            # events there, and /debug/timeline.json is where operators
+            # and `pio-tpu timeline` go to see them
+            timeline=timeline_mod.get_timeline(),
         )
+        r.healthz_extra = self._healthz_extra
         r.route("GET", "/", self._status)
         r.route("POST", "/events.json", self._create_event)
         r.route("GET", "/events.json", self._find_events)
@@ -169,6 +176,17 @@ class EventServer:
         # pid identifies which SO_REUSEPORT worker answered (ops +
         # the multi-worker tests); reference returns a bare status line
         return Response(200, {"status": "alive", "pid": os.getpid()})
+
+    def _healthz_extra(self) -> dict:
+        """When ingest goes through a replicated store set, surface the
+        client-side quorum view (per-peer breaker state, hint depth) in
+        /healthz beside the admission fields."""
+        from predictionio_tpu.data.storage.replicated import (
+            replication_status,
+        )
+
+        status = replication_status(self._storage)
+        return {"replication": status} if status else {}
 
     def _validate(
         self, event: Event, app_id: int, channel_id, whitelist

@@ -130,6 +130,12 @@ class Router:
         #: by the owning server BEFORE HTTPServer construction;
         #: docs/robustness.md "Overload & backpressure")
         self.admission: admission.AdmissionController | None = None
+        #: optional zero-arg callable whose dict is merged into the
+        #: ``/healthz`` payload (the store server reports replication
+        #: role + peer lag here; docs/storage.md "Replication &
+        #: failover"). Must be cheap and non-blocking: health probes
+        #: run on the admission path.
+        self.healthz_extra: Callable[[], dict] | None = None
 
     def route(self, method: str, pattern: str, handler: Handler) -> None:
         # escape literal segments so '.' in '.json' doesn't match anything
@@ -393,14 +399,19 @@ class HTTPServer:
                 if path == "/healthz" and self.command == "GET":
                     draining = state.draining.is_set()
                     request.route = "/healthz"
-                    return Response(
-                        503 if draining else 200,
-                        {
-                            "status": "draining" if draining else "ok",
-                            "service": service,
-                            "pid": os.getpid(),
-                        },
-                    )
+                    payload = {
+                        "status": "draining" if draining else "ok",
+                        "service": service,
+                        "pid": os.getpid(),
+                    }
+                    extra = router_ref.healthz_extra
+                    if extra is not None:
+                        try:
+                            payload.update(extra() or {})
+                        except Exception as e:  # noqa: BLE001
+                            # a broken reporter must not fail the probe
+                            payload["extra_error"] = str(e)
+                    return Response(503 if draining else 200, payload)
                 if self._draining_at_entry and not telemetry_path:
                     request.route = "(draining)"
                     if rejected_total is not None:

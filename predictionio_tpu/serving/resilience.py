@@ -607,9 +607,17 @@ class ChaosReset(Exception):
     exact failure a crashed server produces)."""
 
 
+class ChaosPartition(ChaosReset):
+    """Injected network partition: the connection is accepted and the
+    request read, then the handler holds the socket for ``ms`` (packets
+    into a black hole — the client just waits) before slamming it shut
+    without a response. Subclasses :class:`ChaosReset` so the HTTP
+    layer's no-response socket-close path handles both."""
+
+
 @dataclass(frozen=True)
 class _ChaosRule:
-    fault: str  # latency | error | reset
+    fault: str  # latency | error | reset | partition
     p: float
     ms: float = 0.0
     status: int = 503
@@ -620,14 +628,18 @@ class ChaosMiddleware:
 
     Spec format (env ``PIO_CHAOS``), semicolon-separated rules::
 
-        latency:p=0.1,ms=200;error:p=0.05;reset:p=0.02
+        latency:p=0.1,ms=200;error:p=0.05;reset:p=0.02;partition:p=0.01,ms=100
 
     Rules are evaluated in order per request, each consuming exactly
     one PRNG draw — so for a given seed (``PIO_CHAOS_SEED``) and a
     serialized request sequence the fault schedule is reproducible.
     ``latency`` sleeps and continues to the next rule; ``error`` raises
     :class:`ChaosError` (default status 503, override with
-    ``status=``); ``reset`` raises :class:`ChaosReset`.
+    ``status=``); ``reset`` raises :class:`ChaosReset`; ``partition``
+    accepts the connection, holds it for ``ms`` (default 0), then
+    raises :class:`ChaosPartition` — the client sees a stall followed
+    by a dead socket with no response, the shape of a network
+    partition rather than a crashed process.
 
     The telemetry surface (``/healthz``, ``/metrics*``, ``/debug/*``)
     is exempted by the HTTP layer: chaos must not blind the operator
@@ -661,10 +673,10 @@ class ChaosMiddleware:
                 continue
             fault, _, arg_str = part.partition(":")
             fault = fault.strip()
-            if fault not in ("latency", "error", "reset"):
+            if fault not in ("latency", "error", "reset", "partition"):
                 raise ValueError(
                     f"chaos spec: unknown fault {fault!r} "
-                    "(expected latency|error|reset)"
+                    "(expected latency|error|reset|partition)"
                 )
             args: dict[str, float] = {}
             for pair in filter(None, arg_str.split(",")):
@@ -724,5 +736,13 @@ class ChaosMiddleware:
                 raise ChaosError(
                     rule.status, f"chaos: injected error on {path}"
                 )
+            elif rule.fault == "partition":
+                # accept, swallow, stall, then reset without a
+                # response — what a mid-connection network partition
+                # looks like from the client side (vs `reset`, which
+                # fails fast like a crashed process)
+                if rule.ms > 0:
+                    time.sleep(rule.ms / 1000.0)
+                raise ChaosPartition()
             else:  # reset
                 raise ChaosReset()

@@ -20,9 +20,24 @@ Routes::
     PUT    /meta/<kind>/<id>                    update    -> {"ok": bool}
     DELETE /meta/<kind>/<id>                    delete    -> {"ok": bool}
     GET/PUT/DELETE /meta/engine_manifests/<id>/<version>   (2-part key)
+    GET    /models                              -> {"ids": [...]} | 501
     PUT    /models/<id>                         blob upload (octet-stream)
     GET    /models/<id>                         blob | 404
     DELETE /models/<id>                         -> {"ok": bool}
+    PUT    /events/<app_id>                     init      -> {"ok": bool}
+    DELETE /events/<app_id>                     remove    -> {"ok": bool}
+    POST   /events/<app_id>                     insert    -> {"id": ...}
+    POST   /events/<app_id>/batch               -> {"ids": [...]} | 409
+    GET    /events/<app_id>                     find (query-param filters)
+    GET    /events/<app_id>/watermark           event-set summary
+    GET    /events/<app_id>/one/<event_id>      event | 404
+    DELETE /events/<app_id>/one/<event_id>      -> {"ok": bool}
+
+(``?channel_id=`` selects a channel on every /events route.) Event
+inserts honor ``X-PIO-Store-Seq`` replay dedupe and the replicated
+tier's peers join via ``--peer`` (docs/storage.md "Replication &
+failover"): ``/healthz`` then reports replication role + per-peer lag
+and failover/repair transitions land in ``/debug/timeline.json``.
 
 Auth: optional — start with an access key (``--access-key`` or
 ``PIO_SERVER_ACCESS_KEY``) and every request must carry it
@@ -33,11 +48,24 @@ dashboard uses.
 
 from __future__ import annotations
 
+import collections
+import datetime as _dt
+import hashlib
+import json
+import threading
 import urllib.parse
 
+from predictionio_tpu.data.event import Event, EventValidationError
 from predictionio_tpu.data.storage import Storage, get_storage
-from predictionio_tpu.data.storage.base import Model, StorageError
+from predictionio_tpu.data.storage.base import (
+    Model,
+    PartialBatchError,
+    StorageError,
+)
 from predictionio_tpu.data.storage.httpstore import (
+    STORE_REPLAY_HEADER,
+    STORE_SEQ_HEADER,
+    TRI_NULL,
     access_key_from_json,
     access_key_to_json,
     app_from_json,
@@ -52,6 +80,7 @@ from predictionio_tpu.data.storage.httpstore import (
     manifest_to_json,
 )
 from predictionio_tpu.obs import MetricRegistry, get_registry
+from predictionio_tpu.obs import timeline as timeline_mod
 from predictionio_tpu.obs import tracing
 from predictionio_tpu.serving.config import ServerConfig
 from predictionio_tpu.serving.http import (
@@ -62,6 +91,20 @@ from predictionio_tpu.serving.http import (
     Router,
     install_metrics_routes,
 )
+
+
+def event_set_checksum(ids) -> str:
+    """Order-independent digest of an event-id set: XOR-fold of each
+    id's sha256 prefix. Two peers holding the same events report the
+    same checksum regardless of insertion order — the cheap equality
+    probe anti-entropy runs before deciding to stream a delta."""
+    acc = 0
+    n = 0
+    for event_id in ids:
+        digest = hashlib.sha256(event_id.encode()).digest()
+        acc ^= int.from_bytes(digest[:8], "big")
+        n += 1
+    return f"{n}:{acc:016x}"
 
 
 class StoreServer:
@@ -78,6 +121,24 @@ class StoreServer:
         self._storage = storage or get_storage()
         self.registry = registry if registry is not None else get_registry()
         self.tracer = tracer if tracer is not None else tracing.get_tracer()
+        self.timeline = timeline_mod.Timeline(registry=self.registry)
+        timeline_mod.set_timeline(self.timeline)
+        #: X-PIO-Store-Seq replay dedupe: writer -> (seq, status, body).
+        #: One slot per writer (sequences are monotonic per writer, so
+        #: only the LAST write can ever be replayed after a torn send);
+        #: bounded LRU so a churn of writer ids cannot grow it.
+        self._seq_cache: collections.OrderedDict[
+            str, tuple[int, int, object]
+        ] = collections.OrderedDict()
+        self._seq_lock = threading.Lock()
+        #: serializes existence-check + append on the event routes with
+        #: the anti-entropy pull — both are check-then-insert against an
+        #: append-only log, and interleaving them lands duplicate
+        #: records no repair pass can ever remove
+        self.ingest_lock = threading.Lock()
+        #: set by create_store_server when --peer URLs are given; the
+        #: /healthz payload and anti-entropy loop hang off it
+        self.replication = None
         s = self._storage
         #: <kind> -> (dao getter, to_json, from_json, id parser);
         #: getters defer DAO construction to request time
@@ -118,8 +179,23 @@ class StoreServer:
         }
         self.router = Router()
         r = self.router
-        install_metrics_routes(r, self.registry, self.tracer)
+        install_metrics_routes(
+            r, self.registry, self.tracer, timeline=self.timeline
+        )
+        r.healthz_extra = self._healthz_extra
         r.route("GET", "/", self._status)
+        # events: fixed-tail routes before the parameterized ones so
+        # ".../batch" and ".../watermark" never bind as an id
+        r.route("POST", "/events/<app_id>/batch", self._event_batch)
+        r.route("GET", "/events/<app_id>/watermark", self._event_watermark)
+        r.route("GET", "/events/<app_id>/one/<event_id>", self._event_get)
+        r.route("DELETE", "/events/<app_id>/one/<event_id>",
+                self._event_delete)
+        r.route("PUT", "/events/<app_id>", self._event_init)
+        r.route("DELETE", "/events/<app_id>", self._event_remove)
+        r.route("POST", "/events/<app_id>", self._event_insert)
+        r.route("GET", "/events/<app_id>", self._event_find)
+        r.route("GET", "/models", self._model_list)
         r.route("GET", "/meta/engine_manifests/<id>/<version>",
                 self._manifest_get)
         r.route("PUT", "/meta/engine_manifests/<id>/<version>",
@@ -169,6 +245,68 @@ class StoreServer:
                 "engine_manifests is keyed by (id, version); use "
                 "/meta/engine_manifests/<id>/<version>",
             )
+
+    def _healthz_extra(self) -> dict:
+        if self.replication is None:
+            return {}
+        return {"replication": self.replication.status()}
+
+    # -- X-PIO-Store-Seq replay dedupe ------------------------------------
+
+    @staticmethod
+    def _parse_seq(raw: str) -> tuple[str, int] | None:
+        writer, sep, seq = raw.rpartition(":")
+        if not sep or not writer:
+            return None
+        try:
+            return writer, int(seq)
+        except ValueError:
+            return None
+
+    _SEQ_CACHE_MAX = 1024
+
+    def _seq_replay(self, request: Request):
+        """Returns (token, cached Response | None, writer_known). A
+        replay of the writer's LAST sequence answers from the cache
+        without touching the backend — the append-only eventlog would
+        otherwise record the event twice. ``writer_known=False`` (first
+        write from this writer since the server started) tells the
+        insert path to fall back to an id-existence check: the one
+        window where a replay could arrive with the cache cold.
+
+        ``X-PIO-Store-Replay`` forces ``writer_known=False`` even for a
+        warm writer: hinted-handoff replays arrive AFTER anti-entropy
+        may have pulled the same events from a sibling, so the
+        monotonic-seq shortcut alone would append them twice."""
+        replay = bool(request.headers.get(STORE_REPLAY_HEADER))
+        raw = (request.headers.get(STORE_SEQ_HEADER) or "").strip()
+        if not raw:
+            return None, None, not replay
+        token = self._parse_seq(raw)
+        if token is None:
+            raise HTTPError(
+                400, f"bad {STORE_SEQ_HEADER} {raw!r}; want <writer>:<seq>"
+            )
+        writer, seq = token
+        with self._seq_lock:
+            hit = self._seq_cache.get(writer)
+            if hit is not None:
+                self._seq_cache.move_to_end(writer)
+                last_seq, status, body = hit
+                if seq == last_seq:
+                    return token, Response(status, body), True
+                return token, None, not replay
+        return token, None, False
+
+    def _seq_commit(self, token, status: int, body) -> None:
+        if token is None:
+            return
+        writer, seq = token
+        with self._seq_lock:
+            self._seq_cache[writer] = (seq, status, body)
+            self._seq_cache.move_to_end(writer)
+            while len(self._seq_cache) > self._SEQ_CACHE_MAX:
+                self._seq_cache.popitem(last=False)
 
     # -- routes -----------------------------------------------------------
 
@@ -341,6 +479,215 @@ class StoreServer:
         model_id = urllib.parse.unquote(request.path_params["id"])
         return Response(200, {"ok": bool(self._models().delete(model_id))})
 
+    def _model_list(self, request: Request) -> Response:
+        with tracing.span("dao/models.list_ids"):
+            ids = self._models().list_ids()
+        if ids is None:
+            # backend without enumeration: anti-entropy skips the
+            # model-repair pass rather than failing the peer
+            raise HTTPError(501, "model backend cannot enumerate ids")
+        return Response(200, {"ids": ids})
+
+    # -- events -----------------------------------------------------------
+
+    def _events(self):
+        try:
+            return self._storage.get_events()
+        except StorageError as e:
+            raise HTTPError(500, str(e)) from e
+
+    @staticmethod
+    def _event_coords(request: Request) -> tuple[int, int | None]:
+        try:
+            app_id = int(request.path_params["app_id"])
+        except ValueError as e:
+            raise HTTPError(400, "app_id must be an int") from e
+        chan_raw = request.query.get("channel_id")
+        if chan_raw in (None, ""):
+            return app_id, None
+        try:
+            return app_id, int(chan_raw)
+        except ValueError as e:
+            raise HTTPError(400, "channel_id must be an int") from e
+
+    def _event_init(self, request: Request) -> Response:
+        app_id, channel_id = self._event_coords(request)
+        with tracing.span("dao/events.init"):
+            ok = self._events().init(app_id, channel_id)
+        return Response(200, {"ok": bool(ok)})
+
+    def _event_remove(self, request: Request) -> Response:
+        app_id, channel_id = self._event_coords(request)
+        with tracing.span("dao/events.remove"):
+            ok = self._events().remove(app_id, channel_id)
+        return Response(200, {"ok": bool(ok)})
+
+    @staticmethod
+    def _parse_event(d) -> Event:
+        if not isinstance(d, dict):
+            raise HTTPError(400, "event JSON object required")
+        try:
+            # stamp missing ids HERE so the response (and the seq
+            # cache) can report concrete ids the client may replay
+            return Event.from_json_dict(d).with_id(d.get("eventId"))
+        except EventValidationError as e:
+            raise HTTPError(400, f"bad event: {e}") from e
+
+    def _event_insert(self, request: Request) -> Response:
+        app_id, channel_id = self._event_coords(request)
+        token, cached, writer_known = self._seq_replay(request)
+        if cached is not None:
+            return cached
+        event = self._parse_event(request.json())
+        dao = self._events()
+        with self.ingest_lock:
+            if not writer_known and dao.get(
+                event.event_id, app_id, channel_id
+            ) is not None:
+                # cold-cache replay (writer's first contact since this
+                # server started): the id is already durable here
+                self._seq_commit(token, 201, {"id": event.event_id})
+                return Response(201, {"id": event.event_id})
+            with tracing.span("dao/events.insert"):
+                event_id = dao.insert(event, app_id, channel_id)
+        self._seq_commit(token, 201, {"id": event_id})
+        return Response(201, {"id": event_id})
+
+    def _event_batch(self, request: Request) -> Response:
+        app_id, channel_id = self._event_coords(request)
+        token, cached, writer_known = self._seq_replay(request)
+        if cached is not None:
+            return cached
+        body = request.json()
+        if not isinstance(body, list):
+            raise HTTPError(400, "event JSON array required")
+        events = [self._parse_event(d) for d in body]
+        all_ids = [e.event_id for e in events]
+        dao = self._events()
+        try:
+            with self.ingest_lock:
+                if not writer_known:
+                    # cold-cache replay window: skip events already
+                    # durable so the append-only eventlog never records
+                    # one twice (the response still acks the FULL batch
+                    # — they are all here)
+                    events = [
+                        e
+                        for e in events
+                        if dao.get(e.event_id, app_id, channel_id) is None
+                    ]
+                with tracing.span(
+                    "dao/events.insert_batch", n=len(events)
+                ):
+                    if events:
+                        dao.insert_batch(events, app_id, channel_id)
+        except PartialBatchError as e:
+            # durable-prefix report on 409: a 5xx would be consumed by
+            # the client transport before the prefix could be read.
+            # Ids skipped as already-durable count as inserted.
+            remaining = {ev.event_id for ev in events}
+            durable = [i for i in all_ids if i not in remaining]
+            durable.extend(e.inserted_ids)
+            payload = {"error": str(e), "insertedIds": durable}
+            self._seq_commit(token, 409, payload)
+            return Response(409, payload)
+        self._seq_commit(token, 201, {"ids": all_ids})
+        return Response(201, {"ids": all_ids})
+
+    def _event_find(self, request: Request) -> Response:
+        app_id, channel_id = self._event_coords(request)
+        q = request.query
+
+        def _time(key: str) -> _dt.datetime | None:
+            raw = q.get(key)
+            if raw in (None, ""):
+                return None
+            try:
+                return _dt.datetime.fromisoformat(raw)
+            except ValueError as e:
+                raise HTTPError(400, f"{key} not ISO-8601: {raw!r}") from e
+
+        def _tri(key: str):
+            raw = q.get(key)
+            if raw is None:
+                return ...
+            return None if raw == TRI_NULL else raw
+
+        event_names = None
+        if q.get("event_names") not in (None, ""):
+            try:
+                event_names = json.loads(q["event_names"])
+            except ValueError as e:
+                raise HTTPError(
+                    400, "event_names must be a JSON array"
+                ) from e
+        limit = None
+        if q.get("limit") not in (None, ""):
+            try:
+                limit = int(q["limit"])
+            except ValueError as e:
+                raise HTTPError(400, "limit must be an int") from e
+        with tracing.span("dao/events.find"):
+            out = [
+                e.to_json_dict()
+                for e in self._events().find(
+                    app_id,
+                    channel_id,
+                    start_time=_time("start_time"),
+                    until_time=_time("until_time"),
+                    entity_type=q.get("entity_type"),
+                    entity_id=q.get("entity_id"),
+                    event_names=event_names,
+                    target_entity_type=_tri("target_entity_type"),
+                    target_entity_id=_tri("target_entity_id"),
+                    limit=limit,
+                    reversed=q.get("reversed") not in (None, "", "0"),
+                )
+            ]
+        return Response(200, out)
+
+    def _event_watermark(self, request: Request) -> Response:
+        app_id, channel_id = self._event_coords(request)
+        latest = None
+        latest_id = None
+
+        def _ids():
+            nonlocal latest, latest_id
+            for e in self._events().find(app_id, channel_id):
+                if latest is None or e.creation_time > latest:
+                    latest = e.creation_time
+                    latest_id = e.event_id
+                yield e.event_id
+
+        with tracing.span("dao/events.watermark"):
+            checksum = event_set_checksum(_ids())
+        count = int(checksum.split(":", 1)[0])
+        return Response(
+            200,
+            {
+                "count": count,
+                "checksum": checksum,
+                "latest": latest.isoformat() if latest else None,
+                "latestId": latest_id,
+            },
+        )
+
+    def _event_get(self, request: Request) -> Response:
+        app_id, channel_id = self._event_coords(request)
+        event_id = urllib.parse.unquote(request.path_params["event_id"])
+        with tracing.span("dao/events.get"):
+            event = self._events().get(event_id, app_id, channel_id)
+        if event is None:
+            raise HTTPError(404, "not found")
+        return Response(200, event.to_json_dict())
+
+    def _event_delete(self, request: Request) -> Response:
+        app_id, channel_id = self._event_coords(request)
+        event_id = urllib.parse.unquote(request.path_params["event_id"])
+        with tracing.span("dao/events.delete"):
+            ok = self._events().delete(event_id, app_id, channel_id)
+        return Response(200, {"ok": bool(ok)})
+
 
 def create_store_server(
     host: str = "0.0.0.0",
@@ -349,9 +696,18 @@ def create_store_server(
     server_config: ServerConfig | None = None,
     registry: MetricRegistry | None = None,
     tracer: tracing.Tracer | None = None,
+    peers: list[str] | None = None,
+    role: str = "replica",
 ) -> HTTPServer:
+    """``peers`` (replica-set siblings, base URLs) turns on the
+    anti-entropy loop: this node periodically compares event watermarks
+    + model sets + metadata against each peer and pulls what it is
+    missing, so a restarted node converges without operator action
+    (docs/storage.md "Replication & failover"). ``role`` is reporting
+    only — every node repairs itself; quorum placement is the client's
+    job (data/storage/replicated.py)."""
     server = StoreServer(storage, registry=registry, tracer=tracer)
-    return HTTPServer(
+    http = HTTPServer(
         server.router,
         host=host,
         port=port,
@@ -360,3 +716,22 @@ def create_store_server(
         registry=server.registry,
         tracer=server.tracer,
     )
+    if peers:
+        from predictionio_tpu.data.storage.replicated import AntiEntropyLoop
+
+        loop = AntiEntropyLoop(
+            storage=server._storage,
+            peers=peers,
+            role=role,
+            registry=server.registry,
+            timeline=server.timeline,
+            key=(server_config.access_key if server_config else "") or None,
+            insert_lock=server.ingest_lock,
+        )
+        server.replication = loop
+        loop.start()
+        http.add_drain_hook(loop.close)
+    #: the app object, reachable from the HTTPServer handle (tests and
+    #: the CLI reuse it for replication status)
+    http.store_app = server
+    return http

@@ -106,6 +106,18 @@ if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
     rc=1
 fi
 
+echo "== store HA smoke test (replicated tier, docs/storage.md) =="
+# kill -9 the primary store node under continuous ingest: zero
+# ack'd-write loss through the W-of-N quorum, a generation published
+# during the outage loads from a replica, and the restarted node
+# converges via hinted handoff + anti-entropy (merged timeline shows
+# the repair)
+if ! timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    python scripts/store_ha_smoke.py; then
+    echo "store HA smoke test FAILED"
+    rc=1
+fi
+
 echo "== serving pipeline bench (closed + open loop) =="
 # BENCH-format JSON lands on stdout AND is appended to
 # SERVING_BENCH.json (serving-bench/v1) so the perf trajectory is

@@ -1,0 +1,460 @@
+"""Replicated store tier HA smoke: kill -9 the primary, lose nothing.
+
+The proof scenario behind docs/storage.md "Replication & failover",
+run against real processes:
+
+1. Three ``pio-tpu storeserver`` nodes (eventlog events with fsync,
+   sqlite metadata, localfs models) peer with each other; one replica
+   runs with ``PIO_CHAOS=partition:p=0.05,ms=50`` so a slice of its
+   traffic hits a mid-request network partition throughout.
+2. An event server started with three ``--store-url`` flags takes
+   continuous single + batched ingest and read traffic.
+3. The PRIMARY store node is SIGKILLed mid-batch. Ingest must keep
+   acking through the surviving W-of-N quorum, and every event the
+   client was EVER acked must still be durable — the
+   zero-ack'd-write-loss contract.
+4. During the outage a trainer publishes a model generation through
+   the replicated backend (manifest commit-point included) and a
+   replica-only reader loads it back checksum-verified.
+5. The killed node restarts on the same port and converges via
+   anti-entropy + hinted handoff: event watermark checksums equalise
+   across all three nodes and the outage-era generation appears.
+6. The failover/hint/repair story is visible in the merged
+   ``/debug/timeline.json`` narrative and via ``pio-tpu timeline``;
+   ``pio-tpu status --store-url`` reports per-node replication health.
+
+Run by ``scripts/check.sh`` next to chaos_smoke.py / fleet_smoke.py.
+"""
+
+from __future__ import annotations
+
+import os
+
+# knobs before any predictionio_tpu import: fast breaker recovery so
+# the restarted node is probed within a second, tight replication
+# cadences so convergence is observable inside a CI budget
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["PIO_BREAKER_FAILURES"] = "3"
+os.environ["PIO_BREAKER_RESET_S"] = "0.8"
+os.environ["PIO_STORE_SYNC_INTERVAL"] = "0.5"
+os.environ["PIO_STORE_HINT_INTERVAL"] = "0.5"
+
+import datetime as dt  # noqa: E402
+import hashlib  # noqa: E402
+import json  # noqa: E402
+import shutil  # noqa: E402
+import socket  # noqa: E402
+import subprocess  # noqa: E402
+import sys  # noqa: E402
+import tempfile  # noqa: E402
+import threading  # noqa: E402
+import time  # noqa: E402
+import urllib.error  # noqa: E402
+import urllib.request  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from predictionio_tpu.data.storage.base import AccessKey, App  # noqa: E402
+from predictionio_tpu.data.storage.httpstore import (  # noqa: E402
+    HTTPEvents,
+    HTTPModels,
+    HTTPStoreClient,
+)
+from predictionio_tpu.data.storage.replicated import (  # noqa: E402
+    ReplicatedStoreClient,
+)
+from predictionio_tpu.obs.timeline import merge_timelines  # noqa: E402
+
+ACCESS_KEY = "ha-smoke-key"
+CLI = [sys.executable, "-m", "predictionio_tpu.cli.main"]
+
+failures: list[str] = []
+
+
+def check(cond: bool, label: str) -> None:
+    print(("ok   " if cond else "FAIL ") + label, flush=True)
+    if not cond:
+        failures.append(label)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def http_json(url: str, body=None, timeout: float = 5.0, retries: int = 5):
+    """(status, parsed-json) — retried, because one node deliberately
+    partitions a slice of its connections mid-request."""
+    last: Exception | None = None
+    for _ in range(retries):
+        try:
+            data = None if body is None else json.dumps(body).encode()
+            req = urllib.request.Request(
+                url, data=data,
+                headers={"Content-Type": "application/json"} if data else {},
+            )
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, json.loads(resp.read() or b"null")
+        except urllib.error.HTTPError as e:
+            return e.code, None
+        except Exception as e:  # noqa: BLE001 - partition chaos
+            last = e
+            time.sleep(0.05)
+    raise last  # type: ignore[misc]
+
+
+def wait_healthy(url: str, deadline_s: float = 30.0) -> bool:
+    end = time.monotonic() + deadline_s
+    while time.monotonic() < end:
+        try:
+            status, _ = http_json(url + "/healthz", retries=1)
+            if status == 200:
+                return True
+        except Exception:  # noqa: BLE001
+            pass
+        time.sleep(0.1)
+    return False
+
+
+class StoreNode:
+    """One ``pio-tpu storeserver`` subprocess with its own durable
+    stores, restartable on the same port with the same data."""
+
+    def __init__(self, base: str, idx: int, port: int, peers: list[str],
+                 role: str, chaos: str | None = None):
+        self.dir = os.path.join(base, f"node{idx}")
+        os.makedirs(self.dir, exist_ok=True)
+        self.idx, self.port, self.peers, self.role = idx, port, peers, role
+        self.chaos = chaos
+        self.url = f"http://127.0.0.1:{port}"
+        self.proc: subprocess.Popen | None = None
+
+    def env(self) -> dict:
+        env = dict(os.environ)
+        env.update({
+            "PIO_STORAGE_SOURCES_SQL_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_SQL_PATH": f"{self.dir}/meta.db",
+            "PIO_STORAGE_SOURCES_ELOG_TYPE": "eventlog",
+            "PIO_STORAGE_SOURCES_ELOG_PATH": f"{self.dir}/events",
+            "PIO_STORAGE_SOURCES_FS_TYPE": "localfs",
+            "PIO_STORAGE_SOURCES_FS_PATH": f"{self.dir}/models",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQL",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "ELOG",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS",
+            "PIO_EVENTLOG_FSYNC": "1",  # acks must survive kill -9
+            "PIO_FS_BASEDIR": self.dir,
+        })
+        if self.chaos:
+            env["PIO_CHAOS"] = self.chaos
+        return env
+
+    def start(self) -> None:
+        cmd = CLI + ["storeserver", "--ip", "127.0.0.1",
+                     "--port", str(self.port), "--role", self.role]
+        for p in self.peers:
+            cmd += ["--peer", p]
+        self.proc = subprocess.Popen(
+            cmd, env=self.env(),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+
+    def kill9(self) -> None:
+        assert self.proc is not None
+        self.proc.kill()
+        self.proc.wait(timeout=10)
+
+    def stop(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+def events_dao(url: str) -> HTTPEvents:
+    return HTTPEvents(HTTPStoreClient({"URL": url, "TIMEOUT": "5"}))
+
+
+def models_dao(url: str) -> HTTPModels:
+    return HTTPModels(HTTPStoreClient({"URL": url, "TIMEOUT": "5"}))
+
+
+def main() -> int:  # noqa: PLR0915 - one linear scenario
+    base = tempfile.mkdtemp(prefix="pio-store-ha-")
+    ports = [free_port() for _ in range(3)]
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    nodes = [
+        StoreNode(
+            base, i, ports[i],
+            peers=[u for j, u in enumerate(urls) if j != i],
+            role="primary" if i == 0 else "replica",
+            # one replica lives under partition chaos the whole run
+            chaos="partition:p=0.05,ms=50" if i == 2 else None,
+        )
+        for i in range(3)
+    ]
+    es_proc: subprocess.Popen | None = None
+    boot: ReplicatedStoreClient | None = None
+    stop_flag = threading.Event()
+    try:
+        for n in nodes:
+            n.start()
+        check(all(wait_healthy(n.url) for n in nodes),
+              "3 store nodes up and healthy")
+
+        # -- bootstrap app + access key through the replicated client --
+        boot = ReplicatedStoreClient({
+            "URLS": ",".join(urls), "W": "2",
+            "HINT_DIR": os.path.join(base, "boot-hints"),
+        })
+        app_id = boot.dao("apps").insert(App(id=0, name="ha-smoke"))
+        boot.dao("access_keys").insert(
+            AccessKey(key=ACCESS_KEY, appid=app_id)
+        )
+        boot.dao("events").init(app_id)
+
+        # -- event server with three --store-url flags ----------------
+        es_port = free_port()
+        es_env = dict(os.environ)
+        es_env["PIO_FS_BASEDIR"] = os.path.join(base, "es")
+        cmd = CLI + ["eventserver", "--ip", "127.0.0.1",
+                     "--port", str(es_port)]
+        for u in urls:
+            cmd += ["--store-url", u]
+        es_proc = subprocess.Popen(
+            cmd, env=es_env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        es_url = f"http://127.0.0.1:{es_port}"
+        check(wait_healthy(es_url), "event server up (3x --store-url)")
+
+        # -- continuous ingest + serving traffic ----------------------
+        acked: list[str] = []
+        acked_lock = threading.Lock()
+        counters = {"post_fail": 0, "reads": 0, "read_fail": 0}
+
+        def ev(i: int) -> dict:
+            return {
+                "event": "rate", "entityType": "user",
+                "entityId": f"u{i}", "properties": {"n": i},
+                "eventTime": (
+                    dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc)
+                    + dt.timedelta(seconds=i)
+                ).isoformat(),
+            }
+
+        def ingest() -> None:
+            i = 0
+            while not stop_flag.is_set():
+                try:
+                    if i % 10 == 0:  # every 10th write is a batch
+                        batch = [ev(i + k) for k in range(5)]
+                        status, out = http_json(
+                            f"{es_url}/batch/events.json"
+                            f"?accessKey={ACCESS_KEY}",
+                            body=batch, retries=1,
+                        )
+                        got = [
+                            r["eventId"] for r in (out or [])
+                            if isinstance(r, dict)
+                            and r.get("status") == 201
+                        ] if status == 200 else []
+                        with acked_lock:
+                            acked.extend(got)
+                        i += 5
+                    else:
+                        status, out = http_json(
+                            f"{es_url}/events.json"
+                            f"?accessKey={ACCESS_KEY}",
+                            body=ev(i), retries=1,
+                        )
+                        if status == 201 and out and out.get("eventId"):
+                            with acked_lock:
+                                acked.append(out["eventId"])
+                        else:
+                            counters["post_fail"] += 1
+                        i += 1
+                except Exception:  # noqa: BLE001 - keep the loop alive
+                    counters["post_fail"] += 1
+                    i += 1
+                time.sleep(0.01)
+
+        def serve() -> None:
+            while not stop_flag.is_set():
+                try:
+                    status, _ = http_json(
+                        f"{es_url}/events.json?accessKey={ACCESS_KEY}"
+                        "&limit=10", retries=1,
+                    )
+                    counters["reads"] += 1
+                    if status != 200:
+                        counters["read_fail"] += 1
+                except Exception:  # noqa: BLE001
+                    counters["read_fail"] += 1
+                time.sleep(0.02)
+
+        threads = [threading.Thread(target=ingest, daemon=True),
+                   threading.Thread(target=serve, daemon=True)]
+        for t in threads:
+            t.start()
+
+        time.sleep(2.0)
+        with acked_lock:
+            before_kill = len(acked)
+        check(before_kill > 0, "ingest acking before the kill")
+
+        # -- SIGKILL the primary mid-batch ----------------------------
+        nodes[0].kill9()
+        print(f"killed -9 primary store node on port {ports[0]}",
+              flush=True)
+        time.sleep(3.0)
+        with acked_lock:
+            during = len(acked) - before_kill
+        check(during > 0,
+              "ingest keeps acking through the quorum during the "
+              f"primary outage (+{during} acks)")
+
+        # -- trainer publishes a generation DURING the outage ---------
+        from predictionio_tpu.core.persistence import (
+            load_generation,
+            publish_generation,
+        )
+
+        trainer = ReplicatedStoreClient({
+            "URLS": ",".join(urls), "W": "2",
+            "HINT_DIR": os.path.join(base, "trainer-hints"),
+        })
+        blob = hashlib.sha256(b"ha-smoke").digest() * 128
+        publish_generation(trainer.dao("models"), "gen-ha-1", blob)
+        loaded = load_generation(models_dao(urls[1]), "gen-ha-1")
+        check(loaded == blob,
+              "generation published during the outage loads back "
+              "checksum-verified from a replica")
+        check(trainer.hints[trainer.peers[0].name].pending() > 0,
+              "hinted handoff queued for the dead primary")
+
+        # -- restart the killed node on the same port -----------------
+        nodes[0].start()
+        check(wait_healthy(nodes[0].url), "killed primary restarted")
+        time.sleep(2.0)  # let hint drains + anti-entropy rounds run
+        stop_flag.set()
+        for t in threads:
+            t.join(timeout=10)
+        with acked_lock:
+            total = len(acked)
+        print(f"ingest summary: acked={total} "
+              f"post_fail={counters['post_fail']} "
+              f"reads={counters['reads']} "
+              f"read_fail={counters['read_fail']}", flush=True)
+        check(counters["reads"] > 0 and counters["read_fail"] == 0,
+              "serving reads stayed green throughout "
+              f"({counters['reads']} reads)")
+
+        # -- anti-entropy convergence: watermarks equalise ------------
+        daos = [events_dao(u) for u in urls]
+        deadline = time.monotonic() + 60.0
+        converged = False
+        while time.monotonic() < deadline:
+            try:
+                marks = [d.watermark(app_id) for d in daos]
+                if (len({m["checksum"] for m in marks}) == 1
+                        and marks[0]["count"] >= total):
+                    converged = True
+                    break
+            except Exception:  # noqa: BLE001 - node still catching up
+                pass
+            time.sleep(0.5)
+        check(converged,
+              "restarted node converged: event watermark checksums "
+              "equal on all 3 nodes")
+
+        mdeadline = time.monotonic() + 30.0
+        model_ok = False
+        while time.monotonic() < mdeadline:
+            try:
+                if load_generation(
+                    models_dao(urls[0]), "gen-ha-1"
+                ) == blob:
+                    model_ok = True
+                    break
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(0.5)
+        check(model_ok,
+              "outage-era generation repaired onto the restarted node")
+
+        # -- zero ack'd-write loss on EVERY node ----------------------
+        missing = 0
+        with acked_lock:
+            sample = list(acked)
+        for d, u in zip(daos, urls):
+            for eid in sample:
+                if d.get(eid, app_id) is None:
+                    missing += 1
+                    print(f"MISSING {eid} on {u}", flush=True)
+        check(missing == 0,
+              f"zero ack'd-write loss: {total} acked events present "
+              "on all 3 nodes")
+
+        # -- the story is on the merged timeline ----------------------
+        payloads = []
+        for name, u in [("store-0", urls[0]), ("store-1", urls[1]),
+                        ("store-2", urls[2]), ("events", es_url)]:
+            try:
+                _, p = http_json(u + "/debug/timeline.json")
+                payloads.append((name, p))
+            except Exception:  # noqa: BLE001
+                payloads.append((name, None))
+        merged = merge_timelines(payloads)
+        kinds = {e.get("kind") for e in merged.get("events", [])}
+        check("store_antientropy" in kinds,
+              "anti-entropy repair visible in the merged timeline")
+        check(bool(kinds & {"store_hint_enqueued", "store_failover"}),
+              "failover/hint events visible in the merged timeline "
+              f"(kinds={sorted(k for k in kinds if k)})")
+
+        out = subprocess.run(
+            CLI + ["timeline", "--url", nodes[0].url],
+            capture_output=True, text=True, timeout=60,
+        )
+        check(out.returncode == 0
+              and "store_antientropy" in out.stdout,
+              "pio-tpu timeline renders the repair narrative")
+
+        # -- pio-tpu status --store-url health line -------------------
+        out = subprocess.run(
+            CLI + ["status"]
+            + [a for u in urls for a in ("--store-url", u)],
+            capture_output=True, text=True, timeout=60,
+        )
+        check(out.returncode == 0 and "role=" in out.stdout,
+              "pio-tpu status --store-url reports replication health")
+        trainer.close()
+    finally:
+        stop_flag.set()
+        if boot is not None:
+            boot.close()
+        if es_proc is not None and es_proc.poll() is None:
+            es_proc.terminate()
+            try:
+                es_proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                es_proc.kill()
+        for n in nodes:
+            n.stop()
+        shutil.rmtree(base, ignore_errors=True)
+
+    if failures:
+        print(f"\nstore_ha_smoke: {len(failures)} FAILURE(S)")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("\nstore_ha_smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -112,8 +112,8 @@ def httpstore_storage(tmp_path):
     socket: metadata + models through the ``httpstore`` client → JSON/
     HTTP → StoreServer → sqlite/localfs (the reference's elasticsearch +
     hdfs topology, ESApps.scala:1 / HDFSModels.scala:1). Events stay on
-    a memory source — the service doesn't serve events, exactly like
-    the reference's ES metadata backend."""
+    a memory source here for speed — the server does serve events too
+    (the /events/<app> routes; tests/test_httpstore.py covers them)."""
     from predictionio_tpu.serving.store_server import create_store_server
 
     backing = Storage(

@@ -1,0 +1,60 @@
+"""Kill-9 crash-test writer for the replicated (quorum) event path.
+
+The quorum-ack analogue of ``eventlog_crash_child.py``: connects a
+``ReplicatedStoreClient`` to the store-server peer URLs in argv and
+inserts events one at a time, printing ``ACK <i> <event_id>`` —
+flushed — only AFTER the W-of-N quorum write returned. The parent test
+SIGKILLs this process mid-stream and asserts every acked event is
+durable on EVERY peer (W equals N here): the zero-ack'd-write-loss
+contract of docs/storage.md "Replication & failover".
+
+Usage: python tests/quorum_crash_child.py <hint-dir> <url> [<url> ...]
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from predictionio_tpu.data import DataMap, Event  # noqa: E402
+from predictionio_tpu.data.storage.replicated import (  # noqa: E402
+    ReplicatedStoreClient,
+)
+
+APP_ID = 1
+
+
+def main() -> int:
+    hint_dir, urls = sys.argv[1], sys.argv[2:]
+    client = ReplicatedStoreClient(
+        {
+            "URLS": ",".join(urls),
+            "W": str(len(urls)),  # every ack means durable EVERYWHERE
+            "HINT_DIR": hint_dir,
+        }
+    )
+    events = client.dao("events")
+    events.init(APP_ID)
+    t0 = dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc)
+    i = 0
+    while True:
+        event = Event(
+            event="rate",
+            entity_type="user",
+            entity_id=f"u{i}",
+            properties=DataMap({"n": i}),
+            event_time=t0 + dt.timedelta(seconds=i),
+        )
+        event_id = events.insert(event, APP_ID)
+        # the ack the parent trusts: printed strictly after W peers
+        # reported the write durable
+        print(f"ACK {i} {event_id}", flush=True)
+        i += 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

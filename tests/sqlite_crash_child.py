@@ -1,0 +1,53 @@
+"""Kill-9 racing-writer child for the sqlite events backend.
+
+Two of these race on ONE database file (WAL mode, per-process
+connections); the parent SIGKILLs one mid-commit and asserts that
+every event either writer acked is still present when the database
+reopens — the concurrent-writer durable-prefix contract behind the
+replicated tier's quorum ack (a peer's local commit must survive its
+neighbour's crash).
+
+Usage: python tests/sqlite_crash_child.py <db-path> <writer-tag>
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from predictionio_tpu.data import DataMap, Event  # noqa: E402
+from predictionio_tpu.data.storage.sqlite import (  # noqa: E402
+    SQLiteClient,
+    SQLiteEvents,
+)
+
+APP_ID = 1
+
+
+def main() -> int:
+    path, tag = sys.argv[1], sys.argv[2]
+    backend = SQLiteEvents(SQLiteClient({"PATH": path}))
+    backend.init(APP_ID)
+    t0 = dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc)
+    i = 0
+    while True:
+        event = Event(
+            event="rate",
+            entity_type="user",
+            entity_id=f"{tag}-{i}",
+            properties=DataMap({"writer": tag, "n": i}),
+            event_time=t0 + dt.timedelta(seconds=i),
+        )
+        event_id = backend.insert(event, APP_ID)
+        # printed strictly after the commit returned — the ack the
+        # parent holds the database to after the SIGKILL
+        print(f"ACK {i} {event_id}", flush=True)
+        i += 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

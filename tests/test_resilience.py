@@ -30,6 +30,7 @@ from predictionio_tpu.serving.resilience import (
     BreakerConfig,
     ChaosError,
     ChaosMiddleware,
+    ChaosPartition,
     ChaosReset,
     CircuitBreaker,
     CircuitOpenError,
@@ -743,6 +744,44 @@ class TestChaos:
             ):
                 conn.getresponse()
             conn.close()
+        finally:
+            server.shutdown()
+
+    def test_parse_partition(self):
+        rules = ChaosMiddleware.parse("partition:p=0.5,ms=10")
+        assert rules[0].fault == "partition"
+        assert rules[0].p == 0.5 and rules[0].ms == 10.0
+
+    def test_partition_stalls_then_raises_reset_subtype(self):
+        # ChaosPartition subclasses ChaosReset so the server's existing
+        # no-response socket-slam path handles both; the stall is what
+        # distinguishes a partition (client waits, then dies) from a
+        # crashed process (fails fast)
+        chaos = ChaosMiddleware(
+            "partition:p=1.0,ms=30", registry=MetricRegistry()
+        )
+        t0 = time.monotonic()
+        with pytest.raises(ChaosPartition):
+            chaos.apply("/x")
+        assert time.monotonic() - t0 >= 0.03
+        assert issubclass(ChaosPartition, ChaosReset)
+
+    def test_partition_fault_through_real_server(self, monkeypatch):
+        monkeypatch.setenv("PIO_CHAOS", "partition:p=1.0")
+        server, base = _make_server(MetricRegistry())
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", server.port, timeout=5
+            )
+            conn.request("GET", "/echo")
+            with pytest.raises(
+                (http.client.BadStatusLine, ConnectionError, OSError)
+            ):
+                conn.getresponse()
+            conn.close()
+            # telemetry is exempt, as for every other fault
+            status, _, _ = _get(f"{base}/metrics.json")
+            assert status == 200
         finally:
             server.shutdown()
 
