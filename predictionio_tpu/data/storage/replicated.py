@@ -282,8 +282,12 @@ class HintQueue:
             )
 
     def drain(self, apply: Callable[[dict], None]) -> int:
-        """Replay hints in order; stops at the first failure (the peer
-        went away again — keep the remainder). Returns replayed count."""
+        """Replay hints in order; a :class:`StorageError` from ``apply``
+        (transport — the peer went away again) stops the drain and
+        KEEPS the hint; any other exception marks the hint poison
+        (malformed payload, unknown op — replaying it can never
+        succeed) and drops it so one bad hint cannot wedge the queue
+        or kill the drainer thread. Returns replayed count."""
         replayed = 0
         while True:
             with self._lock:
@@ -296,19 +300,30 @@ class HintQueue:
                     payload = json.loads(f.read().decode("utf-8"))
             except (OSError, ValueError):
                 # torn/garbage hint: drop it rather than wedge the queue
-                with self._lock:
-                    try:
-                        os.remove(path)
-                    except FileNotFoundError:
-                        pass
+                self._drop(path)
                 continue
-            apply(payload)  # raises on failure -> caller stops draining
+            try:
+                apply(payload)
+            except StorageError:
+                raise  # peer unreachable -> stop, keep the hint
+            except Exception:  # noqa: BLE001 - poison hint
+                logger.exception("dropping undeliverable hint %s", path)
+                self._drop(path)
+                continue
             with self._lock:
                 try:
                     os.remove(path)
                 except FileNotFoundError:
                     pass
             replayed += 1
+
+    def _drop(self, path: str) -> None:
+        with self._lock:
+            try:
+                os.remove(path)
+            except FileNotFoundError:
+                pass
+            self.dropped += 1
 
 
 # --------------------------------------------------------------------------
@@ -530,17 +545,30 @@ class ReplicatedStoreClient:
         n = len(self.peers)
         return [self.peers[(start + i) % n] for i in range(n)]
 
-    def failover_read(self, op: str, fn: Callable[[Peer], Any]) -> Any:
+    def failover_read(
+        self, op: str, fn: Callable[[Peer], Any], retry_none: bool = False
+    ) -> Any:
         """Serve from the preferred peer, advancing (stickily) past
-        dead ones. Raises the last error when every peer failed."""
+        dead ones. Raises the last error when every peer failed.
+
+        ``retry_none`` (point-reads): a live peer answering None may
+        simply have missed a quorum-acked write (hint still pending,
+        anti-entropy not yet run) — e.g. an access key created a
+        moment ago on W of N siblings. Only conclude not-found once
+        every live peer agrees; sticky preference moves only past
+        DEAD peers, so one stale replica cannot flap it."""
         last: Exception | None = None
+        saw_none = False
         for i, peer in enumerate(self.read_order()):
             try:
                 result = fn(peer)
             except StorageError as e:
                 last = e
                 continue
-            if i:
+            if result is None and retry_none:
+                saw_none = True
+                continue
+            if i and last is not None:
                 with self._pref_lock:
                     self._preferred = self.peers.index(peer)
                 _record(
@@ -550,6 +578,8 @@ class ReplicatedStoreClient:
                     peer=peer.name,
                 )
             return result
+        if saw_none:
+            return None
         raise last if last is not None else StorageError(
             f"{op}: no peers configured"
         )
@@ -572,6 +602,12 @@ class ReplicatedStoreClient:
                     logger.info(
                         "hint drain to %s stopped: %s", peer.name, e
                     )
+                    replayed = 0
+                except Exception:  # noqa: BLE001 - the daemon drainer
+                    # must outlive anything a single drain throws, or
+                    # hinted handoff silently dies for the process
+                    # lifetime while hints keep queueing
+                    logger.exception("hint drain to %s failed", peer.name)
                     replayed = 0
                 self._hints_gauge.labels(peer.name).set(queue.pending())
                 if replayed:
@@ -694,12 +730,14 @@ class ReplicatedApps(_ReplicatedBase, AppsBackend):
 
     def get(self, app_id: int) -> App | None:
         return self._rc.failover_read(
-            "apps.get", lambda p: p.apps.get(app_id)
+            "apps.get", lambda p: p.apps.get(app_id), retry_none=True
         )
 
     def get_by_name(self, name: str) -> App | None:
         return self._rc.failover_read(
-            "apps.get_by_name", lambda p: p.apps.get_by_name(name)
+            "apps.get_by_name",
+            lambda p: p.apps.get_by_name(name),
+            retry_none=True,
         )
 
     def get_all(self) -> list[App]:
@@ -739,7 +777,9 @@ class ReplicatedAccessKeys(_ReplicatedBase, AccessKeysBackend):
 
     def get(self, key: str) -> AccessKey | None:
         return self._rc.failover_read(
-            "access_keys.get", lambda p: p.access_keys.get(key)
+            "access_keys.get",
+            lambda p: p.access_keys.get(key),
+            retry_none=True,
         )
 
     def get_all(self) -> list[AccessKey]:
@@ -792,7 +832,9 @@ class ReplicatedChannels(_ReplicatedBase, ChannelsBackend):
 
     def get(self, channel_id: int) -> Channel | None:
         return self._rc.failover_read(
-            "channels.get", lambda p: p.channels.get(channel_id)
+            "channels.get",
+            lambda p: p.channels.get(channel_id),
+            retry_none=True,
         )
 
     def get_by_app_id(self, app_id: int) -> list[Channel]:
@@ -825,6 +867,7 @@ class ReplicatedEngineManifests(_ReplicatedBase, EngineManifestsBackend):
         return self._rc.failover_read(
             "engine_manifests.get",
             lambda p: p.engine_manifests.get(manifest_id, version),
+            retry_none=True,
         )
 
     def get_all(self) -> list[EngineManifest]:
@@ -872,6 +915,7 @@ class ReplicatedEngineInstances(_ReplicatedBase, EngineInstancesBackend):
         return self._rc.failover_read(
             "engine_instances.get",
             lambda p: p.engine_instances.get(instance_id),
+            retry_none=True,
         )
 
     def get_all(self) -> list[EngineInstance]:
@@ -961,6 +1005,7 @@ class ReplicatedEvaluationInstances(
         return self._rc.failover_read(
             "evaluation_instances.get",
             lambda p: p.evaluation_instances.get(instance_id),
+            retry_none=True,
         )
 
     def get_all(self) -> list[EvaluationInstance]:
@@ -1224,34 +1269,32 @@ class ReplicatedEvents(_ReplicatedBase, EventsBackend):
             else:
                 break
 
-        # hints: a fully-failed peer replays the WHOLE batch with its
-        # original token (ambiguous sends dedupe server-side); a
-        # partial peer replays only its known remainder
+        # hints carry only the DURABLE prefix: an event that never
+        # reached quorum was never acked to the caller
+        # (PartialBatchError below), so replaying it later would
+        # resurrect a write the caller believes failed — and a caller
+        # retry of the suffix (fresh UUIDs) would then logically
+        # duplicate it. The un-acked suffix converges via anti-entropy
+        # only, exactly the below-quorum contract of quorum_write. A
+        # fully-failed peer keeps its original seq token (an ambiguous
+        # torn-but-committed send dedupes server-side).
+        durable_set = set(durable)
+        durable_events = [e for e in stamped if e.event_id in durable_set]
         for peer, acked, state, seq in per_peer:
-            missing = [e for e in stamped if e.event_id not in acked]
+            missing = [
+                e for e in durable_events if e.event_id not in acked
+            ]
             if not missing:
                 continue
+            payload = {
+                "op": "event_batch",
+                "appId": app_id,
+                "channelId": channel_id,
+                "events": [e.to_json_dict() for e in missing],
+            }
             if state == "fail":
-                rc.add_hint(
-                    peer,
-                    {
-                        "op": "event_batch",
-                        "appId": app_id,
-                        "channelId": channel_id,
-                        "events": [e.to_json_dict() for e in stamped],
-                        "seq": seq,
-                    },
-                )
-            else:
-                rc.add_hint(
-                    peer,
-                    {
-                        "op": "event_batch",
-                        "appId": app_id,
-                        "channelId": channel_id,
-                        "events": [e.to_json_dict() for e in missing],
-                    },
-                )
+                payload["seq"] = seq
+            rc.add_hint(peer, payload)
 
         if len(durable) < len(ids):
             raise PartialBatchError(
@@ -1265,7 +1308,9 @@ class ReplicatedEvents(_ReplicatedBase, EventsBackend):
         self, event_id: str, app_id: int, channel_id: int | None = None
     ) -> Event | None:
         return self._rc.failover_read(
-            "events.get", lambda p: p.events.get(event_id, app_id, channel_id)
+            "events.get",
+            lambda p: p.events.get(event_id, app_id, channel_id),
+            retry_none=True,
         )
 
     def delete(
@@ -1317,6 +1362,7 @@ class AntiEntropyLoop:
         key: str | None = None,
         interval: float | None = None,
         insert_lock: threading.Lock | None = None,
+        watermarks=None,
     ):
         self._storage = storage
         conf = {"KEY": key} if key else {}
@@ -1334,6 +1380,12 @@ class AntiEntropyLoop:
         #: hinted-handoff replay racing the pull after a restart) lands
         #: duplicate records no later repair can remove
         self.insert_lock = insert_lock or threading.Lock()
+        #: the store server's incremental EventWatermarkCache (when
+        #: this loop runs inside one) — keeps the local side of every
+        #: watermark comparison O(1) instead of a full log scan per
+        #: round, and folds pulled events in so it stays exact. A
+        #: standalone loop (tests, tooling) leaves it None and scans.
+        self._watermarks = watermarks
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._status_lock = threading.Lock()
@@ -1505,9 +1557,13 @@ class AntiEntropyLoop:
     def _local_watermark(
         self, app_id: int, channel_id: int | None
     ) -> tuple[str, Any]:
+        dao = self._storage.get_events()
+        if self._watermarks is not None:
+            summary = self._watermarks.summary(app_id, channel_id, dao)
+            return summary["checksum"], summary["latest"]
+
         from predictionio_tpu.serving.store_server import event_set_checksum
 
-        dao = self._storage.get_events()
         latest = None
 
         def _ids():
@@ -1561,6 +1617,10 @@ class AntiEntropyLoop:
                         event.event_id, app_id, channel_id
                     ) is None:
                         dao.insert(event, app_id, channel_id)
+                        if self._watermarks is not None:
+                            self._watermarks.record_insert_locked(
+                                app_id, channel_id, event
+                            )
                         pulled += 1
         # lag: how far the PEER trails us (what /healthz reports as
         # this node's view of its replica set)

@@ -107,6 +107,86 @@ def event_set_checksum(ids) -> str:
     return f"{n}:{acc:016x}"
 
 
+class EventWatermarkCache:
+    """Incrementally-maintained per-(app, channel) event-set summary.
+
+    Without it every anti-entropy round is O(total events) on BOTH
+    sides — the peer's ``/events/<app>/watermark`` handler and the
+    local comparison each stream the full log — and the steady-state
+    sync cost grows without bound as the log grows. Here the full log
+    is scanned once per coordinate (cold start), after which every
+    insert folds into the running XOR checksum (the fold is its own
+    inverse, so the digest stays order-independent and matches
+    :func:`event_set_checksum` exactly).
+
+    Synchronization rides the server's ingest lock, which already
+    serializes every event-log mutation: :meth:`record_insert_locked`
+    must be called WITH the lock held (it takes none itself — the lock
+    is not reentrant); :meth:`summary` and :meth:`invalidate` acquire
+    it. Deletes and log drops invalidate the coordinate — they are
+    rare, and the next :meth:`summary` rescans once.
+    """
+
+    def __init__(self, ingest_lock: threading.Lock):
+        self._lock = ingest_lock
+        self._entries: dict[tuple[int, int | None], dict] = {}
+
+    @staticmethod
+    def _fold(event_id: str) -> int:
+        digest = hashlib.sha256(event_id.encode()).digest()
+        return int.from_bytes(digest[:8], "big")
+
+    def record_insert_locked(
+        self, app_id: int, channel_id: int | None, event: Event
+    ) -> None:
+        """Fold one freshly-inserted event in. Caller holds the ingest
+        lock (the same critical section as the DAO insert, so the scan
+        in :meth:`summary` can never interleave and double-count)."""
+        entry = self._entries.get((app_id, channel_id))
+        if entry is None:
+            return  # cold coordinate: the next summary() scan sees it
+        entry["acc"] ^= self._fold(event.event_id)
+        entry["count"] += 1
+        ct = event.creation_time
+        if ct is not None and (
+            entry["latest"] is None or ct > entry["latest"]
+        ):
+            entry["latest"] = ct
+            entry["latest_id"] = event.event_id
+
+    def invalidate(self, app_id: int, channel_id: int | None) -> None:
+        with self._lock:
+            self._entries.pop((app_id, channel_id), None)
+
+    def summary(self, app_id: int, channel_id: int | None, dao) -> dict:
+        """The coordinate's summary: ``count``, ``checksum``,
+        ``latest`` (creation-time datetime | None), ``latestId``.
+        Rebuilds from a full scan only when the coordinate is cold or
+        was invalidated."""
+        with self._lock:
+            entry = self._entries.get((app_id, channel_id))
+            if entry is None:
+                entry = {
+                    "acc": 0, "count": 0, "latest": None, "latest_id": None
+                }
+                for e in dao.find(app_id, channel_id):
+                    entry["acc"] ^= self._fold(e.event_id)
+                    entry["count"] += 1
+                    if (
+                        entry["latest"] is None
+                        or e.creation_time > entry["latest"]
+                    ):
+                        entry["latest"] = e.creation_time
+                        entry["latest_id"] = e.event_id
+                self._entries[(app_id, channel_id)] = entry
+            return {
+                "count": entry["count"],
+                "checksum": f"{entry['count']}:{entry['acc']:016x}",
+                "latest": entry["latest"],
+                "latestId": entry["latest_id"],
+            }
+
+
 class StoreServer:
     """Key auth and TLS are server-level concerns: ``create_store_server``
     hands the :class:`ServerConfig` to :class:`HTTPServer`, which
@@ -123,12 +203,18 @@ class StoreServer:
         self.tracer = tracer if tracer is not None else tracing.get_tracer()
         self.timeline = timeline_mod.Timeline(registry=self.registry)
         timeline_mod.set_timeline(self.timeline)
-        #: X-PIO-Store-Seq replay dedupe: writer -> (seq, status, body).
-        #: One slot per writer (sequences are monotonic per writer, so
-        #: only the LAST write can ever be replayed after a torn send);
-        #: bounded LRU so a churn of writer ids cannot grow it.
+        #: X-PIO-Store-Seq replay dedupe: writer -> (max_seq, window of
+        #: seq -> (status, body)). A writer id is shared by every thread
+        #: of one client process, so commits interleave: a torn seq-5
+        #: retry can arrive after seq 6 committed, and a single
+        #: last-seq slot would wave it through as "new". The window
+        #: remembers recent responses per writer; anything at or below
+        #: the high-water mark that misses the window falls back to the
+        #: id-existence check. Bounded LRU on both axes so writer churn
+        #: cannot grow it.
         self._seq_cache: collections.OrderedDict[
-            str, tuple[int, int, object]
+            str,
+            tuple[int, collections.OrderedDict[int, tuple[int, object]]],
         ] = collections.OrderedDict()
         self._seq_lock = threading.Lock()
         #: serializes existence-check + append on the event routes with
@@ -136,6 +222,10 @@ class StoreServer:
         #: append-only log, and interleaving them lands duplicate
         #: records no repair pass can ever remove
         self.ingest_lock = threading.Lock()
+        #: incremental per-(app, channel) watermark summaries, shared
+        #: with the anti-entropy loop so steady-state sync rounds stay
+        #: O(delta) instead of O(total events)
+        self.watermarks = EventWatermarkCache(self.ingest_lock)
         #: set by create_store_server when --peer URLs are given; the
         #: /healthz payload and anti-entropy loop hang off it
         self.replication = None
@@ -263,21 +353,30 @@ class StoreServer:
         except ValueError:
             return None
 
-    _SEQ_CACHE_MAX = 1024
+    _SEQ_CACHE_MAX = 1024  # writers remembered
+    _SEQ_WINDOW = 128  # responses remembered per writer
 
     def _seq_replay(self, request: Request):
         """Returns (token, cached Response | None, writer_known). A
-        replay of the writer's LAST sequence answers from the cache
-        without touching the backend — the append-only eventlog would
-        otherwise record the event twice. ``writer_known=False`` (first
-        write from this writer since the server started) tells the
-        insert path to fall back to an id-existence check: the one
-        window where a replay could arrive with the cache cold.
+        replay of a recently-committed sequence answers from the
+        per-writer response window without touching the backend — the
+        append-only eventlog would otherwise record the event twice.
+        ``writer_known=False`` tells the insert path to fall back to an
+        id-existence check; it is forced whenever the fast path cannot
+        PROVE first contact:
 
-        ``X-PIO-Store-Replay`` forces ``writer_known=False`` even for a
-        warm writer: hinted-handoff replays arrive AFTER anti-entropy
-        may have pulled the same events from a sibling, so the
-        monotonic-seq shortcut alone would append them twice."""
+        * cold cache — first write from this writer since the server
+          started;
+        * ``seq <= max_seq`` but outside the response window — the
+          writer id is shared across client threads, so a torn seq-5
+          retry can arrive after seq 6 committed (or after its own
+          slot was evicted) and must not skip the id check;
+        * ``X-PIO-Store-Replay`` — hinted-handoff replays arrive AFTER
+          anti-entropy may have pulled the same events from a sibling,
+          so even a fresh seq proves nothing for them.
+
+        Only ``seq > max_seq`` without the replay marker (a send this
+        server provably never committed) takes the fast path."""
         replay = bool(request.headers.get(STORE_REPLAY_HEADER))
         raw = (request.headers.get(STORE_SEQ_HEADER) or "").strip()
         if not raw:
@@ -292,9 +391,13 @@ class StoreServer:
             hit = self._seq_cache.get(writer)
             if hit is not None:
                 self._seq_cache.move_to_end(writer)
-                last_seq, status, body = hit
-                if seq == last_seq:
+                max_seq, window = hit
+                slot = window.get(seq)
+                if slot is not None:
+                    status, body = slot
                     return token, Response(status, body), True
+                if seq <= max_seq:
+                    return token, None, False
                 return token, None, not replay
         return token, None, False
 
@@ -303,7 +406,20 @@ class StoreServer:
             return
         writer, seq = token
         with self._seq_lock:
-            self._seq_cache[writer] = (seq, status, body)
+            hit = self._seq_cache.get(writer)
+            if hit is None:
+                max_seq = seq
+                window: collections.OrderedDict[int, tuple[int, object]] = (
+                    collections.OrderedDict()
+                )
+            else:
+                max_seq, window = hit
+                max_seq = max(max_seq, seq)
+            window[seq] = (status, body)
+            window.move_to_end(seq)
+            while len(window) > self._SEQ_WINDOW:
+                window.popitem(last=False)
+            self._seq_cache[writer] = (max_seq, window)
             self._seq_cache.move_to_end(writer)
             while len(self._seq_cache) > self._SEQ_CACHE_MAX:
                 self._seq_cache.popitem(last=False)
@@ -514,12 +630,14 @@ class StoreServer:
         app_id, channel_id = self._event_coords(request)
         with tracing.span("dao/events.init"):
             ok = self._events().init(app_id, channel_id)
+        self.watermarks.invalidate(app_id, channel_id)
         return Response(200, {"ok": bool(ok)})
 
     def _event_remove(self, request: Request) -> Response:
         app_id, channel_id = self._event_coords(request)
         with tracing.span("dao/events.remove"):
             ok = self._events().remove(app_id, channel_id)
+        self.watermarks.invalidate(app_id, channel_id)
         return Response(200, {"ok": bool(ok)})
 
     @staticmethod
@@ -550,6 +668,7 @@ class StoreServer:
                 return Response(201, {"id": event.event_id})
             with tracing.span("dao/events.insert"):
                 event_id = dao.insert(event, app_id, channel_id)
+            self.watermarks.record_insert_locked(app_id, channel_id, event)
         self._seq_commit(token, 201, {"id": event_id})
         return Response(201, {"id": event_id})
 
@@ -581,7 +700,14 @@ class StoreServer:
                 ):
                     if events:
                         dao.insert_batch(events, app_id, channel_id)
+                for ev in events:
+                    self.watermarks.record_insert_locked(
+                        app_id, channel_id, ev
+                    )
         except PartialBatchError as e:
+            # an unknown prefix of the batch landed: rescan on the
+            # next watermark read rather than guess
+            self.watermarks.invalidate(app_id, channel_id)
             # durable-prefix report on 409: a 5xx would be consumed by
             # the client transport before the prefix could be read.
             # Ids skipped as already-durable count as inserted.
@@ -648,29 +774,13 @@ class StoreServer:
 
     def _event_watermark(self, request: Request) -> Response:
         app_id, channel_id = self._event_coords(request)
-        latest = None
-        latest_id = None
-
-        def _ids():
-            nonlocal latest, latest_id
-            for e in self._events().find(app_id, channel_id):
-                if latest is None or e.creation_time > latest:
-                    latest = e.creation_time
-                    latest_id = e.event_id
-                yield e.event_id
-
         with tracing.span("dao/events.watermark"):
-            checksum = event_set_checksum(_ids())
-        count = int(checksum.split(":", 1)[0])
-        return Response(
-            200,
-            {
-                "count": count,
-                "checksum": checksum,
-                "latest": latest.isoformat() if latest else None,
-                "latestId": latest_id,
-            },
-        )
+            summary = self.watermarks.summary(
+                app_id, channel_id, self._events()
+            )
+        latest = summary["latest"]
+        summary["latest"] = latest.isoformat() if latest else None
+        return Response(200, summary)
 
     def _event_get(self, request: Request) -> Response:
         app_id, channel_id = self._event_coords(request)
@@ -686,6 +796,8 @@ class StoreServer:
         event_id = urllib.parse.unquote(request.path_params["event_id"])
         with tracing.span("dao/events.delete"):
             ok = self._events().delete(event_id, app_id, channel_id)
+        if ok:
+            self.watermarks.invalidate(app_id, channel_id)
         return Response(200, {"ok": bool(ok)})
 
 
@@ -727,6 +839,7 @@ def create_store_server(
             timeline=server.timeline,
             key=(server_config.access_key if server_config else "") or None,
             insert_lock=server.ingest_lock,
+            watermarks=server.watermarks,
         )
         server.replication = loop
         loop.start()

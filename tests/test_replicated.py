@@ -32,6 +32,7 @@ from predictionio_tpu.data.storage import (
     StorageError,
 )
 from predictionio_tpu.data.storage.base import (
+    AccessKey,
     EngineInstance,
     PartialBatchError,
 )
@@ -45,7 +46,10 @@ from predictionio_tpu.data.storage.replicated import (
     ReplicatedStoreClient,
     replication_status,
 )
-from predictionio_tpu.serving.store_server import create_store_server
+from predictionio_tpu.serving.store_server import (
+    create_store_server,
+    event_set_checksum,
+)
 
 @pytest.fixture(autouse=True)
 def _clean_breakers():
@@ -175,6 +179,27 @@ class TestQuorumWrites:
             for s in servers:
                 s.shutdown()
 
+    def test_below_quorum_batch_does_not_hint_unacked_suffix(
+        self, tmp_path
+    ):
+        # events that never reached quorum were never acked to the
+        # caller; hinting them would deliver them anyway later, and a
+        # caller retry (fresh UUIDs) would logically duplicate them
+        server = _server()
+        rc = _client(
+            [_url(server), "http://127.0.0.1:1"], tmp_path,
+            W=2, TIMEOUT=1,
+        )
+        try:
+            events = rc.dao("events")
+            with pytest.raises(PartialBatchError):
+                events.insert_batch([_event(i) for i in range(5)], 1)
+            for peer in rc.peers:
+                assert rc.hints[peer.name].pending() == 0
+        finally:
+            rc.close()
+            server.shutdown()
+
     def test_metadata_insert_fans_out_assigned_id(self, tmp_path):
         servers = [_server() for _ in range(2)]
         rc = _client([_url(s) for s in servers], tmp_path, W=2)
@@ -258,6 +283,31 @@ class TestSeqReplay:
                          replay=True)
         assert len(list(dao.find(1))) == 2
 
+    def test_retry_overtaken_by_concurrent_seq_is_deduped(
+        self, eventlog_server
+    ):
+        # the writer id is shared by every thread of one client
+        # process: T1's seq-5 send commits but the response is torn,
+        # T2's seq-6 commits before T1 retries. A last-seq-only cache
+        # would see 5 != 6 and wave the retry through as "new".
+        dao = HTTPEvents(
+            HTTPStoreClient({"URL": _url(eventlog_server)})
+        )
+        dao.init(1)
+        e5 = _event(5).with_id(None)
+        e6 = _event(6).with_id(None)
+        dao.insert(e5, 1, store_seq="w4:5")
+        dao.insert(e6, 1, store_seq="w4:6")
+        dao.insert(e5, 1, store_seq="w4:5")  # T1's retry
+        assert len(list(dao.find(1))) == 2
+        # the same retry once its response slot was evicted from the
+        # window: the high-water mark must force the id-existence
+        # check instead of the fast path
+        eventlog_server.store_app._SEQ_WINDOW = 1
+        dao.insert(_event(7).with_id(None), 1, store_seq="w4:7")
+        dao.insert(e5, 1, store_seq="w4:5")
+        assert len(list(dao.find(1))) == 3
+
     def test_bad_seq_header_is_rejected(self, eventlog_server):
         dao = HTTPEvents(
             HTTPStoreClient({"URL": _url(eventlog_server)})
@@ -313,6 +363,26 @@ class TestHintedHandoff:
         queue.drain(lambda p: seen.append(p["n"]))
         assert seen == [2, 3, 4]  # oldest were dropped, order kept
 
+    def test_poison_hint_dropped_and_drain_continues(self, tmp_path):
+        # a hint whose payload can never apply (missing fields,
+        # unknown kind) must not kill the drainer thread or wedge the
+        # queue behind it — only transport errors stop a drain
+        queue = HintQueue(str(tmp_path), "peer_4", limit=10)
+        queue.append({"op": "event"})  # no event payload -> KeyError
+        queue.append({"n": 1})
+        seen = []
+
+        def apply(payload):
+            if "n" not in payload:
+                raise KeyError("event")
+            seen.append(payload["n"])
+
+        replayed = queue.drain(apply)
+        assert replayed == 1
+        assert seen == [1]
+        assert queue.pending() == 0
+        assert queue.dropped == 1
+
     def test_drain_stops_at_first_failure(self, tmp_path):
         queue = HintQueue(str(tmp_path), "peer_2", limit=10)
         for i in range(3):
@@ -332,6 +402,26 @@ class TestHintedHandoff:
 
 
 class TestFailoverReads:
+    def test_point_read_falls_through_not_found_peer(self, tmp_path):
+        # peer A is live but missed a quorum-acked write (its hint is
+        # still pending): a point-read must not conclude not-found
+        # from A's None — e.g. event-server auth would reject a
+        # just-created access key until anti-entropy caught up
+        a, b = _server(), _server()
+        rc = _client([_url(a), _url(b)], tmp_path, W=1)
+        try:
+            rc.peers[1].access_keys.insert(
+                AccessKey(key="k-fresh", appid=1)
+            )
+            got = rc.dao("access_keys").get("k-fresh")
+            assert got is not None and got.key == "k-fresh"
+            # every live peer agreeing None is still a miss
+            assert rc.dao("access_keys").get("k-absent") is None
+        finally:
+            rc.close()
+            a.shutdown()
+            b.shutdown()
+
     def test_read_fails_over_and_sticks(self, tmp_path):
         server = _server()
         rc = _client(
@@ -559,6 +649,41 @@ class TestAntiEntropy:
         finally:
             server_b.shutdown()
             server_a.shutdown()
+
+
+class TestWatermarkCache:
+    def test_watermark_is_incremental_and_exact(self, tmp_path):
+        # steady-state anti-entropy must not re-scan the full log per
+        # round: after the first (cold) scan, inserts fold into the
+        # cached XOR checksum in place, and the answer always matches
+        # a from-scratch event_set_checksum
+        server = _server()
+        try:
+            dao = HTTPEvents(HTTPStoreClient({"URL": _url(server)}))
+            dao.init(1)
+            ids = [dao.insert(_event(0), 1)]
+            wm = dao.watermark(1)
+            assert wm["count"] == 1
+            assert wm["checksum"] == event_set_checksum(ids)
+            # the first read primed the cache: later inserts update
+            # that same entry in place instead of forcing a rescan
+            entry = server.store_app.watermarks._entries[(1, None)]
+            for i in range(1, 4):
+                ids.append(dao.insert(_event(i), 1))
+            assert entry["count"] == 4
+            wm = dao.watermark(1)
+            assert wm["count"] == 4
+            assert wm["checksum"] == event_set_checksum(ids)
+            assert wm["latestId"] == ids[-1]
+            # deletes are rare: they invalidate, and the next read
+            # rescans once and is exact again
+            assert dao.delete(ids[0], 1)
+            assert (1, None) not in server.store_app.watermarks._entries
+            wm = dao.watermark(1)
+            assert wm["count"] == 3
+            assert wm["checksum"] == event_set_checksum(ids[1:])
+        finally:
+            server.shutdown()
 
 
 class TestReplicatedStorageEnv:
