@@ -272,6 +272,43 @@ def _pool_summary_line(data: dict) -> str | None:
     return " ".join(parts)
 
 
+def _cache_summary_line(data: dict) -> str | None:
+    """One-line serving-cache summary: aggregate hit rate, resident vs
+    budget bytes, coalesced lookups + in-flight leaders, evictions.
+    Only rendered when the scraped server (or fleet merge) runs the
+    query cache (``pio_cache_*`` series present)."""
+
+    def labeled_sum(name):
+        family = data.get(name)
+        if not isinstance(family, dict):
+            return 0.0
+        return sum(
+            s.get("value", s.get("count", 0)) or 0
+            for s in family.get("samples") or []
+        )
+
+    budget = data.get("pio_cache_budget_bytes")
+    if not isinstance(budget, dict) or not budget.get("samples"):
+        return None
+    budget_bytes = labeled_sum("pio_cache_budget_bytes")
+    hits = labeled_sum("pio_cache_hits_total")
+    misses = labeled_sum("pio_cache_misses_total")
+    parts = [
+        "cache: bytes="
+        f"{int(labeled_sum('pio_cache_resident_bytes'))}/"
+        f"{int(budget_bytes)}"
+    ]
+    lookups = hits + misses
+    if lookups:
+        parts.append(f"hitRate={hits / lookups:.2f}")
+    parts.append(f"coalesced={int(labeled_sum('pio_cache_coalesced_total'))}")
+    inflight = labeled_sum("pio_cache_inflight")
+    if inflight:
+        parts.append(f"inflight={int(inflight)}")
+    parts.append(f"evictions={int(labeled_sum('pio_cache_evictions_total'))}")
+    return " ".join(parts)
+
+
 def _tenant_cost_line(data: dict, top_n: int = 3) -> str | None:
     """One-line per-tenant cost rollup (cost attribution): the top-N
     tenants by attributed device-seconds, each with its share of total
@@ -460,6 +497,9 @@ def _print_metrics(url: str, access_key: str = "") -> int:
             if stale:
                 line += " stale=" + ",".join(stale)
             print(line)
+            cache = _cache_summary_line(data.get("fleet") or {})
+            if cache:
+                print(cache)
             tenants = _tenant_cost_line(data.get("fleet") or {})
             if tenants:
                 print(tenants)
@@ -472,6 +512,9 @@ def _print_metrics(url: str, access_key: str = "") -> int:
         pool = _pool_summary_line(data)
         if pool:
             print(pool)
+        cache = _cache_summary_line(data)
+        if cache:
+            print(cache)
         tenants = _tenant_cost_line(data)
         if tenants:
             print(tenants)

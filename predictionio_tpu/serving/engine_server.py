@@ -63,6 +63,7 @@ from predictionio_tpu.parallel.mesh import ComputeContext
 from predictionio_tpu.serving import admission as admission_mod
 from predictionio_tpu.serving import canary as canary_mod
 from predictionio_tpu.serving import modelpool as modelpool_mod
+from predictionio_tpu.serving import querycache as querycache_mod
 from predictionio_tpu.serving import resilience
 from predictionio_tpu.serving.batching import (
     BatcherOverloaded,
@@ -132,6 +133,7 @@ class EngineServer:
         tenants: dict[str, str] | None = None,
         pool: modelpool_mod.ModelPool | None = None,
         quantize: str | None = None,
+        cache: bool | querycache_mod.QueryCache | None = None,
     ):
         self._engine = engine
         self._params = params
@@ -289,6 +291,38 @@ class EngineServer:
         #: global) — guarded by self._lock, never held across the
         #: capture window itself
         self._profile_active = False
+        # generation-keyed serving cache + single-flight coalescing
+        # (docs/serving.md "Serving query cache"): opt-in (PIO_CACHE /
+        # explicit arg). Keyed by (tenant, generation token, canonical
+        # query bytes) — every swap path bumps a sub-generation epoch
+        # so stale entries die by key; hits never consume a batcher
+        # slot, so cost attribution charges them ~zero device-seconds.
+        if cache is None:
+            cache = querycache_mod.cache_enabled_from_env()
+        if cache and self._feedback:
+            # feedback mode injects a fresh random prId per response
+            # and must record a predict event per request — responses
+            # are intentionally non-identical and non-replayable
+            logger.warning(
+                "serving cache disabled: incompatible with feedback mode"
+            )
+            cache = False
+        if cache is True:
+            self._cache: querycache_mod.QueryCache | None = (
+                querycache_mod.QueryCache(
+                    registry=self._registry, timeline=self._timeline
+                )
+            )
+        elif isinstance(cache, querycache_mod.QueryCache):
+            self._cache = cache
+        else:
+            self._cache = None
+        #: per-tenant sub-generation epoch ("" in single-tenant mode),
+        #: guarded by self._lock: part of the cache key so a fold-in —
+        #: a child generation of the SAME lineage — still changes every
+        #: key and events→serving freshness never regresses past one
+        #: fold-in interval
+        self._cache_epochs: dict[str, int] = {}
         self._batchers: list[MicroBatcher] = []
         if self._tenants is None:
             self._load()
@@ -359,6 +393,56 @@ class EngineServer:
         """Load the latest generation and swap it in immediately (the
         unguarded path: initial load, and /reload without canary)."""
         self._activate(self._stage())
+
+    # -- serving query cache ----------------------------------------------
+    def _bump_cache_generation(
+        self, reason: str, tenant: str = "", generation=None
+    ) -> None:
+        """Invalidate the serving cache for one tenant ("" = the
+        single-tenant namespace): bump the sub-generation epoch so new
+        lookups miss by KEY immediately, then eagerly flush resident
+        entries (one ``cache_flush{reason}`` timeline event). Every
+        swap path routes here: /reload, canary promote, rollback, and
+        trainer fold-in."""
+        if self._cache is None:
+            return
+        with self._lock:
+            self._cache_epochs[tenant] = (
+                self._cache_epochs.get(tenant, 0) + 1
+            )
+        self._cache.flush(
+            tenant if tenant else None,
+            reason=reason,
+            generation=(
+                str(generation) if generation is not None else None
+            ),
+        )
+
+    def _cache_token(self, tenant: str) -> str | None:
+        """Generation token for cache keys: the serving instance id
+        plus the flush epoch. None (skip the cache, compute instead)
+        when the tenant has no resolved instance yet — a hit must
+        never force a pool load or take a pin."""
+        with self._lock:
+            if self._tenants is None:
+                instance = self._instance
+            else:
+                instance = self._tenant_instances.get(tenant)
+            epoch = self._cache_epochs.get(tenant, 0)
+        if instance is None:
+            return None
+        return f"{instance.id}:{epoch}"
+
+    def _cache_bypass(self, request: Request) -> bool:
+        """``Cache-Control: no-cache`` (or ``no-store``) bypasses the
+        cache — the read-your-writes escape hatch; the fleet canary
+        gate shadow-scores with it so a cached answer is never judged
+        against a fresh one."""
+        directives = (
+            request.headers.get(querycache_mod.CACHE_CONTROL_HEADER)
+            or ""
+        ).lower()
+        return "no-cache" in directives or "no-store" in directives
 
     # -- multi-tenant pool plumbing ---------------------------------------
     def _tenant_age_seconds(self, tenant: str) -> float:
@@ -489,6 +573,18 @@ class EngineServer:
             generation = self._generation
         self._generation_gauge.labels("").set(generation)
         self._warmed_gauge.set(1 if staged.warmed else 0)
+        if generation > 1:
+            # not the initial load: the serving answers just changed.
+            # A fold-in publishes a CHILD generation of the same
+            # lineage (trainer marks it batch="fold-in") — flushed
+            # under its own reason so freshness regressions are
+            # attributable on the timeline.
+            self._bump_cache_generation(
+                "foldin"
+                if getattr(staged.instance, "batch", "") == "fold-in"
+                else "reload",
+                generation=staged.instance.id,
+            )
         for b in old:
             b.close()
         logger.info(
@@ -777,6 +873,9 @@ class EngineServer:
             # pool.stats() takes the pool's own lock — never nest it
             # inside ours
             data["pool"] = self._pool.stats()
+        if self._cache is not None:
+            # cache.stats() takes the cache's shard locks — outside ours
+            data["cache"] = self._cache.stats()
         return data
 
     def _status(self, request: Request) -> Response:
@@ -945,6 +1044,98 @@ class EngineServer:
         query = request.json()
         if not isinstance(query, dict):
             raise HTTPError(400, "query must be a JSON object")
+        claim = None
+        if self._cache is not None and not self._cache_bypass(request):
+            tenant = (
+                "" if self._tenants is None
+                else self._resolve_tenant(request)
+            )
+            token = self._cache_token(tenant)
+            if token is not None:
+                # lookup AFTER admission (the wrapper admitted us) but
+                # BEFORE the batcher: a hit consumes no batcher slot
+                # and (multi-tenant) takes no pool pin
+                claim = self._cache.claim(
+                    tenant, token,
+                    querycache_mod.canonical_query_bytes(query),
+                )
+                if claim.hit:
+                    return self._cached_response(claim.value, "hit", t0)
+                if not claim.leader:
+                    return self._join_coalesced(claim, t0)
+        try:
+            return self._compute_query(request, query, t0, claim)
+        except BaseException as exc:
+            if claim is not None:
+                # leader failed: wake every waiter with the REAL error
+                # and clear the slot — the next claimant leads afresh
+                # (no cache poisoning)
+                self._cache.abort(claim, exc)
+            raise
+
+    def _cached_response(
+        self, value: bytes, state: str, t0: float
+    ) -> Response:
+        """A response served from the cache (hit) or another request's
+        computation (coalesced): same latency bookkeeping as the
+        compute path, plus the X-PIO-Cache provenance header. Canary
+        observation is skipped — near-zero cache latencies must not
+        skew the regression-watch baseline (the gate shadow-scores
+        through the no-cache bypass instead)."""
+        elapsed = time.perf_counter() - t0
+        with self._lock:
+            self._request_count += 1
+            self._last_serving_sec = elapsed
+            self._avg_serving_sec += (
+                elapsed - self._avg_serving_sec
+            ) / self._request_count
+        return Response(
+            200, value,
+            headers={querycache_mod.CACHE_HEADER: state},
+        )
+
+    def _join_coalesced(
+        self, claim: querycache_mod.Claim, t0: float
+    ) -> Response:
+        """Waiter side of single-flight: block on the leader's result
+        under THIS request's own budget. Expiry detaches the waiter
+        without cancelling the leader; a leader failure surfaces the
+        leader's real error."""
+        timeout = self._predict_timeout_s
+        request_deadline = resilience.get_deadline()
+        if request_deadline is not None:
+            timeout = min(
+                timeout,
+                max(0.001,
+                    request_deadline.expires_mono - time.monotonic()),
+            )
+        try:
+            value = self._cache.join(claim, timeout)
+        except querycache_mod.WaiterTimeout:
+            raise HTTPError(
+                504,
+                "deadline expired while coalesced on an identical "
+                "in-flight query",
+            ) from None
+        except querycache_mod.LeaderFailed as exc:
+            cause = exc.__cause__
+            if isinstance(cause, HTTPError):
+                raise HTTPError(
+                    cause.status, cause.message,
+                    headers=dict(cause.headers) or None,
+                ) from None
+            raise HTTPError(
+                500, f"coalesced computation failed: {cause}"
+            ) from exc
+        return self._cached_response(value, "coalesced", t0)
+
+    def _compute_query(
+        self,
+        request: Request,
+        query: dict,
+        t0: float,
+        claim: querycache_mod.Claim | None,
+    ) -> Response:
         for _attempt in range(2):
             # the snapshot holds the tenant's pool pin (multi-tenant)
             # for the WHOLE submit→collect span, so eviction can't
@@ -952,9 +1143,18 @@ class EngineServer:
             with self._serving_snapshot(request) as (serving, batchers):
                 supplemented = serving.supplement(query)
                 futures = []
+                # single-flight leaders submit at the HIGHEST class
+                # coalesced so far: a CRITICAL waiter must not sit
+                # behind a SHEDDABLE leader's batcher slot
+                escalate = (
+                    admission_mod.criticality(claim.criticality())
+                    if claim is not None
+                    else contextlib.nullcontext()
+                )
                 try:
-                    for b in batchers:
-                        futures.append(b.submit(supplemented))
+                    with escalate:
+                        for b in batchers:
+                            futures.append(b.submit(supplemented))
                 except BatcherOverloaded:
                     # queue-depth bound hit: shed immediately instead of
                     # queueing into a predict-timeout hang. Earlier
@@ -1019,6 +1219,17 @@ class EngineServer:
                 self._canary_observe(
                     supplemented, prediction, elapsed, ok=True
                 )
+                if claim is not None:
+                    # serialize ONCE with the exact call the dict
+                    # response path uses, so hits/coalesced answers
+                    # stay byte-identical to uncached ones; fill wakes
+                    # every coalesced waiter with these bytes
+                    body = json.dumps(prediction).encode("utf-8")
+                    self._cache.fill(claim, body)
+                    return Response(
+                        200, body,
+                        headers={querycache_mod.CACHE_HEADER: "miss"},
+                    )
                 return Response(200, prediction)
         raise HTTPError(503, "server is reloading; retry")
 
@@ -1362,6 +1573,14 @@ class EngineServer:
                 ) from exc
             with self._lock:
                 generation = self._tenant_generations.get(tenant, 0)
+                instance = self._tenant_instances.get(tenant)
+            self._bump_cache_generation(
+                "foldin"
+                if getattr(instance, "batch", "") == "fold-in"
+                else "reload",
+                tenant=tenant,
+                generation=getattr(instance, "id", generation),
+            )
             self._timeline.record(
                 "tenant_reload",
                 f"tenant {tenant!r} reloaded to generation {generation}",
@@ -1558,6 +1777,9 @@ class EngineServer:
                 generation = self._generation
             self._generation_gauge.labels("").set(generation)
             self._warmed_gauge.set(1 if staged.warmed else 0)
+            self._bump_cache_generation(
+                "promote", generation=staged.instance.id
+            )
             canary.promoted(retained)
             self._timeline.record(
                 "canary_verdict",
@@ -1596,6 +1818,12 @@ class EngineServer:
                 generation = self._generation
             self._generation_gauge.labels("").set(generation)
             self._warmed_gauge.set(1 if retained.warmed else 0)
+            # the rolled-back generation's answers must vanish: the
+            # epoch bump reknames every key (entries from the bad
+            # generation are unreachable) and the flush drops them
+            self._bump_cache_generation(
+                "rollback", generation=retained.instance.id
+            )
             canary.finished(canary_mod.ROLLED_BACK)
             self._close_batchers_async(rolled_back.batchers)
             self._finish_canary(canary)
@@ -1773,6 +2001,10 @@ class EngineServer:
             # pool close drains the loader thread and closes every
             # resident generation's batchers
             self._pool.close()
+        if self._cache is not None:
+            # fails any still-coalesced waiters instead of stranding
+            # their threads on a dead leader
+            self._cache.close()
         self._device_sampler.stop()
         self._plugins.close()
         if self._log_queue is not None:

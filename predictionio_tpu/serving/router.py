@@ -84,6 +84,7 @@ from predictionio_tpu.obs.slo import SLOMonitor
 from predictionio_tpu.serving import admission, resilience
 from predictionio_tpu.serving.resilience import _env_float
 from predictionio_tpu.serving import canary as canary_mod
+from predictionio_tpu.serving import querycache as querycache_mod
 from predictionio_tpu.serving.http import (
     HTTPError,
     HTTPServer,
@@ -1434,6 +1435,16 @@ class ServingRouter:
         )
         if tenant:
             req.add_header(admission.TENANT_HEADER, tenant)
+        cache_control = request.headers.get(
+            querycache_mod.CACHE_CONTROL_HEADER
+        )
+        if cache_control:
+            # the read-your-writes cache bypass (Cache-Control:
+            # no-cache) must survive the hop or the replica would
+            # happily answer from its serving cache
+            req.add_header(
+                querycache_mod.CACHE_CONTROL_HEADER, cache_control
+            )
         # nest the replica's root span under the forward span (or the
         # router's root when tracing the forward itself is disabled)
         parent = span if span is not None else tracing.current_span()
@@ -1498,7 +1509,21 @@ class ServingRouter:
         # budget — are verdicts of health, not failure (a 429
         # fair-share refusal is tenant-specific and forwarded as-is)
         replica.breaker.record_success()
-        return Response(status, body, content_type=resp_ctype)
+        fwd_headers: dict[str, str] = {}
+        cache_state = (
+            upstream_headers.get(querycache_mod.CACHE_HEADER)
+            if upstream_headers is not None
+            else None
+        )
+        if cache_state:
+            # cache provenance (hit|miss|coalesced) survives the hop,
+            # so clients — and cache_smoke's conservation checks — see
+            # exactly what the replica answered
+            fwd_headers[querycache_mod.CACHE_HEADER] = cache_state
+        return Response(
+            status, body, content_type=resp_ctype,
+            headers=fwd_headers or None,
+        )
 
     # -- rolling swap / fleet promotion ------------------------------------
     def rolling_swap(
@@ -2073,6 +2098,10 @@ class ServingRouter:
             method="POST",
         )
         req.add_header("Content-Type", "application/json")
+        # the gate must never score a CACHED answer against a fresh
+        # one: a stale-but-cached staged replica would look perfectly
+        # convergent (or a warm cache would hide a real divergence)
+        req.add_header(querycache_mod.CACHE_CONTROL_HEADER, "no-cache")
         try:
             with urllib.request.urlopen(
                 req, timeout=config.shadow_timeout_s

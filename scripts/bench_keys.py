@@ -1,0 +1,42 @@
+"""Shared skewed-key generation for the serving benches.
+
+One Zipf implementation, seeded-deterministic, used by BOTH
+``serving_bench.py --density`` (tenant-access skew) and
+``serving_bench.py --skew`` (query-key skew for the serving cache) so
+the benches cannot drift apart on what "skewed traffic" means.
+
+Weights follow the classic Zipf law: P(rank r) ∝ r^-alpha over ranks
+1..n. ``alpha=1.0`` reproduces the 1/rank weighting --density has
+always used (``pow(x, 1.0)`` is exact in IEEE 754, so passing the same
+``rng`` yields bit-identical draws to the old hand-rolled code).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def zipf_weights(n: int, alpha: float = 1.0) -> np.ndarray:
+    """Normalized Zipf probabilities over ranks ``1..n``: weight of
+    rank ``r`` is ``r**-alpha`` before normalization. ``alpha=0`` is
+    uniform; larger alpha concentrates mass on the head."""
+    if n <= 0:
+        raise ValueError(f"need at least one key, got n={n}")
+    ranks = 1.0 + np.arange(n)
+    weights = 1.0 / (ranks ** float(alpha))
+    return weights / weights.sum()
+
+
+def zipf_sequence(
+    n: int,
+    size: int,
+    alpha: float = 1.0,
+    rng: np.random.Generator | None = None,
+    seed: int | None = None,
+) -> np.ndarray:
+    """Draw ``size`` key indices in ``[0, n)`` Zipf-distributed with
+    exponent ``alpha``. Deterministic: pass an existing ``rng`` to
+    continue its stream, or a ``seed`` (default 0) for a fresh one."""
+    if rng is None:
+        rng = np.random.default_rng(0 if seed is None else seed)
+    return rng.choice(n, size=size, p=zipf_weights(n, alpha))

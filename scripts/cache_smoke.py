@@ -1,0 +1,668 @@
+"""Serving-cache smoke test: generation-keyed invalidation proven end
+to end, under continuous traffic with ZERO non-200 responses.
+
+Phase A (one engine server, canary-gated, tiny cache budget) proves:
+
+1. **hit/miss/coalesced surface** — X-PIO-Cache headers on the query
+   path, ``Cache-Control: no-cache`` bypasses the cache entirely, and
+   the ``pio_cache_*`` counters move;
+2. **every swap path flushes** — an immediate ``/reload``, a canary
+   *promotion*, an automatic *rollback*, and a trainer *fold-in* each
+   bump the cache generation: the very next answer comes from the new
+   (for rollback: the restored OLD) generation, repeated cached reads
+   stay on it — zero stale answers — and each swap lands one
+   ``cache_flush{reason}`` event in ``/debug/timeline.json``;
+3. **pressure is observable** — a burst of distinct queries under a
+   32 KiB budget drives evictions past the burst threshold and emits a
+   ``cache_pressure`` timeline event.
+
+Phase B (two engine-server replicas behind a ServingRouter) proves:
+
+4. **the header crosses the router** — X-PIO-Cache is forwarded
+   verbatim, and a routed ``Cache-Control: no-cache`` request reaches
+   the replica (no cache state on the response);
+5. **federated counters conserve** — for each of
+   ``pio_cache_{hits,misses,coalesced}_total``, the router's merged
+   fleet value equals the sum over its per-replica payloads AND the
+   sum of direct replica scrapes;
+6. **flush events merge fleet-wide** — per-replica ``/reload`` flushes
+   appear in the router's merged ``/debug/timeline.json`` with replica
+   provenance.
+
+Run by ``scripts/check.sh`` next to the other smokes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# tiny budget so the pressure path is reachable in seconds; read at
+# QueryCache construction — set before the servers are built
+os.environ["PIO_CACHE_BUDGET_BYTES"] = "32768"
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+WORK = tempfile.mkdtemp(prefix="pio-cache-smoke-")
+STORAGE_ENV = {
+    "PIO_STORAGE_SOURCES_SQL_TYPE": "sqlite",
+    "PIO_STORAGE_SOURCES_SQL_PATH": os.path.join(WORK, "pio.sqlite"),
+    "PIO_STORAGE_SOURCES_FS_TYPE": "localfs",
+    "PIO_STORAGE_SOURCES_FS_PATH": os.path.join(WORK, "models"),
+    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQL",
+    "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQL",
+    "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS",
+}
+os.environ.update(STORAGE_ENV)
+
+ADMIN_KEY = "cache-smoke-key"
+
+failures: list[str] = []
+
+
+def check(cond: bool, label: str) -> None:
+    print(("ok   " if cond else "FAIL ") + label, flush=True)
+    if not cond:
+        failures.append(label)
+
+
+def http_json(url, body=None, headers=None, timeout=20):
+    """(status, parsed body, response headers); no raise on 4xx/5xx."""
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(body).encode() if body is not None else None,
+        method="POST" if body is not None else "GET",
+        headers=headers or {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return (
+                resp.status,
+                json.loads(resp.read() or b"null"),
+                resp.headers,
+            )
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null"), e.headers
+
+
+def metric_sum(payload: dict, name: str) -> float:
+    """Sum every sample of one family in a /metrics.json payload."""
+    family = (payload or {}).get(name)
+    if not isinstance(family, dict):
+        return 0.0
+    return sum(
+        s.get("value", s.get("count", 0.0)) or 0.0
+        for s in family.get("samples", ())
+    )
+
+
+def wait_for(predicate, timeout_s, label, poll_s=0.1):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(poll_s)
+    check(False, f"timed out waiting for {label}")
+    return None
+
+
+class Traffic:
+    """Continuous background load rotating over a keyspace wider than
+    the 32 KiB budget: a live mix of hits, misses, and evictions, so
+    the canary shadow/watch paths (which only see computed requests)
+    keep getting samples while the cache is on. Every response must be
+    200."""
+
+    def __init__(self, base: str, rate_hz: float = 80.0, keys: int = 300):
+        self.base = base
+        self.rate = rate_hz
+        self.keys = keys
+        self.ok = 0
+        self.non_200: list[tuple[int, object]] = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="cache-smoke-traffic", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self):
+        i = 0
+        while not self._stop.is_set():
+            i += 1
+            try:
+                status, out, _ = http_json(
+                    f"{self.base}/queries.json",
+                    {"x": 1, "k": i % self.keys},
+                    timeout=30,
+                )
+            except OSError:
+                continue  # server not up yet / shutting down
+            if status == 200:
+                self.ok += 1
+            else:
+                self.non_200.append((status, out))
+            self._stop.wait(1.0 / self.rate)
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=10)
+
+
+def timeline_events(base: str, kind: str, headers=None) -> list[dict]:
+    status, data, _ = http_json(f"{base}/debug/timeline.json",
+                                headers=headers)
+    if status != 200:
+        return []
+    return [
+        e for e in (data or {}).get("events", ())
+        if e.get("kind") == kind
+    ]
+
+
+def flush_reasons(base: str, headers=None) -> list[str]:
+    return [
+        e.get("reason", "") for e in timeline_events(base, "cache_flush",
+                                                     headers=headers)
+    ]
+
+
+# --------------------------------------------------------------------------
+# the fake pipeline: live traffic identical across generations (so the
+# canary gate passes), probe queries generation-tagged (so staleness is
+# observable the moment a swap should have flushed)
+# --------------------------------------------------------------------------
+
+
+def build_pipeline():
+    from predictionio_tpu.core import (
+        Algorithm,
+        DataSource,
+        Engine,
+        EngineParams,
+        Params,
+        Preparator,
+        Serving,
+    )
+
+    @dataclasses.dataclass(frozen=True)
+    class P(Params):
+        pass
+
+    class Src(DataSource):
+        params_class = P
+
+        def read_training(self, ctx):
+            return {}
+
+    class Prep(Preparator):
+        params_class = P
+
+        def prepare(self, ctx, td):
+            return td
+
+    class GenAlgo(Algorithm):
+        """Model tag/latency frozen at train time from class attrs, so
+        each run_train publishes an observably different generation."""
+
+        params_class = P
+        gen_tag = "g1"
+        slow_s = 0.0
+
+        def train(self, ctx, pd):
+            return {
+                "tag": type(self).gen_tag,
+                "slow_s": type(self).slow_s,
+            }
+
+        def predict(self, model, query):
+            return self.batch_predict(model, [query])[0]
+
+        def batch_predict(self, model, queries):
+            if model["slow_s"]:
+                time.sleep(model["slow_s"])
+            out = []
+            for q in queries:
+                q = q if isinstance(q, dict) else {}
+                if "probe" in q:
+                    # generation-tagged: only probes may diverge across
+                    # generations (probes are never sent while a canary
+                    # is shadow-scoring, so the gate stays clean)
+                    out.append({"result": model["tag"]})
+                else:
+                    out.append({"result": 1.0})
+            return out
+
+    class First(Serving):
+        params_class = P
+
+        def serve(self, query, predictions):
+            return predictions[0]
+
+    engine = Engine(Src, Prep, GenAlgo, First)
+    params = EngineParams(
+        data_source=("", P()), preparator=("", P()),
+        algorithms=[("", P())], serving=("", P()),
+    )
+    return engine, params, GenAlgo
+
+
+def probe(base: str, key: int = 0, fresh: bool = False):
+    """(value, X-PIO-Cache header) for the generation-tagged probe."""
+    headers = {"Cache-Control": "no-cache"} if fresh else None
+    status, out, resp_headers = http_json(
+        f"{base}/queries.json", {"probe": key}, headers=headers
+    )
+    if status != 200:
+        return None, None
+    return out.get("result"), resp_headers.get("X-PIO-Cache")
+
+
+def assert_swap(base: str, want_tag: str, label: str,
+                reject_tags: tuple = ()) -> None:
+    """Zero-stale proof for one swap: the fresh (bypass) answer has the
+    new generation's tag, and EVERY cached read agrees — with at least
+    one served straight from the cache."""
+    fresh_value, fresh_state = probe(base, fresh=True)
+    check(
+        fresh_value == want_tag and fresh_state is None,
+        f"{label}: no-cache probe sees {want_tag!r} with no cache state "
+        f"(got {fresh_value!r}, {fresh_state!r})",
+    )
+    values, states = [], []
+    for _ in range(20):
+        value, state = probe(base)
+        values.append(value)
+        states.append(state)
+    stale = [v for v in values if v != want_tag]
+    check(
+        not stale,
+        f"{label}: zero stale answers across 20 cached probes "
+        f"(stale: {stale[:3]})",
+    )
+    check(
+        "hit" in states,
+        f"{label}: at least one probe served from the cache "
+        f"(states: {sorted(set(states))})",
+    )
+    for tag in reject_tags:
+        check(
+            tag not in values,
+            f"{label}: no {tag!r} answer survived the flush",
+        )
+
+
+# --------------------------------------------------------------------------
+# Phase A: one server — headers, bypass, all four swap paths, pressure
+# --------------------------------------------------------------------------
+
+
+def phase_single() -> None:
+    from predictionio_tpu.core.engine import WorkflowParams
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.data.storage import get_storage
+    from predictionio_tpu.parallel.mesh import ComputeContext
+    from predictionio_tpu.serving.canary import CanaryConfig
+    from predictionio_tpu.serving.engine_server import EngineServer
+
+    engine, params, GenAlgo = build_pipeline()
+    storage = get_storage()
+    ctx = ComputeContext.create(batch="cache-smoke")
+
+    def train(tag: str, slow_s: float = 0.0, fold_in: bool = False):
+        GenAlgo.gen_tag = tag
+        GenAlgo.slow_s = slow_s
+        # a real fold-in is published by the continuous trainer with
+        # batch="fold-in" on the instance record — the marker the
+        # engine server keys its flush reason off
+        workflow = WorkflowParams(batch="fold-in") if fold_in else None
+        return run_train(
+            engine, params, engine_id="cache-smoke", ctx=ctx,
+            workflow=workflow, storage=storage,
+        )
+
+    train("g1")
+    config = CanaryConfig(
+        shadow_sample=1.0, min_shadow=5, max_divergence=0.05,
+        watch_min_requests=10, watch_s=0.5, latency_factor=4.0,
+        error_rate_limit=0.2, shadow_timeout_s=10.0,
+    )
+    server = EngineServer(
+        engine, params, engine_id="cache-smoke", storage=storage,
+        ctx=ctx, canary=config, cache=True, max_wait_ms=0.5,
+    )
+    check(server._cache is not None, "cache enabled on the engine server")
+    http = server.serve(host="127.0.0.1", port=0)
+    http.start()
+    base = f"http://127.0.0.1:{http.port}"
+    traffic = Traffic(base)
+    try:
+        # -- 1: hit/miss headers + bypass ---------------------------------
+        value, state = probe(base, key=99)
+        check(
+            value == "g1" and state == "miss",
+            f"first probe computes: X-PIO-Cache miss ({value!r}, {state!r})",
+        )
+        value, state = probe(base, key=99)
+        check(
+            value == "g1" and state == "hit",
+            f"repeat probe cached: X-PIO-Cache hit ({value!r}, {state!r})",
+        )
+        value, state = probe(base, key=99, fresh=True)
+        check(
+            value == "g1" and state is None,
+            "Cache-Control: no-cache bypasses the cache (no cache state "
+            f"on the response; got {state!r})",
+        )
+        status, data, _ = http_json(base)
+        check(
+            isinstance(data.get("cache"), dict)
+            and data["cache"].get("budgetBytes") == 32768,
+            f"status exposes the cache block (got {data.get('cache')})",
+        )
+
+        # -- 2: immediate /reload flushes ---------------------------------
+        train("g2")
+        status, body, _ = http_json(
+            f"{base}/reload", body={"canary": False}
+        )
+        check(
+            status == 200 and body.get("message") == "reloaded",
+            f"immediate reload swapped g1→g2 ({status}, {body})",
+        )
+        assert_swap(base, "g2", "reload", reject_tags=("g1",))
+        check(
+            "reload" in flush_reasons(base),
+            "cache_flush{reason=reload} in /debug/timeline.json",
+        )
+
+        # -- 3: canary promotion flushes ----------------------------------
+        # warm the cache with g2 probes, then stage g3; probes pause
+        # until the verdict so the shadow gate only scores identical
+        # live traffic
+        probe(base)
+        g3 = train("g3")
+        status, _, _ = http_json(f"{base}/reload", body={})
+        check(status == 202, f"g3 staged as canary ({status})")
+        promoted = wait_for(
+            lambda: http_json(base)[1].get("engineInstanceId") == g3,
+            60, "canary promotion",
+        )
+        check(bool(promoted), "g3 passed the shadow gate and promoted")
+        assert_swap(base, "g3", "promote", reject_tags=("g2",))
+        check(
+            "promote" in flush_reasons(base),
+            "cache_flush{reason=promote} in /debug/timeline.json",
+        )
+
+        # -- 4: automatic rollback flushes (the OLD generation's answers
+        #       come back, with zero rolled-back-generation leftovers) --
+        # the g3 post-promotion regression watch must finish before a
+        # new canary can stage (409 while shadowing/watching)
+        wait_for(
+            lambda: http_json(f"{base}/canary")[1].get("state")
+            not in ("shadowing", "watching"),
+            60, "g3 regression watch verdict",
+        )
+        g4 = train("g4", slow_s=0.06)
+        status, _, _ = http_json(f"{base}/reload", body={})
+        check(status == 202, f"slow g4 staged as canary ({status})")
+        promoted = wait_for(
+            lambda: http_json(base)[1].get("engineInstanceId") == g4,
+            60, "g4 promotion",
+        )
+        check(bool(promoted), "slow g4 passed the gate (identical output)")
+        # cache g4 probe answers so the rollback has entries to kill
+        for _ in range(5):
+            probe(base)
+        rolled = wait_for(
+            lambda: (server._last_canary or {}).get("state")
+            == "rolled_back",
+            60, "automatic rollback",
+        )
+        check(bool(rolled), "latency regression rolled g4 back")
+        assert_swap(base, "g3", "rollback", reject_tags=("g4",))
+        check(
+            "rollback" in flush_reasons(base),
+            "cache_flush{reason=rollback} in /debug/timeline.json",
+        )
+
+        # -- 5: fold-in flushes (freshness: PR 9's event→serving path
+        #       must not be blunted by a warm cache) ----------------------
+        train("g5", fold_in=True)
+        status, body, _ = http_json(
+            f"{base}/reload", body={"canary": False}
+        )
+        check(status == 200, f"fold-in generation reloaded ({status})")
+        assert_swap(base, "g5", "fold-in", reject_tags=("g3", "g4"))
+        check(
+            "foldin" in flush_reasons(base),
+            "cache_flush{reason=foldin} in /debug/timeline.json",
+        )
+
+        # -- 6: pressure burst under the 32 KiB budget --------------------
+        for i in range(500):
+            http_json(f"{base}/queries.json", {"x": 1, "one-shot": i})
+        status, metrics, _ = http_json(f"{base}/metrics.json")
+        check(
+            metric_sum(metrics, "pio_cache_evictions_total") >= 64,
+            "budget pressure: >= 64 evictions counted",
+        )
+        check(
+            bool(timeline_events(base, "cache_pressure")),
+            "cache_pressure event in /debug/timeline.json",
+        )
+        resident = metric_sum(metrics, "pio_cache_resident_bytes")
+        check(
+            0 < resident <= 32768,
+            f"resident bytes within budget ({resident:.0f} <= 32768)",
+        )
+        check(
+            metric_sum(metrics, "pio_cache_hits_total") > 0
+            and metric_sum(metrics, "pio_cache_misses_total") > 0,
+            "pio_cache_{hits,misses}_total both moved",
+        )
+    finally:
+        traffic.stop()
+        http.shutdown()
+    check(
+        not traffic.non_200,
+        f"zero non-200s across all four swap paths ({traffic.ok} "
+        f"requests; first bad: {traffic.non_200[:1]})",
+    )
+
+
+# --------------------------------------------------------------------------
+# Phase B: two replicas behind a router — forwarded headers, conserved
+# federated counters, fleet-merged flush events
+# --------------------------------------------------------------------------
+
+
+def phase_federated() -> None:
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.data.storage import Storage
+    from predictionio_tpu.obs import MetricRegistry
+    from predictionio_tpu.parallel.mesh import ComputeContext
+    from predictionio_tpu.serving.config import ServerConfig
+    from predictionio_tpu.serving.engine_server import EngineServer
+    from predictionio_tpu.serving.router import ServingRouter
+
+    def build_replica(rid: str):
+        """One in-process replica: own memory storage (so reloads can
+        be triggered per replica), own registry (so the conservation
+        check sums true per-replica series, not a shared global)."""
+        engine, params, _ = build_pipeline()
+        storage = Storage(
+            env={
+                "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+            }
+        )
+        ctx = ComputeContext.create(batch=f"cache-smoke-{rid}")
+
+        def retrain():
+            return run_train(
+                engine, params, engine_id=f"cache-{rid}", ctx=ctx,
+                storage=storage,
+            )
+
+        retrain()
+        server = EngineServer(
+            engine, params, engine_id=f"cache-{rid}", storage=storage,
+            ctx=ctx, cache=True, registry=MetricRegistry(),
+            max_wait_ms=0.5,
+        )
+        http = server.serve(host="127.0.0.1", port=0)
+        http.start()
+        return server, http, retrain
+
+    replicas: dict[str, tuple] = {}
+    router_http = None
+    try:
+        for rid in ("a", "b"):
+            replicas[rid] = build_replica(rid)
+
+        config = ServerConfig(key_auth_enforced=True, access_key=ADMIN_KEY)
+        router = ServingRouter(
+            probe_interval_s=0.2, probe_timeout_s=2.0, unhealthy_after=1,
+            failover_retries=1, proxy_timeout_s=20.0, server_config=config,
+        )
+        router_http = router.serve(host="127.0.0.1", port=0)
+        router_http.start()
+        base = f"http://127.0.0.1:{router_http.port}"
+        key_hdr = {"X-PIO-Server-Key": ADMIN_KEY}
+        for rid, (_, http, _) in replicas.items():
+            status, _, _ = http_json(
+                f"{base}/admin/replicas",
+                {"id": rid, "url": f"http://127.0.0.1:{http.port}",
+                 "generation": "g1"},
+                headers=key_hdr,
+            )
+            check(status == 201, f"replica {rid} registered")
+        healthy = wait_for(
+            lambda: all(
+                r.get("state") == "healthy"
+                for r in http_json(base)[1].get("replicas", ())
+            ) and len(http_json(base)[1].get("replicas", ())) == 2,
+            60, "both replicas healthy",
+        )
+        check(bool(healthy), "both replicas admitted")
+
+        # -- 4: the header crosses the router -----------------------------
+        states = []
+        for _ in range(8):
+            status, out, headers = http_json(
+                f"{base}/queries.json", {"x": 7}
+            )
+            check(status == 200, f"routed query 200 (got {status})")
+            states.append(headers.get("X-PIO-Cache"))
+        check(
+            "miss" in states and "hit" in states,
+            f"X-PIO-Cache forwarded through the router (saw {states})",
+        )
+        status, _, headers = http_json(
+            f"{base}/queries.json", {"x": 7},
+            headers={"Cache-Control": "no-cache"},
+        )
+        check(
+            status == 200 and headers.get("X-PIO-Cache") is None,
+            "Cache-Control: no-cache forwarded: bypassed reply has no "
+            f"cache state (got {headers.get('X-PIO-Cache')!r})",
+        )
+
+        # more traffic over a few keys so every counter moves
+        for i in range(40):
+            http_json(f"{base}/queries.json", {"x": i % 5})
+
+        # -- 5: federated counters conserve -------------------------------
+        status, fed, _ = http_json(f"{base}/metrics.json")
+        check(
+            status == 200 and "fleet" in fed and "perReplica" in fed,
+            "router /metrics.json is a federated payload",
+        )
+        for name in (
+            "pio_cache_hits_total",
+            "pio_cache_misses_total",
+            "pio_cache_coalesced_total",
+        ):
+            fleet = metric_sum(fed.get("fleet", {}), name)
+            per_replica = sum(
+                metric_sum(p, name)
+                for p in fed.get("perReplica", {}).values()
+            )
+            direct = sum(
+                metric_sum(
+                    http_json(
+                        f"http://127.0.0.1:{http.port}/metrics.json"
+                    )[1],
+                    name,
+                )
+                for _, http, _ in replicas.values()
+            )
+            check(
+                fleet == per_replica == direct,
+                f"{name} conserved: fleet {fleet} == Σ perReplica "
+                f"{per_replica} == Σ direct {direct}",
+            )
+        check(
+            metric_sum(fed.get("fleet", {}), "pio_cache_hits_total") > 0,
+            "fleet saw at least one cache hit",
+        )
+
+        # -- 6: flush events merge fleet-wide -----------------------------
+        for rid, (_, http, retrain) in replicas.items():
+            retrain()
+            status, _, _ = http_json(
+                f"http://127.0.0.1:{http.port}/reload",
+                body={"canary": False},
+            )
+            check(status == 200, f"replica {rid} reloaded ({status})")
+        merged = wait_for(
+            lambda: {
+                e.get("replica")
+                for e in timeline_events(base, "cache_flush",
+                                         headers=key_hdr)
+            } >= {"a", "b"},
+            30, "fleet-merged cache_flush events",
+        )
+        check(
+            bool(merged),
+            "router timeline merges each replica's cache_flush with "
+            "provenance",
+        )
+    finally:
+        if router_http is not None:
+            router_http.shutdown()
+        for server, http, _ in replicas.values():
+            http.shutdown()
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    print("== cache smoke: swap-path invalidation (single) ==", flush=True)
+    phase_single()
+    print("== cache smoke: router federation ==", flush=True)
+    phase_federated()
+    took = time.monotonic() - t0
+    if failures:
+        print(f"\nFAILED {len(failures)} check(s) in {took:.1f}s:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print(f"\nall checks passed in {took:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

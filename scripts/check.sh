@@ -213,6 +213,34 @@ if ! timeout -k 10 420 env JAX_PLATFORMS=cpu \
     rc=1
 fi
 
+echo "== serving cache bench (generation-keyed cache, docs/serving.md) =="
+# skewed traffic (shared Zipf keys, alpha 0.9 and 1.1) with the cache
+# on vs off: at alpha=1.1 cached QPS must beat uncached by the floor
+# with hit-path p99 under the uncached p50, and EVERY answer must be
+# byte-identical cache-on vs cache-off (equality always gates; the
+# speedup gate is recorded-not-gated when the uncached baseline is
+# degenerate on the runner, < 5 QPS) — recorded to SERVING_BENCH.json
+# as serving-cache/v1
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu \
+    python scripts/serving_bench.py --skew --smoke; then
+    echo "serving cache bench FAILED"
+    rc=1
+fi
+
+echo "== cache smoke test (generation-keyed serving cache, docs/serving.md) =="
+# every swap path flushes: immediate /reload, canary promotion,
+# automatic rollback (the OLD generation's answers come back), and
+# trainer fold-in each land a cache_flush{reason} timeline event with
+# zero stale answers under continuous traffic; Cache-Control: no-cache
+# bypasses; eviction bursts emit cache_pressure; X-PIO-Cache crosses
+# the router and federated pio_cache_* counters conserve
+# (fleet == sum of replicas)
+if ! timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    python scripts/cache_smoke.py; then
+    echo "cache smoke test FAILED"
+    rc=1
+fi
+
 echo "== trainer smoke test (crash-safe continuous training, docs/training.md) =="
 # supervised trainer killed -9 mid-epoch resumes from checkpoint;
 # fold-in freshness recorded to SERVING_BENCH.json; corrupt artifact

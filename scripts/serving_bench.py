@@ -72,6 +72,22 @@ the kernel runs in interpreter mode, so that latency is recorded for
 trend only, never gated. Lands in SERVING_BENCH.json as a
 ``serving-density/v1`` record.
 
+**Skew mode** (``--skew``): the generation-keyed serving-cache proof
+under Zipf-skewed traffic (shared key generator, scripts/bench_keys.py
+— the same distribution --density uses for its tenant mix). Each
+α ∈ {0.9, 1.1} runs a closed-loop pass twice over one key sequence —
+cache OFF (every request pays a batcher slot on the simulated device)
+and cache ON (a real :class:`~predictionio_tpu.serving.querycache
+.QueryCache`, byte-budgeted to hold only ~a quarter of the key space
+so the LRU must keep the Zipf head) — and records QPS, hit/miss/
+coalesced counts, and hit-path p50/p99. Gates: byte-identical answers
+per key across BOTH passes (always — same generation ⇒ same bytes),
+and at α=1.1 cached QPS ≥ ``--skew-floor``× uncached with hit-path
+p99 below the uncached p50 (the speedup floor takes the same
+recorded-not-gated degenerate-runner escape as --density when the
+uncached baseline itself collapses). Lands in SERVING_BENCH.json as a
+``serving-cache/v1`` record.
+
 No jax import outside ``--density`` — the pipeline modes exercise the
 batcher itself, so they run in seconds on any CPU-only runner.
 """
@@ -784,11 +800,15 @@ def density_main(args) -> int:
     # thrashes under the mix, big enough that int8 (~0.26x) holds most
     # of the tenant set resident
     budget = int(2.5 * f32_bytes)
-    # skewed tenant mix (weight ∝ 1/rank): the shape multi-tenant
-    # traffic actually has — LRU keeps the head hot, the tail faults
-    weights = 1.0 / (1.0 + np.arange(n_tenants))
-    weights /= weights.sum()
-    sequence = rng.choice(n_tenants, size=requests, p=weights)
+    # skewed tenant mix (Zipf alpha=1.0, weight ∝ 1/rank): the shape
+    # multi-tenant traffic actually has — LRU keeps the head hot, the
+    # tail faults. Shared generator (bench_keys) with --skew; passing
+    # this rng keeps the draws identical to the old hand-rolled code.
+    import bench_keys
+
+    sequence = bench_keys.zipf_sequence(
+        n_tenants, requests, alpha=1.0, rng=rng
+    )
     queries = jnp.asarray(
         rng.standard_normal((batch, k_dim)).astype(np.float32)
     )
@@ -1028,6 +1048,285 @@ def density_main(args) -> int:
     return 0
 
 
+def _skew_prediction(k: int) -> dict:
+    """Deterministic per-key 'model answer' — the stand-in for
+    ``serving.serve`` so byte equality across passes is checkable."""
+    return {
+        "user": f"u{k}",
+        "itemScores": [
+            {"item": f"i{j}", "score": (k * 131 + j * 17) % 997}
+            for j in range(10)
+        ],
+    }
+
+
+def run_skew_pass(
+    sequence, *, use_cache: bool, cache_budget: int, workers: int,
+    max_batch: int, max_wait_ms: float, device_ms: float,
+    enqueue_ms: float, decode_ms: float,
+) -> dict:
+    """One closed-loop pass over a skewed key sequence. ``use_cache``
+    interposes a real QueryCache exactly where the engine server does:
+    after 'admission' (the worker picked the request up), before the
+    batcher (hits never submit). Returns rates, state counts, hit-path
+    percentiles, and the per-key answer bytes for equality gating."""
+    from predictionio_tpu.serving.querycache import (
+        QueryCache,
+        canonical_query_bytes,
+    )
+
+    dev = SimDevice(
+        device_ms / 1000.0, enqueue_ms / 1000.0, decode_ms / 1000.0
+    )
+    batcher = MicroBatcher(
+        TwoPhaseBatchFn(dev.dispatch, dev.collect),
+        max_batch=max_batch,
+        max_wait_ms=max_wait_ms,
+        max_queue=0,
+        pipeline_depth=2,
+        name=f"bench-skew-{'on' if use_cache else 'off'}",
+    )
+    cache = (
+        QueryCache(cache_budget, shards=4, registry=None)
+        if use_cache
+        else None
+    )
+    n_keys = int(max(sequence)) + 1
+    canon = [
+        canonical_query_bytes({"user": f"u{k}", "num": 10})
+        for k in range(n_keys)
+    ]
+    lock = threading.Lock()
+    answers: dict[int, bytes] = {}
+    mismatched: list[int] = []
+    counts = {"hit": 0, "miss": 0, "coalesced": 0}
+    all_lat: list[float] = []
+    hit_lat: list[float] = []
+    next_idx = {"i": 0}
+    errors: list[str] = []
+
+    def compute(k: int) -> bytes:
+        # the uncached tail: one batcher slot on the simulated device,
+        # then the same single json.dumps the engine server's leader
+        # path uses
+        batcher.submit(k).result(timeout=30)
+        return json.dumps(_skew_prediction(k)).encode("utf-8")
+
+    def one(k: int) -> None:
+        t_req = time.perf_counter()
+        if cache is None:
+            body = compute(k)
+            state = "miss"
+        else:
+            claim = cache.claim("", "g1", canon[k])
+            if claim.hit:
+                body = claim.value
+                state = "hit"
+            elif claim.leader:
+                try:
+                    body = compute(k)
+                except BaseException as exc:
+                    cache.abort(claim, exc)
+                    raise
+                cache.fill(claim, body)
+                state = "miss"
+            else:
+                body = cache.join(claim, 30.0)
+                state = "coalesced"
+        dt = time.perf_counter() - t_req
+        with lock:
+            counts[state] += 1
+            all_lat.append(dt)
+            if state == "hit":
+                hit_lat.append(dt)
+            prev = answers.setdefault(k, body)
+            if prev != body and k not in mismatched:
+                mismatched.append(k)
+
+    def worker() -> None:
+        while True:
+            with lock:
+                i = next_idx["i"]
+                next_idx["i"] += 1
+            if i >= len(sequence):
+                return
+            try:
+                one(int(sequence[i]))
+            except Exception as exc:  # noqa: BLE001 - recorded, fails pass
+                with lock:
+                    errors.append(f"key {int(sequence[i])}: {exc}")
+
+    threads = [
+        threading.Thread(target=worker, name=f"skew-{w}", daemon=True)
+        for w in range(workers)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    batcher.close()
+    all_lat.sort()
+    hit_lat.sort()
+    n = len(all_lat)
+    return {
+        "cache": "on" if use_cache else "off",
+        "qps": round(n / max(1e-9, elapsed), 1),
+        "p50_ms": round(_percentile(all_lat, 0.50) * 1000, 3),
+        "p99_ms": round(_percentile(all_lat, 0.99) * 1000, 3),
+        "hit_p50_ms": round(_percentile(hit_lat, 0.50) * 1000, 3),
+        "hit_p99_ms": round(_percentile(hit_lat, 0.99) * 1000, 3),
+        "hits": counts["hit"],
+        "misses": counts["miss"],
+        "coalesced": counts["coalesced"],
+        "hit_rate": round(counts["hit"] / max(1, n), 3),
+        "batches": dev.batches,
+        "requests": n,
+        "elapsed_s": round(elapsed, 3),
+        "errors": errors,
+        "answers": answers,
+    }
+
+
+def skew_main(args) -> int:
+    """Generation-keyed serving cache under Zipf-skewed traffic:
+    cache-off vs cache-on at α ∈ {0.9, 1.1}, gated on byte-identical
+    answers (always) and the α=1.1 hit-path speedup."""
+    import bench_keys
+
+    n_keys = args.skew_keys or (200 if args.smoke else 400)
+    requests = args.requests or (2400 if args.smoke else 8000)
+    floor = args.skew_floor
+    workers = 8
+    # budget ≈ a quarter of the key space resident: the LRU must earn
+    # its hit rate by keeping the Zipf head, not by caching everything
+    sample_value = json.dumps(_skew_prediction(0)).encode("utf-8")
+    entry_bytes = len(sample_value) + 64 + 256
+    cache_budget = max(4096, (n_keys // 4) * entry_bytes)
+    print(
+        f"serving_bench --skew: {n_keys} keys, {requests} requests/"
+        f"pass, {workers} workers, cache budget {cache_budget} B "
+        f"(~{n_keys // 4} of {n_keys} keys resident)"
+    )
+
+    common = dict(
+        workers=workers, cache_budget=cache_budget,
+        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        device_ms=args.device_ms, enqueue_ms=args.enqueue_ms,
+        decode_ms=args.decode_ms,
+    )
+    failures: list[str] = []
+    degenerate = ""
+    by_alpha: dict[str, dict] = {}
+    speedup_at_gate = 0.0
+    for alpha in (0.9, 1.1):
+        sequence = bench_keys.zipf_sequence(
+            n_keys, requests, alpha=alpha, seed=int(alpha * 10)
+        )
+        off = run_skew_pass(sequence, use_cache=False, **common)
+        on = run_skew_pass(sequence, use_cache=True, **common)
+        # exact-equality gate, both directions: every key answered in
+        # both passes must have produced byte-identical responses
+        # (same generation ⇒ same bytes, hit or miss)
+        unequal = [
+            k for k, body in on.pop("answers").items()
+            if off["answers"].get(k, body) != body
+        ]
+        off.pop("answers")
+        speedup = round(on["qps"] / max(1e-9, off["qps"]), 3)
+        result = {"off": off, "on": on, "speedup": speedup}
+        by_alpha[f"{alpha}"] = result
+        print(
+            f"  alpha={alpha}: off {off['qps']} qps p50 "
+            f"{off['p50_ms']}ms | on {on['qps']} qps "
+            f"(hit rate {on['hit_rate']}, hit p99 "
+            f"{on['hit_p99_ms']}ms) | speedup {speedup}x"
+        )
+        for label, p in (("off", off), ("on", on)):
+            if p["errors"]:
+                failures.append(
+                    f"alpha={alpha} cache-{label} pass errored: "
+                    f"{p['errors'][:3]}"
+                )
+        if unequal:
+            failures.append(
+                f"alpha={alpha}: {len(unequal)} key(s) answered "
+                f"non-identically cache-on vs cache-off "
+                f"(e.g. {sorted(unequal)[:5]})"
+            )
+        if alpha == 1.1:
+            speedup_at_gate = speedup
+            if off["qps"] < 5.0:
+                # the runner itself collapsed: the speedup would
+                # measure harness noise. Equality above still gates.
+                degenerate = (
+                    f"uncached pass served only {off['qps']} req/s — "
+                    "runner, not cache, saturated; speedup gate "
+                    "skipped"
+                )
+                print(
+                    f"serving_bench --skew: degenerate run "
+                    f"({degenerate})",
+                    file=sys.stderr,
+                )
+            else:
+                if speedup < floor:
+                    failures.append(
+                        f"alpha=1.1 cached QPS {on['qps']} is only "
+                        f"{speedup}x uncached {off['qps']} "
+                        f"(< {floor}x)"
+                    )
+                if on["hits"] and not (
+                    on["hit_p99_ms"] < off["p50_ms"]
+                ):
+                    failures.append(
+                        f"alpha=1.1 hit-path p99 {on['hit_p99_ms']}ms "
+                        f"not below uncached p50 {off['p50_ms']}ms"
+                    )
+                if not on["hits"]:
+                    failures.append(
+                        "alpha=1.1 cached pass recorded zero hits"
+                    )
+
+    record = {
+        "metric": "serving_cache_speedup",
+        "record": "serving-cache/v1",
+        "value": speedup_at_gate,
+        "unit": "x",
+        "extra": {
+            "by_alpha": by_alpha,
+            "params": {
+                "keys": n_keys,
+                "requests": requests,
+                "workers": workers,
+                "cache_budget_bytes": cache_budget,
+                "speedup_floor": floor,
+                "smoke": args.smoke,
+            },
+        },
+    }
+    if degenerate:
+        record["extra"]["degenerate"] = degenerate
+    if failures:
+        record["error"] = failures
+    if args.out:
+        persist_record(record, args.out)
+    print(json.dumps(record))
+    if failures:
+        print(
+            "serving_bench --skew: FAILED: " + "; ".join(failures),
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"serving_bench --skew: cached serving holds "
+        f"{speedup_at_gate}x uncached QPS at alpha=1.1 with "
+        f"byte-identical answers — ok"
+    )
+    return 0
+
+
 def persist_record(record: dict, out_path: str) -> None:
     """Append the run to the stable serving-bench trajectory file
     (schema serving-bench/v1), mirroring how the training bench's
@@ -1111,6 +1410,18 @@ def main() -> int:
                     help="int8 aggregate QPS as a fraction of f32's "
                          "(goodput parity; skipped on a degenerate "
                          "runner, recorded either way)")
+    ap.add_argument("--skew", action="store_true",
+                    help="run ONLY the serving-cache skewed-traffic "
+                         "bench: cache-off vs cache-on under Zipf "
+                         "alpha in {0.9, 1.1}, gated on byte-equal "
+                         "answers + the alpha=1.1 hit-path speedup "
+                         "(docs/serving.md 'Serving query cache')")
+    ap.add_argument("--skew-keys", type=int, default=None,
+                    help="distinct query keys (default 200 smoke, "
+                         "400); the cache budget holds ~a quarter")
+    ap.add_argument("--skew-floor", type=float, default=1.5,
+                    help="cached/uncached QPS floor at alpha=1.1 "
+                         "(recorded-not-gated on a degenerate runner)")
     ap.add_argument("--out", default=os.path.join(
                         REPO, "SERVING_BENCH.json"),
                     help="append the run record to this trajectory "
@@ -1121,6 +1432,8 @@ def main() -> int:
         return ramp_main(args)
     if args.density:
         return density_main(args)
+    if args.skew:
+        return skew_main(args)
 
     total = args.requests or (2000 if args.smoke else 8000)
     idle_n = args.idle_requests or (80 if args.smoke else 200)

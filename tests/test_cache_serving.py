@@ -1,0 +1,417 @@
+"""Generation-keyed serving cache through a real EngineServer:
+X-PIO-Cache provenance headers, byte-identical hits, the no-cache
+bypass, single-flight call counting, invalidation on reload, and the
+auto-rollback path restoring the OLD generation's answers with zero
+rolled-back entries surviving (docs/serving.md "Serving query cache")."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from fake_engine import (
+    FakeAlgorithm,
+    FakeDataSource,
+    FakeParams,
+    FakePreparator,
+    FakeServing,
+)
+from predictionio_tpu.core import Engine, EngineParams
+from predictionio_tpu.core.workflow import run_train
+from predictionio_tpu.parallel.mesh import ComputeContext
+from predictionio_tpu.serving.canary import CanaryConfig
+from predictionio_tpu.serving.engine_server import EngineServer
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return ComputeContext.create(batch="cache-srv-test")
+
+
+class TagAlgorithm(FakeAlgorithm):
+    """Answers are generation-tagged ONLY for queries carrying a
+    ``probe`` key: probes are never sent while a canary is shadowing,
+    so the divergence gate stays clean while tests can still observe
+    exactly which generation answered a cached lookup."""
+
+    tag = "g1"
+    slow_s = 0.0
+    calls: list = []
+
+    def train(self, ctx, pd):
+        return {"tag": type(self).tag, "slow_s": type(self).slow_s}
+
+    def _answer(self, model, query):
+        if "boom" in query:
+            raise ValueError("synthetic model failure")
+        if "probe" in query:
+            return {"result": model["tag"]}
+        return {"result": 1.0}
+
+    def predict(self, model, query):
+        if model["slow_s"]:
+            time.sleep(model["slow_s"])
+        return self._answer(model, query)
+
+    def batch_predict(self, model, queries):
+        type(self).calls.append(list(queries))
+        if model["slow_s"]:
+            time.sleep(model["slow_s"])
+        return [self._answer(model, q) for q in queries]
+
+
+class TagServing(FakeServing):
+    def serve(self, query, predictions):
+        return predictions[0]
+
+
+def _engine():
+    return Engine(FakeDataSource, FakePreparator, TagAlgorithm, TagServing)
+
+
+def _params():
+    return EngineParams(
+        data_source=("", FakeParams(id=1)),
+        preparator=("", FakeParams(id=2)),
+        algorithms=[("", FakeParams(id=3))],
+        serving=("", FakeParams()),
+    )
+
+
+def _call(url, method="GET", body=None, headers=None):
+    """Returns (status, parsed_json, response_headers, raw_bytes)."""
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method, headers=headers or {}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            raw = resp.read()
+            return resp.status, json.loads(raw or b"null"), resp.headers, raw
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        return e.code, json.loads(raw or b"null"), e.headers, raw
+
+
+def _query(base, body, headers=None):
+    status, out, resp_headers, raw = _call(
+        f"{base}/queries.json", "POST", body, headers
+    )
+    assert status == 200, out
+    return out, resp_headers.get("X-PIO-Cache"), raw
+
+
+def _flush_reasons(base):
+    status, data, _, _ = _call(f"{base}/debug/timeline.json")
+    assert status == 200
+    return [
+        e.get("reason") for e in data.get("events", [])
+        if e.get("kind") == "cache_flush"
+    ]
+
+
+def _train(ctx, storage, tag, slow_s=0.0):
+    TagAlgorithm.tag = tag
+    TagAlgorithm.slow_s = slow_s
+    return run_train(
+        _engine(), _params(), engine_id="cache", ctx=ctx,
+        storage=storage,
+    )
+
+
+def _serve(ctx, storage, **kwargs):
+    es = EngineServer(
+        _engine(), _params(), engine_id="cache", storage=storage,
+        ctx=ctx, max_wait_ms=0.5, **kwargs,
+    )
+    http = es.serve(host="127.0.0.1", port=0)
+    http.start()
+    return f"http://127.0.0.1:{http.port}", es, http
+
+
+@pytest.fixture()
+def cache_server(ctx, memory_storage):
+    _train(ctx, memory_storage, "g1")
+    base, es, http = _serve(ctx, memory_storage, cache=True)
+    yield base, es
+    http.shutdown()
+
+
+class TestCacheServing:
+    def test_miss_then_hit_byte_identical(self, cache_server):
+        base, es = cache_server
+        out1, state1, raw1 = _query(base, {"probe": 1, "x": 7})
+        out2, state2, raw2 = _query(base, {"x": 7, "probe": 1})
+        assert state1 == "miss"
+        # key-order-insensitive: the reordered query hits the same entry
+        assert state2 == "hit"
+        assert raw2 == raw1, "cached bytes differ from computed bytes"
+        assert out1["result"] == "g1"
+
+    def test_no_cache_bypass_recomputes(self, cache_server):
+        base, es = cache_server
+        _query(base, {"x": 3})
+        before = sum(len(c) for c in TagAlgorithm.calls)
+        out, state, _ = _query(
+            base, {"x": 3}, headers={"Cache-Control": "no-cache"}
+        )
+        assert state is None, "bypassed request must carry no header"
+        after = sum(len(c) for c in TagAlgorithm.calls)
+        assert after == before + 1, "bypass must recompute"
+
+    def test_cache_off_by_default(self, ctx, memory_storage, monkeypatch):
+        monkeypatch.delenv("PIO_CACHE", raising=False)
+        monkeypatch.delenv("PIO_CACHE_BUDGET_BYTES", raising=False)
+        _train(ctx, memory_storage, "g1")
+        base, es, http = _serve(ctx, memory_storage)
+        try:
+            _, state, _ = _query(base, {"x": 1})
+            assert state is None
+            _, state, _ = _query(base, {"x": 1})
+            assert state is None
+            status, data, _, _ = _call(f"{base}/")
+            assert "cache" not in data
+        finally:
+            http.shutdown()
+
+    def test_status_reports_cache_block(self, cache_server):
+        base, es = cache_server
+        _query(base, {"x": 9})
+        status, data, _, _ = _call(f"{base}/")
+        assert status == 200
+        cache = data["cache"]
+        assert cache["entries"] >= 1
+        assert cache["residentBytes"] > 0
+        assert cache["budgetBytes"] == es._cache.budget_bytes
+
+    def test_reload_invalidates_and_swaps_answers(
+        self, cache_server, ctx, memory_storage
+    ):
+        base, es = cache_server
+        out, _, _ = _query(base, {"probe": 1})
+        assert out["result"] == "g1"
+        out, state, _ = _query(base, {"probe": 1})
+        assert state == "hit" and out["result"] == "g1"
+        _train(ctx, memory_storage, "g2")
+        status, body, _, _ = _call(f"{base}/reload", "POST")
+        assert status == 200, body
+        out, state, _ = _query(base, {"probe": 1})
+        assert state == "miss", "old generation's entry survived reload"
+        assert out["result"] == "g2"
+        out, state, _ = _query(base, {"probe": 1})
+        assert state == "hit" and out["result"] == "g2"
+        assert "reload" in _flush_reasons(base)
+
+    def test_single_flight_one_compute_for_n_identical(
+        self, ctx, memory_storage
+    ):
+        """The call-count proof: N concurrent identical cold queries
+        dispatch exactly ONE batcher computation; everyone else
+        coalesces onto it and receives the same bytes."""
+        _train(ctx, memory_storage, "g1", slow_s=0.4)
+        base, es, http = _serve(ctx, memory_storage, cache=True)
+        try:
+            TagAlgorithm.calls = []
+            n = 6
+            barrier = threading.Barrier(n)
+            results = []
+            lock = threading.Lock()
+
+            def one():
+                barrier.wait()
+                out, state, raw = _query(base, {"x": 42, "probe": 1})
+                with lock:
+                    results.append((state, raw))
+
+            threads = [
+                threading.Thread(target=one, daemon=True)
+                for _ in range(n)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            assert len(results) == n
+            computed = sum(
+                1 for call in TagAlgorithm.calls for q in call
+                if q.get("x") == 42
+            )
+            assert computed == 1, (
+                f"{computed} computations for {n} identical queries"
+            )
+            states = sorted(s for s, _ in results)
+            assert states.count("miss") == 1
+            assert states.count("coalesced") >= 1
+            assert set(states) <= {"miss", "coalesced", "hit"}
+            bodies = {raw for _, raw in results}
+            assert len(bodies) == 1, "coalesced waiters saw other bytes"
+        finally:
+            http.shutdown()
+
+    def test_leader_failure_not_cached(self, ctx, memory_storage):
+        """A failing leader surfaces a real error to its waiters and
+        leaves no negative entry: the next identical query computes
+        again instead of replaying a cached failure."""
+        _train(ctx, memory_storage, "g1", slow_s=0.2)
+        base, es, http = _serve(ctx, memory_storage, cache=True)
+        try:
+            TagAlgorithm.calls = []
+            barrier = threading.Barrier(2)
+            statuses = []
+            lock = threading.Lock()
+
+            def one():
+                barrier.wait()
+                status, _, _, _ = _call(
+                    f"{base}/queries.json", "POST", {"boom": 1}
+                )
+                with lock:
+                    statuses.append(status)
+
+            threads = [
+                threading.Thread(target=one, daemon=True)
+                for _ in range(2)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            assert statuses and all(s >= 500 for s in statuses)
+            before = sum(
+                1 for call in TagAlgorithm.calls for q in call
+                if "boom" in q
+            )
+            status, _, _, _ = _call(
+                f"{base}/queries.json", "POST", {"boom": 1}
+            )
+            assert status >= 500
+            after = sum(
+                1 for call in TagAlgorithm.calls for q in call
+                if "boom" in q
+            )
+            assert after == before + 1, "failure was negatively cached"
+            # the cache still works for healthy queries
+            _, state, _ = _query(base, {"x": 5})
+            assert state == "miss"
+            _, state, _ = _query(base, {"x": 5})
+            assert state == "hit"
+        finally:
+            http.shutdown()
+
+
+class TestRollbackInvalidation:
+    """Satellite: auto-rollback must restore the OLD generation's
+    answers — zero entries from the rolled-back generation survive."""
+
+    def _drive_until(self, base, predicate, start=0, n_max=400):
+        for i in range(n_max):
+            # distinct keys: every request computes (cache misses), so
+            # the canary keeps observing real latencies
+            out, _, _ = _query(base, {"x": start + i})
+            if predicate():
+                return
+            time.sleep(0.005)
+        raise AssertionError("predicate never held")
+
+    def test_rollback_restores_old_answers(self, ctx, memory_storage):
+        g1 = _train(ctx, memory_storage, "old")
+        config = CanaryConfig(
+            shadow_sample=1.0, min_shadow=3, max_divergence=0.05,
+            watch_min_requests=3, watch_s=0.0, latency_factor=4.0,
+            error_rate_limit=0.2, shadow_timeout_s=5.0,
+        )
+        base, es, http = _serve(
+            ctx, memory_storage, cache=True, canary=config
+        )
+        try:
+            out, state, _ = _query(base, {"probe": 1})
+            assert out["result"] == "old" and state == "miss"
+            out, state, _ = _query(base, {"probe": 1})
+            assert state == "hit"
+            # identical non-probe answers (divergence 0 → promotes)
+            # but slow to serve: the regression only shows AFTER
+            # promotion, forcing the watch to auto-roll-back
+            g2 = _train(ctx, memory_storage, "new", slow_s=0.05)
+            status, body, _, _ = _call(f"{base}/reload", "POST")
+            assert status == 202, body
+            self._drive_until(
+                base,
+                lambda: es._status_data()["engineInstanceId"] == g2,
+            )
+            # the promoted generation populates cache entries that the
+            # rollback must then kill
+            out, _, _ = _query(base, {"probe": 1})
+            assert out["result"] == "new"
+            out, state, _ = _query(base, {"probe": 1})
+            assert state == "hit" and out["result"] == "new"
+            self._drive_until(
+                base,
+                lambda: (es._last_canary or {}).get("state")
+                == "rolled_back",
+                start=1000,
+            )
+            assert es._status_data()["engineInstanceId"] == g1
+            # zero stale answers: every cached lookup now serves the
+            # OLD generation's tag; nothing from g2 survives
+            seen_hit = False
+            for _ in range(10):
+                out, state, _ = _query(base, {"probe": 1})
+                assert out["result"] == "old", (
+                    "rolled-back generation's answer served from cache"
+                )
+                seen_hit = seen_hit or state == "hit"
+            assert seen_hit, "old generation's answers never re-cached"
+            reasons = _flush_reasons(base)
+            assert "promote" in reasons
+            assert "rollback" in reasons
+        finally:
+            http.shutdown()
+
+
+class TestCacheCLI:
+    def test_cache_summary_line_formats(self):
+        from predictionio_tpu.cli.main import _cache_summary_line
+
+        line = _cache_summary_line(
+            {
+                "pio_cache_budget_bytes": {
+                    "samples": [{"labels": {}, "value": 65536}]
+                },
+                "pio_cache_resident_bytes": {
+                    "samples": [{"labels": {}, "value": 1024}]
+                },
+                "pio_cache_hits_total": {
+                    "samples": [
+                        {"labels": {"tenant": "a"}, "value": 6},
+                        {"labels": {"tenant": "b"}, "value": 3},
+                    ]
+                },
+                "pio_cache_misses_total": {
+                    "samples": [{"labels": {"tenant": "a"}, "value": 3}]
+                },
+                "pio_cache_coalesced_total": {
+                    "samples": [{"labels": {"tenant": "a"}, "value": 2}]
+                },
+                "pio_cache_evictions_total": {
+                    "samples": [{"labels": {"tenant": "b"}, "value": 4}]
+                },
+            }
+        )
+        assert line == (
+            "cache: bytes=1024/65536 hitRate=0.75 coalesced=2 "
+            "evictions=4"
+        )
+        # no cache series scraped → no line (cache-off server)
+        assert _cache_summary_line({}) is None
+        # a cold cache omits the hit rate
+        cold = _cache_summary_line(
+            {
+                "pio_cache_budget_bytes": {
+                    "samples": [{"labels": {}, "value": 100}]
+                }
+            }
+        )
+        assert cold == "cache: bytes=0/100 coalesced=0 evictions=0"

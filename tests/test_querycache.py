@@ -1,0 +1,393 @@
+"""Serving query cache: canonicalization, byte-budgeted LRU,
+generation keying, single-flight coalescing, flush semantics, env
+knobs, and the shared Zipf key generator the skew bench draws from."""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.obs import MetricRegistry
+from predictionio_tpu.obs import timeline as timeline_mod
+from predictionio_tpu.serving import admission
+from predictionio_tpu.serving import querycache
+from predictionio_tpu.serving.querycache import (
+    LeaderFailed,
+    QueryCache,
+    WaiterTimeout,
+    canonical_query_bytes,
+)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+
+GEN = "inst-1:0"
+
+
+def _fill(cache, tenant, gen, query, value: bytes):
+    claim = cache.claim(tenant, gen, canonical_query_bytes(query))
+    assert claim.leader
+    cache.fill(claim, value)
+    return claim
+
+
+class TestCanonicalization:
+    def test_key_order_invariant(self):
+        assert canonical_query_bytes(
+            {"b": 2, "a": 1}
+        ) == canonical_query_bytes({"a": 1, "b": 2})
+
+    def test_volatile_fields_stripped(self):
+        assert canonical_query_bytes(
+            {"x": 1, "prId": "abc", "pid": 42, "generation": "g9"}
+        ) == canonical_query_bytes({"x": 1})
+
+    def test_distinct_queries_distinct_keys(self):
+        assert canonical_query_bytes({"x": 1}) != canonical_query_bytes(
+            {"x": 2}
+        )
+
+    def test_compact_and_deterministic(self):
+        canon = canonical_query_bytes({"user": "u1", "num": 3})
+        assert canon == b'{"num":3,"user":"u1"}'
+
+
+class TestLRU:
+    def test_hit_after_fill(self):
+        cache = QueryCache(1 << 20, shards=2)
+        _fill(cache, "", GEN, {"x": 1}, b"answer")
+        claim = cache.claim("", GEN, canonical_query_bytes({"x": 1}))
+        assert claim.hit and claim.value == b"answer"
+
+    def test_generation_key_misses_across_swap(self):
+        cache = QueryCache(1 << 20, shards=2)
+        _fill(cache, "", "inst-1:0", {"x": 1}, b"old")
+        claim = cache.claim(
+            "", "inst-2:1", canonical_query_bytes({"x": 1})
+        )
+        assert not claim.hit and claim.leader
+
+    def test_tenants_are_isolated(self):
+        cache = QueryCache(1 << 20, shards=2)
+        _fill(cache, "t1", GEN, {"x": 1}, b"t1-answer")
+        claim = cache.claim("t2", GEN, canonical_query_bytes({"x": 1}))
+        assert not claim.hit
+
+    def test_budget_evicts_lru_first(self):
+        # one shard so LRU order is global; entries ~(5 + canon + 256)
+        cache = QueryCache(1200, shards=1)
+        for i in range(4):
+            _fill(cache, "", GEN, {"x": i}, b"v" * 5)
+        # 4 entries at ~270 B exceed 1200 only at the 5th; touch x=0
+        # so x=1 is the LRU victim when overflow comes
+        assert cache.claim(
+            "", GEN, canonical_query_bytes({"x": 0})
+        ).hit
+        _fill(cache, "", GEN, {"x": 99}, b"v" * 5)
+        assert cache.resident_bytes() <= 1200
+        assert cache.claim(
+            "", GEN, canonical_query_bytes({"x": 0})
+        ).hit, "recently-touched entry survived"
+        assert not cache.claim(
+            "", GEN, canonical_query_bytes({"x": 1})
+        ).hit, "LRU entry evicted"
+
+    def test_oversized_entry_never_inserted(self):
+        cache = QueryCache(512, shards=1)
+        _fill(cache, "", GEN, {"x": 1}, b"v" * 4096)
+        assert len(cache) == 0
+        assert cache.resident_bytes() == 0
+
+    def test_eviction_counter_and_pressure_event(self):
+        registry = MetricRegistry()
+        timeline = timeline_mod.Timeline()
+        cache = QueryCache(
+            600, shards=1, registry=registry, timeline=timeline,
+            pressure_burst=3, pressure_window_s=60.0,
+        )
+        for i in range(8):
+            _fill(cache, "", GEN, {"x": i}, b"v" * 10)
+        evicted = sum(
+            s["value"]
+            for s in registry.to_dict()["pio_cache_evictions_total"][
+                "samples"
+            ]
+        )
+        assert evicted >= 3
+        kinds = [e["kind"] for e in timeline.to_dict()["events"]]
+        assert "cache_pressure" in kinds
+
+    def test_ttl_expiry(self):
+        now = [0.0]
+        cache = QueryCache(
+            1 << 20, shards=1, ttl_s=5.0, clock=lambda: now[0]
+        )
+        _fill(cache, "", GEN, {"x": 1}, b"answer")
+        assert cache.claim(
+            "", GEN, canonical_query_bytes({"x": 1})
+        ).hit
+        now[0] = 6.0
+        claim = cache.claim("", GEN, canonical_query_bytes({"x": 1}))
+        assert not claim.hit and claim.leader
+
+    def test_stats_shape(self):
+        cache = QueryCache(4096, shards=2, ttl_s=9.0)
+        _fill(cache, "", GEN, {"x": 1}, b"answer")
+        stats = cache.stats()
+        assert stats["budgetBytes"] == 4096
+        assert stats["entries"] == 1
+        assert stats["residentBytes"] == cache.resident_bytes() > 0
+        assert stats["shards"] == 2
+        assert stats["ttlS"] == 9.0
+        assert stats["inflight"] == 0
+
+
+class TestSingleFlight:
+    def test_concurrent_identical_misses_one_leader(self):
+        """N concurrent identical cold lookups -> exactly ONE compute
+        (the call-count proof): every other claim coalesces and gets
+        the leader's bytes."""
+        cache = QueryCache(1 << 20, shards=2)
+        canon = canonical_query_bytes({"x": 1})
+        compute_calls = []
+        results: list[bytes] = []
+        errors: list[BaseException] = []
+        barrier = threading.Barrier(8)
+        go = threading.Event()
+
+        def one():
+            barrier.wait()
+            claim = cache.claim("", GEN, canon)
+            if claim.hit:
+                results.append(claim.value)
+                return
+            if claim.leader:
+                go.wait(5)  # hold leadership until all claims landed
+                compute_calls.append(1)
+                cache.fill(claim, b"computed")
+                results.append(b"computed")
+                return
+            try:
+                results.append(cache.join(claim, timeout_s=5.0))
+            except BaseException as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        threads = [
+            threading.Thread(target=one, daemon=True) for _ in range(8)
+        ]
+        for t in threads:
+            t.start()
+        # release the leader once every thread has claimed
+        deadline = time.monotonic() + 5
+        while cache.stats()["waiters"] < 7:
+            assert time.monotonic() < deadline, cache.stats()
+            time.sleep(0.005)
+        go.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errors
+        assert len(compute_calls) == 1, "single-flight dispatched twice"
+        assert results == [b"computed"] * 8
+
+    def test_waiter_own_deadline_detaches(self):
+        cache = QueryCache(1 << 20, shards=1)
+        canon = canonical_query_bytes({"x": 1})
+        leader = cache.claim("", GEN, canon)
+        assert leader.leader
+        waiter = cache.claim("", GEN, canon)
+        assert not waiter.leader and not waiter.hit
+        t0 = time.monotonic()
+        with pytest.raises(WaiterTimeout):
+            cache.join(waiter, timeout_s=0.05)
+        assert time.monotonic() - t0 < 2.0
+        # the leader is untouched: its fill still lands + is cached
+        cache.fill(leader, b"late")
+        assert cache.claim("", GEN, canon).value == b"late"
+
+    def test_leader_failure_propagates_without_poisoning(self):
+        cache = QueryCache(1 << 20, shards=1)
+        canon = canonical_query_bytes({"x": 1})
+        leader = cache.claim("", GEN, canon)
+        waiter = cache.claim("", GEN, canon)
+        boom = ValueError("model exploded")
+        cache.abort(leader, boom)
+        with pytest.raises(LeaderFailed) as excinfo:
+            cache.join(waiter, timeout_s=1.0)
+        assert excinfo.value.__cause__ is boom
+        # no negative caching: the next claimant leads afresh
+        fresh = cache.claim("", GEN, canon)
+        assert fresh.leader and not fresh.hit
+        cache.fill(fresh, b"recovered")
+        assert cache.claim("", GEN, canon).hit
+
+    def test_criticality_escalates_to_highest_waiter(self):
+        cache = QueryCache(1 << 20, shards=1)
+        canon = canonical_query_bytes({"x": 1})
+        with admission.criticality(admission.SHEDDABLE):
+            leader = cache.claim("", GEN, canon)
+        assert leader.criticality() == admission.SHEDDABLE
+        with admission.criticality(admission.CRITICAL):
+            cache.claim("", GEN, canon)
+        assert leader.criticality() == admission.CRITICAL
+
+    def test_coalesced_counter(self):
+        registry = MetricRegistry()
+        cache = QueryCache(1 << 20, shards=1, registry=registry)
+        canon = canonical_query_bytes({"x": 1})
+        leader = cache.claim("", GEN, canon)
+        cache.claim("", GEN, canon)
+        cache.fill(leader, b"v")
+        data = registry.to_dict()
+        assert data["pio_cache_misses_total"]["samples"][0]["value"] == 1
+        assert (
+            data["pio_cache_coalesced_total"]["samples"][0]["value"] == 1
+        )
+
+
+class TestFlush:
+    def test_flush_drops_and_records_event(self):
+        timeline = timeline_mod.Timeline()
+        cache = QueryCache(1 << 20, shards=2, timeline=timeline)
+        _fill(cache, "", GEN, {"x": 1}, b"a")
+        _fill(cache, "", GEN, {"x": 2}, b"b")
+        dropped = cache.flush(reason="reload", generation="inst-2")
+        assert dropped == 2 and len(cache) == 0
+        events = [
+            e for e in timeline.to_dict()["events"]
+            if e["kind"] == "cache_flush"
+        ]
+        assert events and events[-1]["reason"] == "reload"
+        assert events[-1]["dropped"] == 2
+
+    def test_tenant_scoped_flush(self):
+        cache = QueryCache(1 << 20, shards=2)
+        _fill(cache, "t1", GEN, {"x": 1}, b"a")
+        _fill(cache, "t2", GEN, {"x": 1}, b"b")
+        cache.flush("t1", reason="reload")
+        assert not cache.claim(
+            "t1", GEN, canonical_query_bytes({"x": 1})
+        ).hit
+        assert cache.claim(
+            "t2", GEN, canonical_query_bytes({"x": 1})
+        ).hit
+
+    def test_post_flush_fill_not_resurrected(self):
+        """A fill whose claim predates the flush must not re-insert the
+        entry the flush was meant to kill — but its waiters still get
+        the computed bytes."""
+        cache = QueryCache(1 << 20, shards=1)
+        canon = canonical_query_bytes({"x": 1})
+        leader = cache.claim("", GEN, canon)
+        waiter = cache.claim("", GEN, canon)
+        cache.flush(reason="promote")
+        cache.fill(leader, b"stale-gen-answer")
+        assert cache.join(waiter, timeout_s=1.0) == b"stale-gen-answer"
+        assert len(cache) == 0, "flushed claim resurrected an entry"
+
+    def test_close_fails_waiters(self):
+        cache = QueryCache(1 << 20, shards=1)
+        canon = canonical_query_bytes({"x": 1})
+        cache.claim("", GEN, canon)  # leader, never fills
+        waiter = cache.claim("", GEN, canon)
+        cache.close()
+        with pytest.raises(LeaderFailed):
+            cache.join(waiter, timeout_s=1.0)
+
+
+class TestEnvKnobs:
+    def test_enabled_flag(self, monkeypatch):
+        monkeypatch.delenv("PIO_CACHE", raising=False)
+        monkeypatch.delenv("PIO_CACHE_BUDGET_BYTES", raising=False)
+        assert not querycache.cache_enabled_from_env()
+        monkeypatch.setenv("PIO_CACHE", "1")
+        assert querycache.cache_enabled_from_env()
+        monkeypatch.setenv("PIO_CACHE", "off")
+        monkeypatch.setenv("PIO_CACHE_BUDGET_BYTES", "1024")
+        assert not querycache.cache_enabled_from_env(), (
+            "explicit PIO_CACHE=off must win over a budget"
+        )
+        monkeypatch.delenv("PIO_CACHE")
+        assert querycache.cache_enabled_from_env(), (
+            "a budget alone opts in"
+        )
+
+    def test_budget_malformed_falls_back(self, monkeypatch):
+        monkeypatch.setenv("PIO_CACHE_BUDGET_BYTES", "not-a-number")
+        assert querycache.default_budget_bytes() == 64 << 20
+        monkeypatch.setenv("PIO_CACHE_BUDGET_BYTES", "-5")
+        assert querycache.default_budget_bytes() == 64 << 20
+        monkeypatch.setenv("PIO_CACHE_BUDGET_BYTES", "4096")
+        assert querycache.default_budget_bytes() == 4096
+
+    def test_shards_and_ttl_from_env(self, monkeypatch):
+        monkeypatch.setenv("PIO_CACHE_SHARDS", "3")
+        monkeypatch.setenv("PIO_CACHE_TTL_S", "2.5")
+        cache = QueryCache(1 << 20)
+        assert cache.stats()["shards"] == 3
+        assert cache.stats()["ttlS"] == 2.5
+        monkeypatch.setenv("PIO_CACHE_SHARDS", "zero")
+        monkeypatch.setenv("PIO_CACHE_TTL_S", "-1")
+        cache = QueryCache(1 << 20)
+        assert cache.stats()["shards"] == 8
+        assert cache.stats()["ttlS"] is None
+
+
+class TestBenchKeys:
+    """The shared Zipf generator both serving_bench modes draw from."""
+
+    def test_seeded_deterministic(self):
+        import bench_keys
+
+        a = bench_keys.zipf_sequence(100, 500, alpha=1.1, seed=7)
+        b = bench_keys.zipf_sequence(100, 500, alpha=1.1, seed=7)
+        assert np.array_equal(a, b)
+        c = bench_keys.zipf_sequence(100, 500, alpha=1.1, seed=8)
+        assert not np.array_equal(a, c)
+
+    def test_alpha_one_matches_legacy_density_weights(self):
+        """--density always used 1/rank; alpha=1.0 must be bit-equal so
+        extracting the shared generator changed no density draws."""
+        import bench_keys
+
+        legacy = 1.0 / (1.0 + np.arange(50))
+        legacy = legacy / legacy.sum()
+        assert np.array_equal(bench_keys.zipf_weights(50, 1.0), legacy)
+
+    def test_alpha_zero_is_uniform(self):
+        import bench_keys
+
+        w = bench_keys.zipf_weights(10, 0.0)
+        assert np.allclose(w, 0.1)
+
+    def test_higher_alpha_concentrates_head(self):
+        import bench_keys
+
+        w09 = bench_keys.zipf_weights(1000, 0.9)
+        w11 = bench_keys.zipf_weights(1000, 1.1)
+        assert w11[0] > w09[0]
+        assert w11[-1] < w09[-1]
+
+    def test_bounds_and_validation(self):
+        import bench_keys
+
+        seq = bench_keys.zipf_sequence(10, 200, alpha=1.1, seed=0)
+        assert seq.min() >= 0 and seq.max() < 10
+        with pytest.raises(ValueError):
+            bench_keys.zipf_weights(0)
+
+
+def test_volatile_keys_match_canary_scorer():
+    """The cache strips exactly the fields the canary's divergence
+    scorer ignores — one volatile set, no drift."""
+    from predictionio_tpu.serving import canary
+
+    stripped = json.loads(
+        canonical_query_bytes(
+            {k: 1 for k in canary.VOLATILE_PREDICTION_KEYS} | {"x": 2}
+        )
+    )
+    assert stripped == {"x": 2}
